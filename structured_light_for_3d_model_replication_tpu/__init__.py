@@ -1,0 +1,24 @@
+"""structured_light_for_3d_model_replication_tpu — TPU-native structured-light 3D scanning.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the reference
+scan-to-print pipeline (Nuttoty/Structured_Light_for_3D_Model_Replication):
+Gray-code pattern projection, per-pixel decode, ray-plane triangulation,
+point-cloud cleanup, multi-view registration/merge, and surface meshing —
+re-designed TPU-first (dense masked compute, static shapes, shard_map over
+device meshes) rather than translated from the reference's NumPy/Open3D code.
+
+Subpackages
+-----------
+ops       — jitted compute kernels (patterns, decode, triangulate, pointcloud,
+            registration, meshing)
+models    — pipelines that compose the ops (scan pipeline, oracle, synthetic
+            scanner), plus calibration
+parallel  — device-mesh / sharding layer (batch DP over scans, spatial tiling)
+io        — PLY/STL/.mat/image-stack codecs
+hw        — hardware edge (capture server, turntable driver)
+utils     — profiling, misc
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401

@@ -1,0 +1,181 @@
+"""Configuration layer.
+
+The reference keeps its knobs in a constants module (`server/config.py:10-30`)
+plus ~30 Tk variables (`server/gui.py:27-83`). Here the same surface is a set of
+frozen dataclasses so configs are hashable (usable as jit static args) and
+serializable. A `PROCESSING_BACKEND` switch selects the compute path, as
+required by BASELINE.json: "jax_tpu" (default) or "numpy_cv2" (the oracle).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import math
+import os
+
+# Backend switch (BASELINE.json: PROCESSING_BACKEND in {'numpy_cv2', 'jax_tpu'}).
+PROCESSING_BACKEND = os.environ.get("SL_PROCESSING_BACKEND", "jax_tpu")
+
+VALID_BACKENDS = ("jax_tpu", "numpy_cv2")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectorConfig:
+    """Projector geometry; mirrors reference `server/config.py:16-22`."""
+
+    width: int = 1920
+    height: int = 1080
+    # Second display sits to the right of the primary one.
+    offset_x: int = 1920
+    offset_y: int = 0
+    brightness: int = 200
+    # Pattern downsampling factor (reference D_SAMPLE_PROJ, applied at
+    # `server/sl_system.py:144-146`): finest `downsample` bits are dropped.
+    downsample: int = 1
+
+    @property
+    def col_bits(self) -> int:
+        """Bits needed to code width/downsample coarse columns.
+
+        Downsampling reduces the BIT COUNT (the reference's D_SAMPLE_PROJ
+        projects coarser stripes and hence fewer planes,
+        `server/sl_system.py:52-54,144-146`): 1920 @ D=2 -> ceil(log2(960)) =
+        10 bits, giving the 42-frame stacks BASELINE.json describes.
+        """
+        return int(math.ceil(math.log2(math.ceil(self.width / self.downsample))))
+
+    @property
+    def row_bits(self) -> int:
+        return int(math.ceil(math.log2(math.ceil(self.height / self.downsample))))
+
+    @property
+    def n_frames(self) -> int:
+        """2 refs (white, black) + (pattern, inverse) per bit for cols + rows.
+
+        1920x1080 @ D=1 -> 2 + 2*11 + 2*11 = 46 (`server/sl_system.py:52-54`);
+        @ D=2 -> 2 + 2*10 + 2*10 = 42 (the BASELINE.json configuration).
+        """
+        return 2 + 2 * self.col_bits + 2 * self.row_bits
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeConfig:
+    """Per-pixel validity-mask thresholds.
+
+    Two variants exist in the reference and both must be supported (§7 of
+    SURVEY.md): the adaptive one (`server/sl_system.py:526-535`) and the fixed
+    one (`multi_point_cloud_process.py:36-38`).
+    """
+
+    mode: str = "adaptive"  # "adaptive" | "fixed"
+    # adaptive: white > white_factor * percentile(black, black_percentile)
+    #           AND (white-black) > contrast_frac * max(white-black)
+    white_factor: float = 1.5
+    black_percentile: float = 95.0
+    contrast_frac: float = 0.05
+    # fixed: white > white_thresh AND (white-black) > contrast_thresh
+    white_thresh: float = 40.0
+    contrast_thresh: float = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TriangulationConfig:
+    """Ray-plane intersection options.
+
+    The reference triangulates against column planes only — `row_map` is
+    computed but never used (`server/sl_system.py:624-629`). "col" reproduces
+    that; "row" triangulates against row planes instead, and "both" fuses the
+    two independent ray-plane depth estimates by inverse variance (sensitivity
+    to a one-index plane step). wPlaneRow is already part of the calibration
+    container (`server/sl_system.py:403,410`); the reference just never uses it.
+    """
+
+    plane_axis: str = "col"  # "col" | "row" | "both"
+    denom_eps: float = 1e-6
+    # Reject points behind the camera or absurdly far.
+    min_t: float = 0.0
+    max_t: float = 1e5
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckerboardConfig:
+    """Calibration target; reference `server/config.py:24-27` (7x7 @ 35 mm)."""
+
+    cols: int = 7
+    rows: int = 7
+    square_mm: float = 35.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TurntableConfig:
+    """360° schedule; reference `server/gui.py:79-80` defaults 12 x 30°."""
+
+    turns: int = 12
+    degrees_per_turn: float = 30.0
+    baud: int = 115200
+    done_timeout_s: float = 10.0
+    settle_s: float = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeConfig:
+    """Registration/merge knobs; reference `server/processing.py` defaults."""
+
+    voxel_size: float = 0.02
+    ransac_iters: int = 100_000
+    ransac_confidence: float = 0.999
+    icp_iters: int = 30
+    sor_neighbors: int = 20
+    sor_std_ratio: float = 2.0
+    use_pose_graph: bool = False  # loop-closure LM variant (Old/360Merge.py)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Meshing knobs; reference `server/processing.py:184-310`."""
+
+    method: str = "poisson"  # "poisson" | "ball_pivot"
+    poisson_depth: int = 8  # grid = 2**depth per axis; guard like ref's >16
+    density_trim_quantile: float = 0.02
+    normal_orientation: str = "radial"  # "radial" | "tangent" | "camera"
+    bpa_radius_multipliers: tuple = (1.0, 2.0, 4.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class CaptureConfig:
+    """Capture-loop timing; reference `server/sl_system.py:465,103` etc."""
+
+    frame_dwell_ms: int = 200
+    calib_dwell_ms: int = 250
+    capture_timeout_s: float = 20.0
+    http_port: int = 5000
+    push_port: int = 8765  # Android host push-mode port
+
+
+def dated_output_root(base: str = ".") -> str:
+    """Reference layout root `{dd_mm_YYYY}_3Dscan` (`server/config.py:10`)."""
+    stamp = datetime.date.today().strftime("%d_%m_%Y")
+    return os.path.join(base, f"{stamp}_3Dscan")
+
+
+@dataclasses.dataclass(frozen=True)
+class SystemConfig:
+    projector: ProjectorConfig = ProjectorConfig()
+    decode: DecodeConfig = DecodeConfig()
+    triangulation: TriangulationConfig = TriangulationConfig()
+    checkerboard: CheckerboardConfig = CheckerboardConfig()
+    turntable: TurntableConfig = TurntableConfig()
+    merge: MergeConfig = MergeConfig()
+    mesh: MeshConfig = MeshConfig()
+    capture: CaptureConfig = CaptureConfig()
+    backend: str = PROCESSING_BACKEND
+
+    def __post_init__(self):
+        if self.backend not in VALID_BACKENDS:
+            raise ValueError(
+                f"backend must be one of {VALID_BACKENDS}, got {self.backend!r}"
+            )
+
+
+DEFAULT = SystemConfig()

@@ -1,0 +1,1 @@
+"""Pipelines composing the ops: scan pipeline, oracle backend, synthetic scanner."""

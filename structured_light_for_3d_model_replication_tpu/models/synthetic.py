@@ -1,0 +1,215 @@
+"""Synthetic structured-light scanner — the hardware simulator.
+
+The reference has no headless test path at all (SURVEY.md §4: "There are no
+tests"; its only mock is a `time.sleep(2)` turntable stub, `server/gui.py:
+690-693`). This module is the new build's answer: a ray-traced simulator that
+renders exactly the frame stack a phone camera would capture while the
+projector plays the Gray-code sequence over a known scene. Every pipeline
+stage can then be tested end-to-end against analytic ground truth — decode
+maps against true projector coordinates, triangulated points against true
+surface geometry, multi-view merges against the true rotated object.
+
+Scenes are unions of spheres plus an optional background wall (so background
+removal has something to remove). A turntable is simulated by rotating the
+spheres about a vertical axis through a pivot point, like the real 28BYJ-48
+turntable (`ESP_code.ino`).
+
+Host-side NumPy: this is a test/data substrate, not a hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..config import ProjectorConfig
+from .oracle import camera_rays_np
+
+
+@dataclasses.dataclass(frozen=True)
+class Sphere:
+    center: tuple  # (x, y, z) mm, camera frame at angle 0
+    radius: float
+    albedo: float = 0.9  # fraction of projector brightness reflected
+
+
+@dataclasses.dataclass(frozen=True)
+class Scene:
+    spheres: tuple = (
+        Sphere((0.0, 10.0, 500.0), 80.0, 0.9),
+        Sphere((45.0, -55.0, 470.0), 35.0, 0.7),  # bump: breaks rotational symmetry
+    )
+    wall_z: float | None = 700.0
+    wall_albedo: float = 0.35
+    pivot: tuple = (0.0, 0.0, 500.0)  # turntable axis passes through this, along +y
+    ambient: float = 4.0
+
+
+def default_calibration(cam_height: int = 270, cam_width: int = 480,
+                        proj: ProjectorConfig = ProjectorConfig()):
+    """A plausible synthetic camera/projector stereo rig.
+
+    Returns (cam_K, proj_K, R, T) with stereoCalibrate convention
+    X_p = R X_c + T (units mm). Small camera resolutions keep tests fast;
+    intrinsics scale with the requested size.
+    """
+    f_cam = 1.1 * cam_width
+    cam_K = np.array(
+        [[f_cam, 0, cam_width / 2 - 0.5],
+         [0, f_cam, cam_height / 2 - 0.5],
+         [0, 0, 1]], dtype=np.float64)
+    f_proj = 1.2 * proj.width
+    proj_K = np.array(
+        [[f_proj, 0, proj.width / 2 - 0.5],
+         [0, f_proj, proj.height / 2 - 0.5],
+         [0, 0, 1]], dtype=np.float64)
+    # Projector sits 150 mm to the camera's left, toed in ~8° about y.
+    ang = np.deg2rad(8.0)
+    R = np.array(
+        [[np.cos(ang), 0, -np.sin(ang)],
+         [0, 1, 0],
+         [np.sin(ang), 0, np.cos(ang)]], dtype=np.float64)
+    T = np.array([150.0, 0.0, 20.0], dtype=np.float64)
+    return cam_K, proj_K, R, T
+
+
+def rotated_scene(scene: Scene, angle_deg: float) -> Scene:
+    """Scene after the turntable rotates by angle_deg about the pivot's y-axis."""
+    th = np.deg2rad(angle_deg)
+    Ry = np.array([[np.cos(th), 0, np.sin(th)],
+                   [0, 1, 0],
+                   [-np.sin(th), 0, np.cos(th)]], dtype=np.float64)
+    pivot = np.asarray(scene.pivot)
+    spheres = tuple(
+        Sphere(tuple(pivot + Ry @ (np.asarray(s.center) - pivot)), s.radius, s.albedo)
+        for s in scene.spheres
+    )
+    return dataclasses.replace(scene, spheres=spheres)
+
+
+def raycast(scene: Scene, rays: np.ndarray):
+    """Intersect unit rays from the origin with the scene.
+
+    rays: (N, 3). Returns (t (N,), albedo (N,), hit_object (N,) bool,
+    hit_any (N,) bool). Nearest positive hit wins; wall is a hit but not
+    "object".
+    """
+    N = rays.shape[0]
+    t_best = np.full(N, np.inf)
+    albedo = np.zeros(N)
+    is_object = np.zeros(N, dtype=bool)
+
+    for s in scene.spheres:
+        c = np.asarray(s.center, np.float64)
+        b = rays @ c  # = t at closest approach (|ray|=1)
+        disc = b * b - (c @ c - s.radius**2)
+        ok = disc > 0
+        sq = np.sqrt(np.where(ok, disc, 0.0))
+        t0 = b - sq
+        t1 = b + sq
+        t = np.where(t0 > 1e-6, t0, t1)  # nearest positive root
+        ok &= t > 1e-6
+        closer = ok & (t < t_best)
+        t_best = np.where(closer, t, t_best)
+        albedo = np.where(closer, s.albedo, albedo)
+        is_object = np.where(closer, True, is_object)
+
+    if scene.wall_z is not None:
+        rz = rays[:, 2]
+        ok = rz > 1e-6
+        t = np.where(ok, scene.wall_z / np.where(ok, rz, 1.0), np.inf)
+        closer = ok & (t < t_best)
+        t_best = np.where(closer, t, t_best)
+        albedo = np.where(closer, scene.wall_albedo, albedo)
+        is_object = np.where(closer, False, is_object)
+
+    hit = np.isfinite(t_best)
+    t_best = np.where(hit, t_best, 0.0)
+    return t_best, albedo, is_object, hit
+
+
+def render_scan(
+    scene: Scene,
+    cam_K: np.ndarray,
+    proj_K: np.ndarray,
+    R: np.ndarray,
+    T: np.ndarray,
+    cam_height: int,
+    cam_width: int,
+    proj: ProjectorConfig = ProjectorConfig(),
+    pattern_frames: np.ndarray | None = None,
+):
+    """Render the full protocol-ordered capture stack for one turntable stop.
+
+    Returns (stack (n_frames, H, W) uint8, ground_truth dict). Ground truth
+    holds per-pixel true points, true projector (u, v), the object mask, and
+    the hit mask — everything needed to verify decode and triangulation
+    analytically.
+    """
+    from ..ops.patterns import pattern_stack  # lazy: pulls in jax
+
+    if pattern_frames is None:
+        pattern_frames = np.asarray(
+            pattern_stack(proj.width, proj.height, proj.col_bits, proj.row_bits,
+                          proj.brightness, proj.downsample))
+
+    rays = camera_rays_np(cam_K, cam_height, cam_width).reshape(-1, 3)
+    t, albedo, is_object, hit = raycast(scene, rays)
+    points = t[:, None] * rays  # (N, 3), camera frame
+
+    # Project every hit point into the projector.
+    P_p = points @ R.T + T[None, :]
+    z = P_p[:, 2]
+    ok_z = z > 1e-6
+    u = np.where(ok_z, (proj_K[0, 0] * P_p[:, 0] + proj_K[0, 2] * z)
+                 / np.where(ok_z, z, 1.0), -1.0)
+    v = np.where(ok_z, (proj_K[1, 1] * P_p[:, 1] + proj_K[1, 2] * z)
+                 / np.where(ok_z, z, 1.0), -1.0)
+    ui = np.round(u).astype(np.int64)
+    vi = np.round(v).astype(np.int64)
+    lit = hit & ok_z & (ui >= 0) & (ui < proj.width) & (vi >= 0) & (vi < proj.height)
+    ui_c = np.clip(ui, 0, proj.width - 1)
+    vi_c = np.clip(vi, 0, proj.height - 1)
+
+    n_frames = pattern_frames.shape[0]
+    stack = np.empty((n_frames, cam_height * cam_width), dtype=np.uint8)
+    amb = scene.ambient
+    for f in range(n_frames):
+        frame = pattern_frames[f]
+        proj_val = frame[vi_c, ui_c].astype(np.float64)
+        val = np.where(lit, albedo * proj_val + amb, np.where(hit, amb, 0.0))
+        stack[f] = np.clip(val, 0, 255).astype(np.uint8)
+    stack = stack.reshape(n_frames, cam_height, cam_width)
+
+    gt = {
+        "points": points.reshape(cam_height, cam_width, 3),
+        "proj_u": u.reshape(cam_height, cam_width),
+        "proj_v": v.reshape(cam_height, cam_width),
+        "object_mask": is_object.reshape(cam_height, cam_width),
+        "hit_mask": hit.reshape(cam_height, cam_width),
+        "lit_mask": lit.reshape(cam_height, cam_width),
+    }
+    return stack, gt
+
+
+def render_turntable_scans(
+    scene: Scene,
+    n_stops: int,
+    degrees_per_stop: float,
+    cam_K, proj_K, R, T,
+    cam_height: int, cam_width: int,
+    proj: ProjectorConfig = ProjectorConfig(),
+):
+    """Render stacks for a full 360° schedule. Returns list of (stack, gt)."""
+    from ..ops.patterns import pattern_stack
+
+    frames = np.asarray(
+        pattern_stack(proj.width, proj.height, proj.col_bits, proj.row_bits,
+                      proj.brightness, proj.downsample))
+    out = []
+    for k in range(n_stops):
+        sc = rotated_scene(scene, k * degrees_per_stop)
+        out.append(render_scan(sc, cam_K, proj_K, R, T, cam_height, cam_width,
+                               proj, pattern_frames=frames))
+    return out

@@ -1,0 +1,3 @@
+"""Jitted compute kernels (the TPU replacement for the reference's NumPy/Open3D)."""
+
+from . import patterns, decode, triangulate  # noqa: F401

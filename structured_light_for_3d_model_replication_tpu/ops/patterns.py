@@ -1,0 +1,98 @@
+"""Gray-code pattern generation.
+
+The reference builds Gray codes with a recursive string generator and a Python
+loop over bit-planes (`server/sl_system.py:44-86`). Here the whole stack is one
+vectorized expression: ``g = i ^ (i >> 1)`` per projector column/row, then a
+broadcasted bit-extraction over all planes at once — a single fused XLA kernel.
+
+Frame protocol (must match the reference's on-disk numbering,
+`server/sl_system.py:133-150`): frame 0 = white, frame 1 = black, then for each
+column bit MSB-first a (pattern, inverse) pair, then the same for row bits.
+1920x1080 => 46 frames.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ProjectorConfig
+
+
+def gray_code(x: jnp.ndarray) -> jnp.ndarray:
+    """Binary-reflected Gray code of integer array x."""
+    return x ^ (x >> 1)
+
+
+def gray_to_binary(g: jnp.ndarray, n_bits: int) -> jnp.ndarray:
+    """Inverse of :func:`gray_code` via doubling XOR shifts.
+
+    Replaces the reference's per-bit iterative XOR loop
+    (`server/sl_system.py:567-570`) with log2(n_bits) whole-array XORs.
+    """
+    b = g
+    shift = 1
+    while shift < n_bits:
+        b = b ^ (b >> shift)
+        shift *= 2
+    return b
+
+
+def bit_planes(n: int, n_bits: int, downsample: int = 1) -> jnp.ndarray:
+    """(n_bits, n) uint8 array: Gray-code bit b (MSB-first) of each COARSE index.
+
+    With downsampling the projected code is the Gray code of idx//downsample —
+    coarser stripes, fewer planes (reference D_SAMPLE_PROJ semantics,
+    `server/sl_system.py:144-146`): n_bits must be the coarse bit count.
+    """
+    idx = jnp.arange(n, dtype=jnp.int32) // downsample
+    g = gray_code(idx)
+    shifts = jnp.arange(n_bits - 1, -1, -1, dtype=jnp.int32)[:, None]
+    return ((g[None, :] >> shifts) & 1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def pattern_stack(
+    width: int,
+    height: int,
+    col_bits: int,
+    row_bits: int,
+    brightness: int = 200,
+    downsample: int = 1,
+) -> jnp.ndarray:
+    """Full projector frame stack, shape (n_frames, height, width) uint8.
+
+    Layout: [white, black, colbit0, ~colbit0, ..., rowbit0, ~rowbit0, ...].
+    """
+    b = jnp.uint8(brightness)
+    white = jnp.full((1, height, width), b, dtype=jnp.uint8)
+    black = jnp.zeros((1, height, width), dtype=jnp.uint8)
+
+    cols = bit_planes(width, col_bits, downsample)  # (cb, W)
+    col_pat = (cols[:, None, None, :] * b).astype(jnp.uint8)  # (cb,1,1,W)
+    col_pat = jnp.broadcast_to(col_pat, (col_bits, 1, height, width))
+    col_inv = (b - col_pat).astype(jnp.uint8)
+    col_frames = jnp.concatenate([col_pat, col_inv], axis=1)  # (cb, 2, H, W)
+    col_frames = col_frames.reshape(2 * col_bits, height, width)
+
+    rows = bit_planes(height, row_bits, downsample)  # (rb, H)
+    row_pat = (rows[:, None, :, None] * b).astype(jnp.uint8)
+    row_pat = jnp.broadcast_to(row_pat, (row_bits, 1, height, width))
+    row_inv = (b - row_pat).astype(jnp.uint8)
+    row_frames = jnp.concatenate([row_pat, row_inv], axis=1)
+    row_frames = row_frames.reshape(2 * row_bits, height, width)
+
+    return jnp.concatenate([white, black, col_frames, row_frames], axis=0)
+
+
+def pattern_stack_for(proj: ProjectorConfig) -> jnp.ndarray:
+    return pattern_stack(
+        proj.width,
+        proj.height,
+        proj.col_bits,
+        proj.row_bits,
+        proj.brightness,
+        proj.downsample,
+    )

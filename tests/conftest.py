@@ -1,0 +1,55 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip hardware isn't available in CI; sharding correctness is validated on
+host-platform virtual devices (set BEFORE jax import, as jax reads XLA_FLAGS at
+backend init).
+"""
+
+import os
+
+# Hard assignment: the image's sitecustomize (PYTHONPATH=/root/.axon_site)
+# pre-sets JAX_PLATFORMS=axon (the tunneled TPU), so setdefault would lose.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from structured_light_for_3d_model_replication_tpu.config import ProjectorConfig  # noqa: E402
+from structured_light_for_3d_model_replication_tpu.models import synthetic  # noqa: E402
+
+
+# Small projector keeps synthetic renders fast while exercising every code
+# path (col_bits=8, row_bits=7 -> 2 + 16 + 14 = 32 frames).
+SMALL_PROJ = ProjectorConfig(width=256, height=128, brightness=200)
+CAM_H, CAM_W = 96, 160
+
+
+@pytest.fixture(scope="session")
+def small_proj():
+    return SMALL_PROJ
+
+
+@pytest.fixture(scope="session")
+def synth_rig():
+    """(cam_K, proj_K, R, T) for the small synthetic rig."""
+    return synthetic.default_calibration(CAM_H, CAM_W, SMALL_PROJ)
+
+
+@pytest.fixture(scope="session")
+def synth_scan(synth_rig):
+    """One rendered stop: (stack, ground-truth dict)."""
+    cam_K, proj_K, R, T = synth_rig
+    scene = synthetic.Scene()
+    return synthetic.render_scan(
+        scene, cam_K, proj_K, R, T, CAM_H, CAM_W, SMALL_PROJ
+    )
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

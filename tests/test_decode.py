@@ -1,0 +1,56 @@
+"""Decode: JAX kernels vs NumPy oracle (pixel-exact) and vs synthetic ground truth."""
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.config import DecodeConfig
+from structured_light_for_3d_model_replication_tpu.models import oracle
+from structured_light_for_3d_model_replication_tpu.ops import decode
+
+
+def test_jax_matches_oracle_adaptive(synth_scan, small_proj):
+    stack, _ = synth_scan
+    cb, rb = small_proj.col_bits, small_proj.row_bits
+    cfg = DecodeConfig(mode="adaptive")
+    jc, jr, jm = decode.decode_stack(stack, cb, rb, cfg=cfg)
+    oc, orr, om = oracle.decode_stack_np(stack, cb, rb, cfg)
+    assert np.array_equal(np.asarray(jc), oc)
+    assert np.array_equal(np.asarray(jr), orr)
+    assert np.array_equal(np.asarray(jm), om)
+
+
+def test_jax_matches_oracle_fixed(synth_scan, small_proj):
+    stack, _ = synth_scan
+    cb, rb = small_proj.col_bits, small_proj.row_bits
+    cfg = DecodeConfig(mode="fixed")
+    jc, jr, jm = decode.decode_stack(stack, cb, rb, cfg=cfg)
+    oc, orr, om = oracle.decode_stack_np(stack, cb, rb, cfg)
+    assert np.array_equal(np.asarray(jc), oc)
+    assert np.array_equal(np.asarray(jr), orr)
+    assert np.array_equal(np.asarray(jm), om)
+
+
+def test_decode_recovers_projector_coords(synth_scan, small_proj):
+    """Decoded maps must equal the true projector pixel each camera pixel saw."""
+    stack, gt = synth_scan
+    cb, rb = small_proj.col_bits, small_proj.row_bits
+    col_map, row_map, mask = decode.decode_stack(stack, cb, rb)
+    col_map, row_map, mask = map(np.asarray, (col_map, row_map, mask))
+
+    check = mask & gt["lit_mask"]
+    assert check.sum() > 1000  # scene actually visible
+    true_u = np.round(gt["proj_u"]).astype(int)
+    true_v = np.round(gt["proj_v"]).astype(int)
+    # Rounding at projector-pixel boundaries can flip one code step.
+    assert np.abs(col_map - true_u)[check].max() <= 1
+    assert np.abs(row_map - true_v)[check].max() <= 1
+    # And the overwhelming majority are exact.
+    assert (col_map == true_u)[check].mean() > 0.9
+    assert (row_map == true_v)[check].mean() > 0.9
+
+
+def test_mask_rejects_unlit(synth_scan, small_proj):
+    stack, gt = synth_scan
+    _, _, mask = decode.decode_stack(stack, small_proj.col_bits, small_proj.row_bits)
+    mask = np.asarray(mask)
+    # Nothing outside the lit region may pass the adaptive mask.
+    assert not np.any(mask & ~gt["lit_mask"])

@@ -1,0 +1,74 @@
+"""Pattern generation: protocol layout, Gray-code round trip."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from structured_light_for_3d_model_replication_tpu.config import ProjectorConfig
+from structured_light_for_3d_model_replication_tpu.ops import patterns
+
+
+def test_gray_roundtrip():
+    x = jnp.arange(4096, dtype=jnp.int32)
+    g = patterns.gray_code(x)
+    assert np.array_equal(np.asarray(patterns.gray_to_binary(g, 12)), np.asarray(x))
+    # Successive Gray codes differ in exactly one bit.
+    diff = np.asarray(g[1:] ^ g[:-1])
+    assert np.all(np.bitwise_count(diff.astype(np.uint32)) == 1)
+
+
+def test_frame_count_1080p():
+    proj = ProjectorConfig()  # 1920x1080
+    assert proj.col_bits == 11 and proj.row_bits == 11
+    assert proj.n_frames == 46  # reference server/sl_system.py:52-54
+
+
+def test_stack_layout():
+    proj = ProjectorConfig(width=32, height=16, brightness=200)
+    s = np.asarray(patterns.pattern_stack(
+        proj.width, proj.height, proj.col_bits, proj.row_bits, proj.brightness))
+    assert s.shape == (2 + 2 * 5 + 2 * 4, 16, 32)
+    assert s.dtype == np.uint8
+    assert np.all(s[0] == 200) and np.all(s[1] == 0)
+    # Pattern + inverse are complementary.
+    for b in range(5):
+        assert np.all(s[2 + 2 * b].astype(int) + s[3 + 2 * b].astype(int) == 200)
+    # Column frames constant along rows; row frames constant along columns.
+    assert np.all(s[2] == s[2][0:1, :])
+    assert np.all(s[2 + 10] == s[2 + 10][:, 0:1])
+    # MSB column plane: left half 0 (gray MSB of 0..15 is 0), right half on.
+    assert np.all(s[2][:, :16] == 0) and np.all(s[2][:, 16:] == 200)
+
+
+def test_decoded_value_is_column_index():
+    """Decoding noiseless patterns must recover the exact column/row index."""
+    from structured_light_for_3d_model_replication_tpu.ops import decode
+
+    proj = ProjectorConfig(width=64, height=32, brightness=200)
+    s = patterns.pattern_stack(proj.width, proj.height, proj.col_bits,
+                               proj.row_bits, proj.brightness)
+    # Treat projector frames as a perfectly-captured camera stack.
+    col_map, row_map, _ = decode.decode_stack(s, proj.col_bits, proj.row_bits)
+    cm = np.asarray(col_map)
+    rm = np.asarray(row_map)
+    assert np.array_equal(cm, np.broadcast_to(np.arange(64), (32, 64)))
+    assert np.array_equal(rm, np.broadcast_to(np.arange(32)[:, None], (32, 64)))
+
+
+def test_downsample_reduces_bits_and_frames():
+    """D_SAMPLE_PROJ semantics: coarser stripes -> fewer planes. The
+    BASELINE.json 42-frame 1080p protocol is 1920x1080 @ downsample=2."""
+    from structured_light_for_3d_model_replication_tpu.ops import decode
+
+    assert ProjectorConfig(downsample=2).n_frames == 42
+    assert ProjectorConfig(downsample=1).n_frames == 46
+
+    proj = ProjectorConfig(width=64, height=32, downsample=4)
+    assert proj.col_bits == 4 and proj.row_bits == 3
+    s = patterns.pattern_stack(proj.width, proj.height, proj.col_bits,
+                               proj.row_bits, proj.brightness, proj.downsample)
+    assert s.shape[0] == proj.n_frames == 2 + 2 * 4 + 2 * 3
+    col_map, _, _ = decode.decode_stack(
+        s, proj.col_bits, proj.row_bits, downsample=proj.downsample)
+    cm = np.asarray(col_map)
+    # Decoded values are stripe centers in projector pixels.
+    assert np.array_equal(cm[0], (np.arange(64) // 4) * 4 + 1)

@@ -1,0 +1,115 @@
+"""Triangulation: plane precompute, oracle parity, synthetic ground-truth accuracy."""
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.config import TriangulationConfig
+from structured_light_for_3d_model_replication_tpu.models import oracle
+from structured_light_for_3d_model_replication_tpu.ops import decode, triangulate
+from tests.conftest import CAM_H, CAM_W
+
+
+def _calib(synth_rig, small_proj):
+    cam_K, proj_K, R, T = synth_rig
+    return triangulate.make_calibration(
+        cam_K, proj_K, R, T, CAM_H, CAM_W, small_proj.width, small_proj.height)
+
+
+def test_planes_match_oracle(synth_rig, small_proj):
+    cam_K, proj_K, R, T = synth_rig
+    jp = np.asarray(triangulate.projector_planes(proj_K, R, T, small_proj.width, "col"))
+    op = oracle.projector_planes_np(proj_K, R, T, small_proj.width, "col")
+    # Same plane up to sign of the normal.
+    sign = np.sign(np.sum(jp[:, :3] * op[:, :3], axis=-1, keepdims=True))
+    assert np.allclose(jp, op * np.concatenate([sign, sign, sign, sign], -1), atol=1e-4)
+
+
+def test_planes_contain_projector_center_and_pixels(synth_rig, small_proj):
+    """Analytic property: plane u must contain the projector center and every
+    back-projected point of projector column u."""
+    cam_K, proj_K, R, T = synth_rig
+    planes = np.asarray(
+        triangulate.projector_planes(proj_K, R, T, small_proj.width, "col"))
+    center = -(R.T @ T)
+    resid = planes[:, :3] @ center + planes[:, 3]
+    assert np.abs(resid).max() < 1e-4
+    # Points along column u at depth z=1..3 in projector frame, to camera frame.
+    Kinv = np.linalg.inv(proj_K)
+    for u in (0, 37, small_proj.width - 1):
+        for v in (0.0, 0.5, 1.0):
+            for z in (1.0, 2.5):
+                X_p = z * (Kinv @ np.array([u, v * small_proj.height, 1.0]))
+                X_c = R.T @ (X_p - T)
+                r = planes[u, :3] @ X_c + planes[u, 3]
+                assert abs(r) < 1e-3 * z
+
+
+def test_triangulate_matches_oracle(synth_scan, synth_rig, small_proj):
+    stack, _ = synth_scan
+    cam_K, proj_K, R, T = synth_rig
+    cb, rb = small_proj.col_bits, small_proj.row_bits
+    col_map, row_map, mask = decode.decode_stack(stack, cb, rb)
+    calib = _calib(synth_rig, small_proj)
+    pts, valid = triangulate.triangulate(col_map, row_map, mask, calib)
+    pts, valid = np.asarray(pts), np.asarray(valid)
+
+    opts, oidx = oracle.triangulate_np(
+        np.asarray(col_map), np.asarray(row_map), np.asarray(mask),
+        cam_K, proj_K, R, T, small_proj.width, small_proj.height)
+    jidx = np.flatnonzero(valid)
+    assert np.array_equal(jidx, oidx)
+    assert np.allclose(pts[jidx], opts, rtol=1e-4, atol=1e-2)
+
+
+def test_triangulation_accuracy_vs_ground_truth(synth_scan, synth_rig, small_proj):
+    """Reconstructed points must lie within ~1 projector-pixel quantization of
+    the true surface (mm-scale scene at 500 mm depth)."""
+    stack, gt = synth_scan
+    cb, rb = small_proj.col_bits, small_proj.row_bits
+    col_map, row_map, mask = decode.decode_stack(stack, cb, rb)
+    calib = _calib(synth_rig, small_proj)
+    pts, valid = triangulate.triangulate(col_map, row_map, mask, calib)
+    pts = np.asarray(pts).reshape(CAM_H, CAM_W, 3)
+    valid = np.asarray(valid).reshape(CAM_H, CAM_W)
+
+    check = valid & gt["lit_mask"] & gt["hit_mask"]
+    assert check.sum() > 1000
+    err = np.linalg.norm(pts - gt["points"], axis=-1)[check]
+    # Depth sensitivity here is ~z²/(f·baseline) ≈ 5.4 mm per projector pixel;
+    # decode rounds to the nearest column, so errors stay within ~1 pixel.
+    assert np.median(err) < 3.0
+    assert np.quantile(err, 0.95) < 8.0
+
+
+def test_both_axis_matches_oracle(synth_scan, synth_rig, small_proj):
+    """JAX and NumPy backends must agree on the 'both' fusion path too."""
+    stack, _ = synth_scan
+    cam_K, proj_K, R, T = synth_rig
+    cb, rb = small_proj.col_bits, small_proj.row_bits
+    col_map, row_map, mask = decode.decode_stack(stack, cb, rb)
+    calib = _calib(synth_rig, small_proj)
+    cfg = TriangulationConfig(plane_axis="both")
+    pts, valid = triangulate.triangulate(col_map, row_map, mask, calib, cfg=cfg)
+    pts, valid = np.asarray(pts), np.asarray(valid)
+    opts, oidx = oracle.triangulate_np(
+        np.asarray(col_map), np.asarray(row_map), np.asarray(mask),
+        cam_K, proj_K, R, T, small_proj.width, small_proj.height, cfg)
+    jidx = np.flatnonzero(valid)
+    assert np.array_equal(jidx, oidx)
+    assert np.allclose(pts[jidx], opts, rtol=1e-3, atol=5e-2)
+
+
+def test_both_axis_beats_or_matches_col(synth_scan, synth_rig, small_proj):
+    stack, gt = synth_scan
+    cb, rb = small_proj.col_bits, small_proj.row_bits
+    col_map, row_map, mask = decode.decode_stack(stack, cb, rb)
+    calib = _calib(synth_rig, small_proj)
+
+    errs = {}
+    for axis in ("col", "both"):
+        cfg = TriangulationConfig(plane_axis=axis)
+        pts, valid = triangulate.triangulate(col_map, row_map, mask, calib, cfg=cfg)
+        pts = np.asarray(pts).reshape(CAM_H, CAM_W, 3)
+        valid = np.asarray(valid).reshape(CAM_H, CAM_W)
+        check = valid & gt["lit_mask"]
+        errs[axis] = np.median(np.linalg.norm(pts - gt["points"], axis=-1)[check])
+    assert errs["both"] <= errs["col"] * 1.1

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark harness. Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric (BASELINE config 1): single-view decode+triangulate of a full
+46-frame 1920×1080 capture stack. The reference publishes no numbers
+(BASELINE.md), so ``vs_baseline`` is the speedup over the reference-semantics
+NumPy oracle (`models/oracle.py`, reproducing `server/sl_system.py:508-653`)
+run on this same host — the honest stand-in for "the reference on its own
+hardware".
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+
+def _timeit(fn, repeats=5, warmup=2):
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(times)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.config import ProjectorConfig
+    from structured_light_for_3d_model_replication_tpu.models import (
+        oracle,
+        pipeline,
+        synthetic,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops import patterns
+    from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+        make_calibration,
+    )
+
+    proj = ProjectorConfig()  # 1920×1080, 11+11 bits, 46 frames
+    H, W = proj.height, proj.width
+
+    # Camera-views-projector-head-on stack: decode recovers exact pixel
+    # indices; every pixel is valid, which makes this the WORST case for
+    # triangulation load (2M points).
+    stack_np = np.asarray(
+        patterns.pattern_stack(proj.width, proj.height, proj.col_bits,
+                               proj.row_bits, proj.brightness)
+    )
+    cam_K, proj_K, R, T = synthetic.default_calibration(H, W, proj)
+    calib = make_calibration(cam_K, proj_K, R, T, H, W,
+                             proj_width=proj.width, proj_height=proj.height)
+
+    stack = jax.device_put(jnp.asarray(stack_np))
+
+    def jax_run():
+        out = pipeline.reconstruct(stack, calib, proj.col_bits, proj.row_bits)
+        jax.block_until_ready(out.points)
+        return out
+
+    jax_ms = _timeit(jax_run)
+
+    def oracle_run():
+        col, row, mask = oracle.decode_stack_np(stack_np, proj.col_bits,
+                                                proj.row_bits)
+        oracle.triangulate_np(col, row, mask, cam_K, proj_K, R, T,
+                              proj_width=proj.width, proj_height=proj.height)
+
+    oracle_ms = _timeit(oracle_run, repeats=3, warmup=0)
+
+    print(json.dumps({
+        "metric": "single_view_decode_triangulate_1080p_ms",
+        "value": round(jax_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(oracle_ms / jax_ms, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

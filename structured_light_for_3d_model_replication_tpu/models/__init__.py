@@ -1,1 +1,19 @@
-"""Pipelines composing the ops: scan pipeline, oracle backend, synthetic scanner."""
+"""Pipelines composing the ops: scan pipeline, oracle backend, synthetic scanner.
+
+`pipeline` is exposed lazily: the numpy_cv2 oracle backend must stay importable
+without pulling in jax (which can block at interpreter TPU-claim time on this
+image — see .claude/skills/verify/SKILL.md).
+"""
+
+import importlib
+
+from . import oracle, synthetic  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "pipeline":
+        # import_module (not `from . import`) so an in-progress circular
+        # import resolves from sys.modules instead of recursing into this
+        # __getattr__ via the package attribute lookup.
+        return importlib.import_module(f"{__name__}.pipeline")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,3 @@
+"""Device-mesh / sharding layer: DP over scans, SP over image rows."""
+
+from . import mesh, pipeline  # noqa: F401

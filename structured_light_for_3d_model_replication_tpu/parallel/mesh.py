@@ -1,0 +1,57 @@
+"""Device-mesh construction for the scan workload.
+
+The reference has no accelerator parallelism at all (SURVEY.md §2e: no
+DP/TP/PP/SP, no NCCL/MPI — its only concurrency is Python threads around
+hardware IO). The TPU build's parallel axes are therefore designed from
+scratch around the workload's natural structure:
+
+* ``data`` — independent scans (turntable stops / batch jobs). Embarrassingly
+  parallel; the analogue of DP. BASELINE config 5 (8 scans across a v4-8).
+* ``space`` — spatial tiling of the camera image rows within one scan. The
+  decode reduction is per-pixel (associative along the frame axis), so a row
+  shard needs NO cross-chip communication except the global percentile in the
+  adaptive mask, which XLA lowers to a small collective. The analogue of SP
+  for the 46×4K stacks of BASELINE config 4.
+
+Meshes are ordinary ``jax.sharding.Mesh`` objects; sharded entry points take
+the mesh explicitly so multi-host setups (``jax.distributed``) can pass a
+global mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPACE_AXIS = "space"
+
+
+def make_mesh(data: int | None = None, space: int = 1, devices=None) -> Mesh:
+    """Build a (data, space) mesh. data=None → all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devices) % space:
+            raise ValueError(f"{len(devices)} devices not divisible by space={space}")
+        data = len(devices) // space
+    n = data * space
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(data, space)
+    return Mesh(grid, (DATA_AXIS, SPACE_AXIS))
+
+
+def stack_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """(B, F, H, W) capture-stack batches: B over data, H (rows) over space."""
+    return NamedSharding(mesh, P(DATA_AXIS, None, SPACE_AXIS, None))
+
+
+def cloud_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """(B, N, 3) point batches: B over data, points over space."""
+    return NamedSharding(mesh, P(DATA_AXIS, SPACE_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

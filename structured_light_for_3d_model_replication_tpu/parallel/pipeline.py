@@ -1,0 +1,54 @@
+"""Sharded batch reconstruction: DP over scans × SP over image rows.
+
+BASELINE configs 4 and 5 in one entry point. The design is sharding-annotation
+style (the scaling-book recipe): place the inputs with a ``NamedSharding``,
+jit the pure batch function, and let XLA insert the collectives. For this
+workload the decode/triangulate math is per-pixel, so the only cross-shard
+traffic XLA generates is the adaptive-mask percentile reduction — everything
+else is fully local to each (scan, row-block) tile and rides the VPU.
+
+No shard_map is needed for the forward pipeline; it becomes necessary only for
+the ICP/merge stages where per-scan results interact (see models/merge.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..config import DecodeConfig, TriangulationConfig
+from ..models import pipeline as mp
+from ..ops.triangulate import Calibration
+from . import mesh as mesh_lib
+
+
+def shard_inputs(stacks: jnp.ndarray, calib: Calibration, mesh: Mesh):
+    """Place a (B, F, H, W) batch on the mesh: scans over `data`, rows over
+    `space`; the calibration container is replicated (it is small next to the
+    stacks and every shard needs all plane equations)."""
+    stacks = jax.device_put(stacks, mesh_lib.stack_batch_sharding(mesh))
+    calib = jax.device_put(calib, mesh_lib.replicated(mesh))
+    return stacks, calib
+
+
+def reconstruct_sharded(
+    stacks: jnp.ndarray,
+    calib: Calibration,
+    mesh: Mesh,
+    col_bits: int,
+    row_bits: int,
+    decode_cfg: DecodeConfig = DecodeConfig(),
+    tri_cfg: TriangulationConfig = TriangulationConfig(),
+    downsample: int = 1,
+) -> mp.CloudResult:
+    """Decode+triangulate a batch of scans across the mesh.
+
+    Returns a batched CloudResult whose arrays are sharded (B over data,
+    pixels over space). Call sites that need host data should np.asarray the
+    fields they use.
+    """
+    stacks, calib = shard_inputs(stacks, calib, mesh)
+    fn = mp.reconstruct_batch_fn(col_bits, row_bits, decode_cfg, tri_cfg,
+                                 downsample)
+    return fn(stacks, calib)

@@ -1,0 +1,55 @@
+"""KNN vs scipy.spatial.cKDTree (the kind of tree Open3D uses internally)."""
+
+import numpy as np
+from scipy.spatial import cKDTree
+
+from structured_light_for_3d_model_replication_tpu.ops import knn as knn_ops
+
+
+def test_knn_matches_kdtree(rng):
+    pts = rng.normal(size=(500, 3)).astype(np.float32) * 10
+    d2, idx, nbv = knn_ops.knn(pts, 8, q_tile=128, k_tile=128)
+    assert bool(nbv.all())
+
+    tree = cKDTree(pts)
+    ref_d, ref_i = tree.query(pts, k=8)
+    # Distances must match; indices may differ on exact ties.
+    np.testing.assert_allclose(np.sqrt(np.asarray(d2)), ref_d, atol=1e-3)
+    ties = ref_d[:, -1:] == ref_d  # ignore tied-boundary columns
+    agree = (np.asarray(idx) == ref_i) | ties
+    assert agree.mean() > 0.999
+
+
+def test_knn_exclude_self(rng):
+    pts = rng.normal(size=(300, 3)).astype(np.float32)
+    d2, idx, nbv = knn_ops.knn(pts, 5, exclude_self=True,
+                               q_tile=128, k_tile=128)
+    own = np.arange(300)[:, None]
+    assert not np.any(np.asarray(idx) == own)
+    tree = cKDTree(pts)
+    ref_d, ref_i = tree.query(pts, k=6)
+    np.testing.assert_allclose(
+        np.sqrt(np.asarray(d2)), ref_d[:, 1:], atol=1e-3
+    )
+
+
+def test_knn_respects_validity(rng):
+    pts = rng.normal(size=(200, 3)).astype(np.float32)
+    valid = np.ones(200, bool)
+    valid[50:100] = False
+    d2, idx, nbv = knn_ops.knn(pts, 4, points_valid=valid,
+                               q_tile=64, k_tile=64)
+    # No invalid point may appear as a neighbor.
+    assert not np.any(np.isin(np.asarray(idx)[np.asarray(nbv)],
+                              np.arange(50, 100)))
+    tree = cKDTree(pts[valid])
+    ref_d, _ = tree.query(pts, k=4)
+    np.testing.assert_allclose(np.sqrt(np.asarray(d2)), ref_d, atol=1e-3)
+
+
+def test_knn_separate_queries(rng):
+    pts = rng.normal(size=(400, 3)).astype(np.float32)
+    q = rng.normal(size=(77, 3)).astype(np.float32)
+    d2, idx, nbv = knn_ops.knn(pts, 3, queries=q, q_tile=64, k_tile=128)
+    ref_d, ref_i = cKDTree(pts).query(q, k=3)
+    np.testing.assert_allclose(np.sqrt(np.asarray(d2)), ref_d, atol=1e-3)

@@ -1,0 +1,104 @@
+"""Point-cloud cleanup ops vs plainly-written NumPy/scipy oracles."""
+
+import numpy as np
+from scipy.spatial import cKDTree
+
+from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
+
+
+def _dict_voxel_downsample(pts, voxel):
+    cells = {}
+    for p in pts:
+        key = tuple(np.floor(p / voxel).astype(int))
+        cells.setdefault(key, []).append(p)
+    return {k: np.mean(v, axis=0) for k, v in cells.items()}
+
+
+def test_voxel_downsample_matches_dict(rng):
+    pts = rng.uniform(-5, 5, size=(400, 3)).astype(np.float32)
+    out_p, _, out_v, n_cells = pc.voxel_downsample(pts, 1.0)
+    out_p = np.asarray(out_p)[np.asarray(out_v)]
+    ref = _dict_voxel_downsample(pts, 1.0)
+    assert int(n_cells) == len(ref)
+    # Compare as sets of centroids (order differs).
+    ref_sorted = np.array(sorted(ref.values(), key=tuple))
+    got_sorted = np.array(sorted(out_p, key=tuple))
+    np.testing.assert_allclose(got_sorted, ref_sorted, atol=1e-4)
+
+
+def test_voxel_downsample_attrs_and_validity(rng):
+    pts = rng.uniform(0, 3, size=(100, 3)).astype(np.float32)
+    colors = rng.uniform(0, 1, size=(100, 3)).astype(np.float32)
+    valid = np.ones(100, bool)
+    valid[::3] = False
+    out_p, out_c, out_v, n = pc.voxel_downsample(
+        pts, 1.0, valid=valid, attrs=colors, with_attrs=True
+    )
+    ref = _dict_voxel_downsample(pts[valid], 1.0)
+    assert int(n) == len(ref)
+    # Every valid output centroid must be a centroid of only-valid points.
+    got = np.asarray(out_p)[np.asarray(out_v)]
+    ref_sorted = np.array(sorted(ref.values(), key=tuple))
+    np.testing.assert_allclose(np.array(sorted(got, key=tuple)),
+                               ref_sorted, atol=1e-4)
+    assert np.asarray(out_c).shape == (100, 3)
+
+
+def _sor_oracle(pts, k, ratio):
+    tree = cKDTree(pts)
+    d, _ = tree.query(pts, k=k + 1)
+    mean_d = d[:, 1:].mean(axis=1)
+    mu, sigma = mean_d.mean(), mean_d.std()
+    return mean_d <= mu + ratio * sigma
+
+
+def test_sor_matches_oracle(rng):
+    pts = rng.normal(size=(300, 3)).astype(np.float32)
+    pts[:10] *= 8.0  # outliers
+    keep = np.asarray(pc.statistical_outlier_removal(pts, nb_neighbors=10,
+                                                     std_ratio=2.0))
+    ref = _sor_oracle(pts, 10, 2.0)
+    assert (keep == ref).mean() > 0.995
+    assert keep[:10].sum() < 5  # most injected outliers rejected
+
+
+def test_radius_outlier_matches_oracle(rng):
+    pts = rng.normal(size=(250, 3)).astype(np.float32)
+    pts[:8] += 20.0
+    r, m = 0.6, 4
+    keep = np.asarray(pc.radius_outlier_removal(pts, r, min_neighbors=m))
+    tree = cKDTree(pts)
+    counts = np.array([len(tree.query_ball_point(p, r)) - 1 for p in pts])
+    np.testing.assert_array_equal(keep, counts >= m)
+
+
+def test_smallest_eigenvector_matches_eigh(rng):
+    M = rng.normal(size=(64, 3, 3))
+    A = (M @ M.transpose(0, 2, 1)).astype(np.float32)  # SPD
+    v = np.asarray(pc.smallest_eigenvector_sym3(A))
+    w, V = np.linalg.eigh(A)
+    ref = V[:, :, 0]  # eigh: ascending order
+    dots = np.abs(np.sum(v * ref, axis=1))
+    np.testing.assert_allclose(dots, 1.0, atol=1e-3)
+
+
+def test_normals_on_plane(rng):
+    # Points on z = 2x - y + 3 → normal ∝ (2, -1, -1)/√6.
+    xy = rng.uniform(-1, 1, size=(200, 2))
+    z = 2 * xy[:, 0] - xy[:, 1] + 3
+    pts = np.column_stack([xy, z]).astype(np.float32)
+    normals, nv = pc.estimate_normals(pts, k=12)
+    assert bool(np.asarray(nv).all())
+    ref = np.array([2.0, -1.0, -1.0]) / np.sqrt(6.0)
+    dots = np.abs(np.asarray(normals) @ ref)
+    np.testing.assert_allclose(dots, 1.0, atol=1e-2)
+
+
+def test_orient_normals_camera_and_outward(rng):
+    pts = rng.normal(size=(50, 3)).astype(np.float32) + np.array([0, 0, 5.0])
+    normals, _ = pc.estimate_normals(pts, k=8)
+    cam = np.zeros(3, np.float32)
+    toward = np.asarray(pc.orient_normals(pts, normals, cam, outward=False))
+    assert np.all(np.sum(toward * (cam - pts), axis=1) >= 0)
+    outward = np.asarray(pc.orient_normals(pts, normals, cam, outward=True))
+    np.testing.assert_allclose(outward, -toward, atol=1e-6)

@@ -1,3 +1,3 @@
 """Jitted compute kernels (the TPU replacement for the reference's NumPy/Open3D)."""
 
-from . import patterns, decode, triangulate, knn, pointcloud  # noqa: F401
+from . import patterns, decode, triangulate, knn, pointcloud, features, registration  # noqa: F401

@@ -1,0 +1,104 @@
+"""FPFH (Fast Point Feature Histograms) — batched, branch-free.
+
+Replaces Open3D's ``compute_fpfh_feature`` (call site
+`server/processing.py:92-94`: radius = 5·voxel, max_nn = 100). The classic
+implementation loops over points and their neighbor lists; here the whole
+cloud is processed as one (N, max_nn) batch:
+
+1. neighborhoods from the tiled-matmul KNN, radius-masked;
+2. the three Darboux-frame angles (α, φ, θ) for every (point, neighbor) pair
+   at once — pure vectorized trig;
+3. SPFH histograms via one-hot scatter-sums (no data-dependent loops);
+4. FPFH = SPFH(p) + mean_k ( SPFH(q_k) / ‖p−q_k‖ ), then each 11-bin
+   sub-histogram L1-normalized to 100 (PCL convention) so descriptors are
+   density-invariant.
+
+33 dims = 3 angles × 11 bins. Rotation-invariant by construction (verified in
+tests/test_registration.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .knn import knn
+
+N_BINS = 11
+FPFH_DIM = 3 * N_BINS
+
+
+def _bin(x: jnp.ndarray, lo: float, hi: float) -> jnp.ndarray:
+    b = jnp.floor((x - lo) / (hi - lo) * N_BINS).astype(jnp.int32)
+    return jnp.clip(b, 0, N_BINS - 1)
+
+
+@functools.partial(jax.jit, static_argnames=("max_nn",))
+def fpfh(
+    points: jnp.ndarray,
+    normals: jnp.ndarray,
+    radius: float,
+    valid: jnp.ndarray | None = None,
+    max_nn: int = 100,
+):
+    """(N, 33) float32 FPFH descriptors (+ (N,) validity).
+
+    ``radius``/``max_nn`` mirror the reference's KDTreeSearchParamHybrid.
+    """
+    n = points.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, dtype=bool)
+    pts = jnp.asarray(points, jnp.float32)
+    nrm = jnp.asarray(normals, jnp.float32)
+
+    d2, idx, nbv = knn(pts, max_nn, points_valid=valid)
+    own = jnp.arange(n, dtype=jnp.int32)[:, None]
+    pair_ok = nbv & (d2 <= radius * radius) & (idx != own)  # (N, K)
+
+    q = pts[idx]                    # (N, K, 3) neighbor positions
+    nt = nrm[idx]                   # (N, K, 3) neighbor normals
+    dvec = q - pts[:, None, :]
+    dist = jnp.sqrt(jnp.maximum(jnp.sum(dvec * dvec, axis=-1), 1e-20))
+    dn = dvec / dist[..., None]
+
+    # Darboux frame at the source point: u = n_s, v = u × d̂, w = u × v.
+    u = jnp.broadcast_to(nrm[:, None, :], dvec.shape)
+    v = jnp.cross(u, dn)
+    v_norm = jnp.linalg.norm(v, axis=-1, keepdims=True)
+    v = v / jnp.where(v_norm > 1e-12, v_norm, 1.0)
+    w = jnp.cross(u, v)
+
+    alpha = jnp.sum(v * nt, axis=-1)                 # ∈ [-1, 1]
+    phi = jnp.sum(u * dn, axis=-1)                   # ∈ [-1, 1]
+    theta = jnp.arctan2(jnp.sum(w * nt, axis=-1),
+                        jnp.sum(u * nt, axis=-1))    # ∈ [-π, π]
+
+    bins = jnp.stack([
+        _bin(alpha, -1.0, 1.0),
+        _bin(phi, -1.0, 1.0),
+        _bin(theta, -jnp.pi, jnp.pi),
+    ], axis=-1)  # (N, K, 3)
+
+    onehot = jax.nn.one_hot(bins, N_BINS, dtype=jnp.float32)  # (N, K, 3, 11)
+    onehot = onehot * pair_ok[..., None, None]
+    spfh = onehot.sum(axis=1).reshape(n, FPFH_DIM)  # (N, 33)
+    # Normalize SPFH per point by its pair count (so the weighted neighbor
+    # sum below doesn't favor dense points).
+    cnt = jnp.maximum(jnp.sum(pair_ok, axis=1), 1)[:, None].astype(jnp.float32)
+    spfh = spfh / cnt
+
+    # FPFH: own SPFH + distance-weighted mean of neighbors' SPFHs.
+    wgt = jnp.where(pair_ok, 1.0 / jnp.maximum(dist, 1e-12), 0.0)  # (N, K)
+    nb_spfh = spfh[idx]  # (N, K, 33)
+    wsum = jnp.maximum(jnp.sum(wgt, axis=1), 1e-12)[:, None]
+    f = spfh + jnp.einsum("nk,nkf->nf", wgt, nb_spfh) / wsum
+
+    # L1-normalize each 11-bin sub-histogram to 100.
+    f3 = f.reshape(n, 3, N_BINS)
+    s = jnp.maximum(jnp.sum(f3, axis=-1, keepdims=True), 1e-12)
+    f = (100.0 * f3 / s).reshape(n, FPFH_DIM)
+
+    feat_valid = valid & (jnp.sum(pair_ok, axis=1) >= 1)
+    return jnp.where(feat_valid[:, None], f, 0.0), feat_valid

@@ -1,4 +1,5 @@
-"""K-nearest-neighbor search on TPU.
+"""K-nearest-neighbor search on TPU (D-dimensional: 3-D geometry and 33-D
+FPFH feature matching share this kernel).
 
 The reference delegates every neighborhood query to Open3D's C++ KDTree
 (`server/processing.py:64,87,154` — SOR, normal estimation, ICP
@@ -27,14 +28,14 @@ import jax.numpy as jnp
 
 
 def pad_points(points: jnp.ndarray, valid: jnp.ndarray | None, multiple: int):
-    """Pad (N,3) points (+ valid mask) to a multiple; padding is invalid."""
-    n = points.shape[0]
+    """Pad (N,D) points (+ valid mask) to a multiple; padding is invalid."""
+    n, dim = points.shape
     if valid is None:
         valid = jnp.ones(n, dtype=bool)
     pad = (-n) % multiple
     if pad:
         points = jnp.concatenate(
-            [points, jnp.zeros((pad, 3), points.dtype)], axis=0
+            [points, jnp.zeros((pad, dim), points.dtype)], axis=0
         )
         valid = jnp.concatenate([valid, jnp.zeros(pad, dtype=bool)], axis=0)
     return points, valid
@@ -42,25 +43,25 @@ def pad_points(points: jnp.ndarray, valid: jnp.ndarray | None, multiple: int):
 
 @functools.partial(jax.jit, static_argnums=(4, 5, 6))
 def _knn_padded(
-    queries: jnp.ndarray,   # (M, 3) float32, M % q_tile == 0
+    queries: jnp.ndarray,   # (M, D) float32, M % q_tile == 0
     q_valid: jnp.ndarray,   # (M,) bool
-    points: jnp.ndarray,    # (N, 3) float32, N % k_tile == 0
+    points: jnp.ndarray,    # (N, D) float32, N % k_tile == 0
     p_valid: jnp.ndarray,   # (N,) bool
     k: int,
     q_tile: int,
     k_tile: int,
 ):
-    M = queries.shape[0]
+    M, dim = queries.shape
     N = points.shape[0]
     n_k_blocks = N // k_tile
-    key_blocks = points.reshape(n_k_blocks, k_tile, 3)
+    key_blocks = points.reshape(n_k_blocks, k_tile, dim)
     key_valid = p_valid.reshape(n_k_blocks, k_tile)
     base_idx = jnp.arange(n_k_blocks, dtype=jnp.int32) * k_tile
 
     p2_blocks = jnp.sum(key_blocks * key_blocks, axis=-1)  # (B, Tk)
 
     def per_query_tile(args):
-        q, qv = args  # (Tq, 3), (Tq,)
+        q, qv = args  # (Tq, D), (Tq,)
         q2 = jnp.sum(q * q, axis=-1, keepdims=True)  # (Tq, 1)
 
         def step(carry, blk):
@@ -91,7 +92,7 @@ def _knn_padded(
         )
         return best_d, best_i
 
-    q_tiles = queries.reshape(M // q_tile, q_tile, 3)
+    q_tiles = queries.reshape(M // q_tile, q_tile, dim)
     qv_tiles = q_valid.reshape(M // q_tile, q_tile)
     # lax.map over query tiles: one (Tq, Tk) block resident at a time.
     best_d, best_i = jax.lax.map(per_query_tile, (q_tiles, qv_tiles))

@@ -1,0 +1,147 @@
+"""Registration stack: Kabsch, FPFH invariance, RANSAC, ICP, info matrix."""
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.ops import (
+    features,
+    pointcloud as pc,
+    registration as reg,
+)
+
+
+def _rand_rigid(rng, max_angle=0.5, max_t=2.0):
+    axis = rng.normal(size=3)
+    axis /= np.linalg.norm(axis)
+    th = rng.uniform(-max_angle, max_angle)
+    K = np.array([[0, -axis[2], axis[1]],
+                  [axis[2], 0, -axis[0]],
+                  [-axis[1], axis[0], 0]])
+    R = np.eye(3) + np.sin(th) * K + (1 - np.cos(th)) * (K @ K)
+    T = np.eye(4, dtype=np.float32)
+    T[:3, :3] = R
+    T[:3, 3] = rng.uniform(-max_t, max_t, 3)
+    return T
+
+
+def _bumpy_cloud(rng, n=400):
+    """A sphere with bumps — enough geometric variety for features/ICP."""
+    u = rng.normal(size=(n, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    r = 1.0 + 0.3 * np.sin(4 * u[:, 0]) * np.cos(3 * u[:, 1])
+    return (u * r[:, None]).astype(np.float32)
+
+
+def test_kabsch_exact_recovery(rng):
+    src = rng.normal(size=(50, 3)).astype(np.float32)
+    T = _rand_rigid(rng)
+    dst = src @ T[:3, :3].T + T[:3, 3]
+    got = np.asarray(reg.kabsch(src, dst))
+    np.testing.assert_allclose(got, T, atol=1e-4)
+
+
+def test_kabsch_weighted_ignores_outliers(rng):
+    src = rng.normal(size=(60, 3)).astype(np.float32)
+    T = _rand_rigid(rng)
+    dst = src @ T[:3, :3].T + T[:3, 3]
+    dst[:5] += 50.0  # corrupted correspondences
+    w = np.ones(60, np.float32)
+    w[:5] = 0.0
+    got = np.asarray(reg.kabsch(src, dst, weights=w))
+    np.testing.assert_allclose(got, T, atol=1e-4)
+
+
+def test_transform_points_roundtrip(rng):
+    pts = rng.normal(size=(20, 3)).astype(np.float32)
+    T = _rand_rigid(rng)
+    back = reg.transform_points(np.linalg.inv(T).astype(np.float32),
+                                np.asarray(reg.transform_points(T, pts)))
+    np.testing.assert_allclose(np.asarray(back), pts, atol=1e-4)
+
+
+def test_fpfh_rotation_invariant(rng):
+    pts = _bumpy_cloud(rng)
+    normals, _ = pc.estimate_normals(pts, k=12)
+    normals = np.asarray(pc.orient_normals(pts, np.asarray(normals),
+                                           np.zeros(3, np.float32),
+                                           outward=True))
+    f0, v0 = features.fpfh(pts, normals, radius=0.8, max_nn=32)
+
+    T = _rand_rigid(rng)
+    R = T[:3, :3]
+    pts_r = (pts @ R.T + T[:3, 3]).astype(np.float32)
+    f1, v1 = features.fpfh(pts_r, (normals @ R.T).astype(np.float32),
+                           radius=0.8, max_nn=32)
+    # Same KNN topology under a rigid motion → near-identical descriptors.
+    diff = np.abs(np.asarray(f0) - np.asarray(f1)).mean()
+    assert diff < 2.0, f"FPFH not rotation invariant: mean |Δ| = {diff}"
+
+
+def test_ransac_recovers_transform(rng):
+    pts = _bumpy_cloud(rng, 300)
+    normals, _ = pc.estimate_normals(pts, k=12)
+    normals = np.asarray(pc.orient_normals(pts, np.asarray(normals),
+                                           np.zeros(3, np.float32),
+                                           outward=True))
+    T = _rand_rigid(rng)
+    dst = (pts @ T[:3, :3].T + T[:3, 3]).astype(np.float32)
+    dst_n = (normals @ T[:3, :3].T).astype(np.float32)
+
+    f_src, _ = features.fpfh(pts, normals, radius=0.8, max_nn=32)
+    f_dst, _ = features.fpfh(dst, dst_n, radius=0.8, max_nn=32)
+    res = reg.ransac_feature_registration(
+        pts, f_src, dst, f_dst, distance_threshold=0.05,
+        num_iterations=2048, batch=256,
+    )
+    moved = np.asarray(reg.transform_points(res.transformation, pts))
+    err = np.linalg.norm(moved - dst, axis=1)
+    assert np.median(err) < 0.05, f"median err {np.median(err)}"
+    assert float(res.fitness) > 0.8
+
+
+def test_icp_point_to_point_converges(rng):
+    pts = _bumpy_cloud(rng, 500)
+    T = _rand_rigid(rng, max_angle=0.2, max_t=0.1)
+    dst = (pts @ T[:3, :3].T + T[:3, 3]).astype(np.float32)
+    res = reg.icp(pts, dst, 0.5, method="point_to_point", max_iterations=30)
+    moved = np.asarray(reg.transform_points(res.transformation, pts))
+    assert np.median(np.linalg.norm(moved - dst, axis=1)) < 1e-3
+    assert float(res.fitness) > 0.99
+
+
+def test_icp_point_to_plane_converges(rng):
+    pts = _bumpy_cloud(rng, 500)
+    nrm, _ = pc.estimate_normals(pts, k=12)
+    T = _rand_rigid(rng, max_angle=0.2, max_t=0.1)
+    R = T[:3, :3]
+    dst = (pts @ R.T + T[:3, 3]).astype(np.float32)
+    dst_n = (np.asarray(nrm) @ R.T).astype(np.float32)
+    res = reg.icp(pts, dst, 0.5, dst_normals=dst_n,
+                  method="point_to_plane", max_iterations=30)
+    moved = np.asarray(reg.transform_points(res.transformation, pts))
+    assert np.median(np.linalg.norm(moved - dst, axis=1)) < 1e-3
+
+
+def test_icp_respects_validity(rng):
+    pts = _bumpy_cloud(rng, 300)
+    T = _rand_rigid(rng, max_angle=0.1, max_t=0.05)
+    dst = (pts @ T[:3, :3].T + T[:3, 3]).astype(np.float32)
+    # Corrupt HALF the source; mask it off — ICP should still converge.
+    src = pts.copy()
+    src[150:] += 30.0
+    sv = np.zeros(300, bool)
+    sv[:150] = True
+    res = reg.icp(src, dst, 0.5, method="point_to_point",
+                  src_valid=sv, max_iterations=30)
+    moved = np.asarray(reg.transform_points(res.transformation, src[:150]))
+    assert np.median(np.linalg.norm(moved - dst[:150], axis=1)) < 1e-3
+
+
+def test_information_matrix_properties(rng):
+    pts = _bumpy_cloud(rng, 200)
+    info = np.asarray(reg.information_matrix(pts, pts, np.eye(4, dtype=np.float32), 0.1))
+    assert info.shape == (6, 6)
+    np.testing.assert_allclose(info, info.T, atol=1e-2)
+    w = np.linalg.eigvalsh(info)
+    assert w.min() > -1e-3  # PSD
+    # Translation block = N·I for identity-matched clouds.
+    np.testing.assert_allclose(info[3:, 3:], 200 * np.eye(3), atol=1e-2)

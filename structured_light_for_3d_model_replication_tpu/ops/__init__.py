@@ -1,3 +1,14 @@
 """Jitted compute kernels (the TPU replacement for the reference's NumPy/Open3D)."""
 
-from . import patterns, decode, triangulate, knn, pointcloud, features, registration  # noqa: F401
+from . import (  # noqa: F401
+    cluster,
+    decode,
+    features,
+    knn,
+    patterns,
+    pointcloud,
+    posegraph,
+    registration,
+    segmentation,
+    triangulate,
+)

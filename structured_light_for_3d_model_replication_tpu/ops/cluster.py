@@ -1,0 +1,114 @@
+"""DBSCAN clustering — keep-largest-cluster cleanup.
+
+Replaces Open3D ``cluster_dbscan`` as used by the reference's outlier lab
+(`Old/StatisticalOutlierRemoval.py:5-32`: eps=5, min_points=200, then keep
+the biggest cluster and call everything else noise).
+
+DBSCAN's textbook formulation is a frontier BFS — hostile to a vector
+machine. The TPU formulation here is iterative min-label propagation on the
+ε-neighborhood graph:
+
+1. ε-neighborhoods from the tiled-matmul KNN (capped at ``max_nn`` edges per
+   point — exact for clouds whose local density stays under the cap; the cap
+   only ever SPLITS a cluster, never merges two);
+2. core points = ≥ min_points neighbors (self included, DBSCAN convention);
+3. every core point starts labeled with its own index; each sweep takes the
+   min label over {self} ∪ core neighbors — labels flow only THROUGH core
+   points, exactly DBSCAN's density-connectivity. Edges are propagated both
+   directions (scatter-min over the directed KNN edge list and its reverse),
+   so the truncated KNN lists still behave as an undirected graph;
+4. border points adopt the min label among their core neighbors at the end;
+   everything else is noise (−1). ``lax.while_loop`` runs sweeps until the
+   labels reach a fixed point (≤ graph diameter iterations).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .knn import knn
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "max_nn"))
+def dbscan(
+    points: jnp.ndarray,
+    eps: float,
+    min_points: int = 200,
+    valid: jnp.ndarray | None = None,
+    max_nn: int = 64,
+):
+    """Returns (labels (N,) int32, n_clusters). Noise/invalid → −1.
+
+    Labels are compacted to 0..n_clusters−1 in first-seen (min-index) order.
+    """
+    n = points.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, dtype=bool)
+    pts = jnp.asarray(points, jnp.float32)
+
+    d2, idx, nbv = knn(pts, max_nn, points_valid=valid)
+    in_eps = nbv & (d2 <= eps * eps)            # (N, K), self included
+    n_nbrs = jnp.sum(in_eps, axis=1)
+    core = valid & (n_nbrs >= min_points)
+
+    big = jnp.int32(n)  # "no label yet" sentinel (> any real index)
+    init = jnp.where(core, jnp.arange(n, dtype=jnp.int32), big)
+
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                            idx.shape)
+    edge_ok = in_eps & core[rows] & core[idx]   # core–core edges only
+
+    def sweep(labels):
+        # forward: row takes min of its listed core neighbors' labels
+        nb_lab = jnp.where(edge_ok, labels[idx], big)
+        fwd = jnp.minimum(labels, jnp.min(nb_lab, axis=1))
+        # reverse: scatter each row's label to its listed neighbors
+        src_lab = jnp.where(edge_ok, fwd[rows], big)
+        rev = jnp.full(n, big, jnp.int32).at[idx.reshape(-1)].min(
+            src_lab.reshape(-1))
+        return jnp.where(core, jnp.minimum(fwd, rev), big)
+
+    def cond(state):
+        labels, prev_changed = state
+        return prev_changed
+
+    def body(state):
+        labels, _ = state
+        new = sweep(labels)
+        return new, jnp.any(new != labels)
+
+    labels, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True)))
+
+    # Border points: min label among core ε-neighbors.
+    nb_core_lab = jnp.where(in_eps & core[idx], labels[idx], big)
+    border_lab = jnp.min(nb_core_lab, axis=1)
+    full = jnp.where(core, labels,
+                     jnp.where(valid & (border_lab < big), border_lab, big))
+
+    # Compact root indices to 0..C-1 (roots are label==own-index core pts).
+    is_root = core & (labels == jnp.arange(n, dtype=jnp.int32))
+    compact = jnp.cumsum(is_root.astype(jnp.int32)) - 1  # root rank at root
+    out = jnp.where(full < big, compact[jnp.clip(full, 0, n - 1)], -1)
+    return out.astype(jnp.int32), jnp.sum(is_root.astype(jnp.int32))
+
+
+def keep_largest_cluster(points, eps, min_points=200, valid=None,
+                         max_nn: int = 64):
+    """The reference's cleanup recipe (`Old/StatisticalOutlierRemoval.py:
+    5-32`): cluster, then keep only the most populous cluster. Returns the
+    surviving mask (all-noise clouds keep everything, like the reference's
+    early-return)."""
+    n = points.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, dtype=bool)
+    labels, n_clusters = dbscan(points, eps, min_points, valid, max_nn)
+    counts = jax.ops.segment_sum(
+        (labels >= 0).astype(jnp.int32), jnp.clip(labels, 0, n - 1),
+        num_segments=n,
+    )
+    biggest = jnp.argmax(counts)
+    keep = valid & (labels == biggest)
+    return jnp.where(n_clusters > 0, keep, valid)

@@ -1,0 +1,169 @@
+"""Pose-graph optimization (the loop-closure 360° merge upgrade).
+
+Replaces Open3D's ``PoseGraph`` + ``global_optimization`` (Levenberg-
+Marquardt) as driven by the reference's legacy merge
+(`Old/360Merge.py:43-84`, `Old/new360Merge.py:96-137`): a chain of
+sequential ICP edges plus a first↔last loop-closure edge, each carrying a
+6×6 information matrix, jointly optimized so drift is distributed around the
+loop instead of accumulating (strictly better than the shipped sequential
+merge `server/processing.py:140-167`).
+
+TPU-first formulation: the problem is tiny (N≈24 nodes → 6(N−1) variables),
+so the whole LM iteration is DENSE — residuals for all edges at once,
+the Jacobian by forward-mode autodiff in one ``jax.jacfwd`` call, one
+(6(N−1))² solve per iteration, all inside ``lax.scan``. No sparse graph
+machinery, no host loops.
+
+Conventions (matching the reference's Open3D usage): node pose X_i maps
+frame-i points into the global (node 0) frame; an edge (i, j, T_ij) measures
+``X_i ≈ X_j · T_ij`` (T_ij carries source-i points into frame j, exactly
+what ICP between scan i and scan j returns). Edge residual
+``r = [log_SO3, trans](T_ij⁻¹ · X_j⁻¹ · X_i) ∈ ℝ⁶`` weighted by the edge
+information matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .registration import exp_se3
+
+
+class PoseGraph(NamedTuple):
+    poses: jnp.ndarray       # (N, 4, 4) initial node poses (frame i → global)
+    edge_src: jnp.ndarray    # (E,) int32
+    edge_dst: jnp.ndarray    # (E,) int32
+    edge_T: jnp.ndarray      # (E, 4, 4) measured X_dst⁻¹ X_src
+    edge_info: jnp.ndarray   # (E, 6, 6) information matrices
+
+
+def log_so3(R: jnp.ndarray) -> jnp.ndarray:
+    """Rotation vector of (..., 3, 3); safe near identity."""
+    tr = jnp.trace(R, axis1=-2, axis2=-1)
+    cos = jnp.clip((tr - 1.0) / 2.0, -1.0, 1.0)
+    th = jnp.arccos(cos)
+    v = jnp.stack([
+        R[..., 2, 1] - R[..., 1, 2],
+        R[..., 0, 2] - R[..., 2, 0],
+        R[..., 1, 0] - R[..., 0, 1],
+    ], axis=-1)
+    s = jnp.sin(th)
+    # th/(2 sin th) → 1/2 as th → 0.
+    scale = jnp.where(th[..., None] > 1e-6,
+                      th[..., None] / (2.0 * jnp.where(jnp.abs(s) > 1e-12, s, 1.0)[..., None]),
+                      0.5)
+    return v * scale
+
+
+def chain_poses(edge_T_seq: jnp.ndarray) -> jnp.ndarray:
+    """Initial odometry poses from sequential edge measurements.
+
+    edge_T_seq[i] = T_{i+1, i}? No — pass T such that X_{i+1} = X_i · T_i
+    (i.e. T_i maps frame-(i+1) points into frame i, the ICP result of
+    aligning scan i+1 onto scan i, as the reference accumulates at
+    `server/processing.py:162`). Returns (N, 4, 4) with X_0 = I.
+    """
+    n = edge_T_seq.shape[0] + 1
+
+    def step(X, T):
+        Xn = X @ T
+        return Xn, Xn
+
+    _, rest = jax.lax.scan(step, jnp.eye(4, dtype=edge_T_seq.dtype),
+                           edge_T_seq)
+    return jnp.concatenate([jnp.eye(4, dtype=edge_T_seq.dtype)[None], rest],
+                           axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("iterations",))
+def optimize(
+    graph: PoseGraph,
+    iterations: int = 30,
+    damping: float = 1e-6,
+) -> jnp.ndarray:
+    """Levenberg-Marquardt over node poses (node 0 held fixed).
+
+    Returns optimized (N, 4, 4) poses. Damping is adapted multiplicatively:
+    a step that reduces the weighted cost is accepted and λ shrinks ×0.5,
+    otherwise the step is rejected and λ grows ×4 (classic LM schedule,
+    branch-free via jnp.where).
+    """
+    n = graph.poses.shape[0]
+    nv = 6 * (n - 1)
+    poses0 = graph.poses.astype(jnp.float32)
+    Tinv = jnp.linalg.inv(graph.edge_T.astype(jnp.float32))
+    info = graph.edge_info.astype(jnp.float32)
+
+    def apply_delta(poses, xi):
+        """Right-perturb every pose except node 0."""
+        xi_full = jnp.concatenate([jnp.zeros((1, 6), xi.dtype),
+                                   xi.reshape(n - 1, 6)], axis=0)
+        deltas = jax.vmap(lambda v: exp_se3(v[:3], v[3:]))(xi_full)
+        return jnp.einsum("nij,njk->nik", poses, deltas)
+
+    def residuals(xi, poses):
+        P = apply_delta(poses, xi)
+        Xi = P[graph.edge_src]
+        Xj_inv = jnp.linalg.inv(P[graph.edge_dst])
+        E = jnp.einsum("eij,ejk,ekl->eil", Tinv, Xj_inv, Xi)
+        r_rot = log_so3(E[:, :3, :3])
+        r_t = E[:, :3, 3]
+        return jnp.concatenate([r_rot, r_t], axis=-1)  # (E, 6)
+
+    def cost_of(r):
+        return jnp.sum(jnp.einsum("ei,eij,ej->e", r, info, r))
+
+    def step(carry, _):
+        poses, lam = carry
+        zero = jnp.zeros(nv, jnp.float32)
+        r = residuals(zero, poses)                       # (E, 6)
+        J = jax.jacfwd(lambda x: residuals(x, poses))(zero)  # (E, 6, nv)
+        # H = Σ_e J_eᵀ Λ_e J_e ; g = Σ_e J_eᵀ Λ_e r_e
+        JL = jnp.einsum("eij,eik->ejk", info, J)         # (E, 6, nv)… Λᵀ=Λ
+        H = jnp.einsum("eiv,eiw->vw", J, JL)
+        g = jnp.einsum("eiv,eij,ej->v", J, info, r)
+        delta = -jnp.linalg.solve(
+            H + lam * jnp.eye(nv, dtype=H.dtype), g
+        )
+        new_poses = apply_delta(poses, delta)
+        c0 = cost_of(r)
+        c1 = cost_of(residuals(zero, new_poses))
+        better = c1 < c0
+        poses = jnp.where(better, new_poses, poses)
+        lam = jnp.where(better, lam * 0.5, lam * 4.0)
+        return (poses, lam), c0
+
+    (poses, _), _ = jax.lax.scan(step, (poses0, jnp.float32(damping)),
+                                 None, length=iterations)
+    return poses
+
+
+def build_360_graph(
+    seq_T: jnp.ndarray,
+    seq_info: jnp.ndarray,
+    loop_T: jnp.ndarray | None = None,
+    loop_info: jnp.ndarray | None = None,
+) -> PoseGraph:
+    """Graph for an N-stop turntable ring: sequential edges i+1→i (ICP of
+    scan i+1 onto scan i) plus the optional loop-closure edge 0→N-1
+    (`Old/360Merge.py:53-56`: "sequential scans ... AND the loop closure").
+
+    seq_T[i] maps frame-(i+1) points into frame i; loop_T maps frame-0
+    points into frame N-1 (ICP of scan 0 onto the last scan).
+    """
+    n = seq_T.shape[0] + 1
+    poses = chain_poses(seq_T)
+    src = jnp.arange(1, n, dtype=jnp.int32)
+    dst = jnp.arange(0, n - 1, dtype=jnp.int32)
+    edge_T = seq_T
+    info = seq_info
+    if loop_T is not None:
+        src = jnp.concatenate([src, jnp.array([0], jnp.int32)])
+        dst = jnp.concatenate([dst, jnp.array([n - 1], jnp.int32)])
+        edge_T = jnp.concatenate([edge_T, loop_T[None]], axis=0)
+        info = jnp.concatenate([info, loop_info[None]], axis=0)
+    return PoseGraph(poses, src, dst, edge_T, info)

@@ -1,0 +1,91 @@
+"""Plane segmentation (RANSAC) — background/wall removal.
+
+Replaces Open3D ``segment_plane`` as used for background removal
+(`server/processing.py:37-39`: distance_threshold, ransac_n=3,
+num_iterations; `Old/blackground_remove.py:10-16`): find the dominant plane,
+then DROP its inliers to keep the scanned object.
+
+All hypotheses are vmapped: sample 3 points per hypothesis, get the plane
+from one cross product, score every point densely, argmax — no sequential
+trial loop, no early exit (finishing the batch is cheaper on TPU than a
+data-dependent branch). A least-squares refit on the winning inlier set
+polishes the model like Open3D does.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pointcloud import smallest_eigenvector_sym3
+
+
+@functools.partial(jax.jit, static_argnames=("num_iterations",))
+def segment_plane(
+    points: jnp.ndarray,
+    distance_threshold: float = 10.0,
+    num_iterations: int = 1000,
+    valid: jnp.ndarray | None = None,
+    key=None,
+):
+    """Returns (plane (4,) [a,b,c,d] with ‖n‖=1, inlier_mask (N,)).
+
+    ``remove_background`` keeps ~inlier_mask (`server/processing.py:42`).
+    """
+    n = points.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, dtype=bool)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    pts = jnp.asarray(points, jnp.float32)
+    vf = valid.astype(jnp.float32)
+
+    def hypothesis(k):
+        i = jax.random.randint(k, (3,), 0, n)
+        p0, p1, p2 = pts[i[0]], pts[i[1]], pts[i[2]]
+        nrm = jnp.cross(p1 - p0, p2 - p0)
+        ln = jnp.linalg.norm(nrm)
+        ok = (ln > 1e-12) & jnp.all(valid[i])
+        nrm = nrm / jnp.where(ln > 1e-12, ln, 1.0)
+        d = -jnp.dot(nrm, p0)
+        dist = jnp.abs(pts @ nrm + d)
+        cnt = jnp.sum((dist <= distance_threshold) * vf)
+        return jnp.concatenate([nrm, d[None]]), jnp.where(ok, cnt, -1.0)
+
+    # Hypotheses in vmapped batches under a scan: one (batch, N) distance
+    # block resident at a time, best-so-far carried through.
+    batch = min(256, num_iterations)
+    n_batches = max(1, num_iterations // batch)
+
+    def batch_step(carry, k):
+        best_plane, best_cnt = carry
+        planes, cnts = jax.vmap(hypothesis)(jax.random.split(k, batch))
+        i = jnp.argmax(cnts)
+        better = cnts[i] > best_cnt
+        return (jnp.where(better, planes[i], best_plane),
+                jnp.where(better, cnts[i], best_cnt)), None
+
+    init = (jnp.array([0.0, 0.0, 1.0, 0.0], jnp.float32), jnp.float32(-1))
+    (best, _), _ = jax.lax.scan(batch_step, init,
+                                jax.random.split(key, n_batches))
+
+    inl = (jnp.abs(pts @ best[:3] + best[3]) <= distance_threshold) & valid
+
+    # Least-squares refit on the inliers: plane normal = smallest principal
+    # direction of the inlier scatter (same polish Open3D applies).
+    w = inl.astype(jnp.float32)[:, None]
+    cnt = jnp.maximum(jnp.sum(w), 1.0)
+    mu = jnp.sum(pts * w, axis=0) / cnt
+    xc = (pts - mu) * w
+    C = jnp.einsum("ni,nj->ij", xc, xc,
+                   precision=jax.lax.Precision.HIGHEST) / cnt
+    nrm = smallest_eigenvector_sym3(C)
+    d = -jnp.dot(nrm, mu)
+    refit = jnp.concatenate([nrm, d[None]])
+    refit_inl = (jnp.abs(pts @ nrm + d) <= distance_threshold) & valid
+    use_refit = jnp.sum(refit_inl) >= jnp.sum(inl)
+    plane = jnp.where(use_refit, refit, best)
+    inliers = jnp.where(use_refit, refit_inl, inl)
+    return plane, inliers

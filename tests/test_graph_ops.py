@@ -1,0 +1,141 @@
+"""Pose-graph LM, plane segmentation, DBSCAN vs small NumPy oracles."""
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.ops import (
+    cluster,
+    posegraph,
+    registration as reg,
+    segmentation,
+)
+
+
+def _rot_y(deg):
+    th = np.deg2rad(deg)
+    return np.array([[np.cos(th), 0, np.sin(th)],
+                     [0, 1, 0],
+                     [-np.sin(th), 0, np.cos(th)]])
+
+
+def _se3(R, t):
+    T = np.eye(4, dtype=np.float32)
+    T[:3, :3] = R
+    T[:3, 3] = t
+    return T
+
+
+def test_posegraph_closes_the_loop(rng):
+    """12 stops × 30°: noisy sequential edges drift; the loop-closure edge
+    plus LM must pull the accumulated error back down (Old/360Merge.py)."""
+    n = 12
+    true_T = [_se3(_rot_y(30.0), np.array([0.1, 0, 0.05])) for _ in range(n - 1)]
+
+    def noisy(T):
+        w = rng.normal(scale=0.01, size=3).astype(np.float32)
+        t = rng.normal(scale=0.01, size=3).astype(np.float32)
+        return np.asarray(reg.exp_se3(w, t), np.float32) @ T
+
+    seq = np.stack([noisy(T) for T in true_T]).astype(np.float32)
+
+    # True global poses and the true loop edge X_{n-1}⁻¹ X_0.
+    X = [np.eye(4)]
+    for T in true_T:
+        X.append(X[-1] @ T)
+    loop = (np.linalg.inv(X[-1]) @ X[0]).astype(np.float32)
+
+    info = np.stack([np.eye(6, dtype=np.float32) * 100] * (n - 1))
+    g = posegraph.build_360_graph(seq, info, loop_T=loop,
+                                  loop_info=np.eye(6, dtype=np.float32) * 100)
+    opt = np.asarray(posegraph.optimize(g, iterations=40))
+
+    def pose_err(P):
+        errs = []
+        for i in range(n):
+            E = np.linalg.inv(P[i]) @ X[i]
+            errs.append(np.linalg.norm(E[:3, 3]))
+        return np.max(errs)
+
+    drift_before = pose_err(np.asarray(g.poses))
+    drift_after = pose_err(opt)
+    assert drift_after < drift_before * 0.7, (drift_before, drift_after)
+    # Loop must actually close: residual of the loop edge near zero.
+    E = np.linalg.inv(loop) @ np.linalg.inv(opt[n - 1]) @ opt[0]
+    assert np.linalg.norm(E[:3, 3]) < 0.05
+
+
+def test_chain_poses():
+    T = _se3(_rot_y(30.0), np.array([1.0, 0, 0])).astype(np.float32)
+    poses = np.asarray(posegraph.chain_poses(np.stack([T, T])))
+    np.testing.assert_allclose(poses[0], np.eye(4), atol=1e-6)
+    np.testing.assert_allclose(poses[2], T @ T, atol=1e-5)
+
+
+def test_segment_plane_finds_wall(rng):
+    wall = rng.uniform(-50, 50, size=(800, 2))
+    wall3 = np.column_stack([wall[:, 0], wall[:, 1],
+                             np.full(800, 70.0) + rng.normal(scale=0.3, size=800)])
+    obj = rng.normal(size=(200, 3)) * 5 + np.array([0, 0, 40.0])
+    pts = np.vstack([wall3, obj]).astype(np.float32)
+
+    plane, inl = segmentation.segment_plane(pts, distance_threshold=2.0,
+                                            num_iterations=256)
+    inl = np.asarray(inl)
+    assert inl[:800].mean() > 0.98      # wall captured
+    assert inl[800:].mean() < 0.05      # object kept
+    nrm = np.asarray(plane[:3])
+    assert abs(nrm[2]) > 0.99           # wall normal ≈ ±z
+
+
+def _dbscan_oracle(pts, eps, min_pts):
+    from scipy.spatial import cKDTree
+    tree = cKDTree(pts)
+    nbrs = [tree.query_ball_point(p, eps) for p in pts]
+    core = np.array([len(nb) >= min_pts for nb in nbrs])
+    labels = np.full(len(pts), -1)
+    cid = 0
+    for i in range(len(pts)):
+        if not core[i] or labels[i] != -1:
+            continue
+        frontier = [i]
+        labels[i] = cid
+        while frontier:
+            j = frontier.pop()
+            if not core[j]:
+                continue
+            for k in nbrs[j]:
+                if labels[k] == -1:
+                    labels[k] = cid
+                    frontier.append(k)
+        cid += 1
+    return labels, cid
+
+
+def test_dbscan_matches_oracle(rng):
+    blobs = [rng.normal(size=(80, 3)) * 0.3 + c
+             for c in [np.zeros(3), np.array([5.0, 0, 0]), np.array([0, 6.0, 0])]]
+    noise = rng.uniform(-10, 10, size=(20, 3))
+    pts = np.vstack(blobs + [noise]).astype(np.float32)
+
+    labels, n_clusters = cluster.dbscan(pts, eps=1.0, min_points=8, max_nn=96)
+    labels = np.asarray(labels)
+    ref_labels, ref_n = _dbscan_oracle(pts, 1.0, 8)
+    assert int(n_clusters) == ref_n
+    # Same partition (labels may be permuted): compare co-membership on a
+    # sample of pairs.
+    idx = rng.integers(0, len(pts), size=(400, 2))
+    same_got = labels[idx[:, 0]] == labels[idx[:, 1]]
+    same_ref = ref_labels[idx[:, 0]] == ref_labels[idx[:, 1]]
+    noise_agree = (labels == -1) == (ref_labels == -1)
+    assert noise_agree.mean() > 0.97
+    both_clustered = (labels[idx[:, 0]] >= 0) & (ref_labels[idx[:, 0]] >= 0)
+    assert (same_got == same_ref)[both_clustered].mean() > 0.97
+
+
+def test_keep_largest_cluster(rng):
+    big = rng.normal(size=(150, 3)) * 0.3
+    small = rng.normal(size=(40, 3)) * 0.3 + np.array([8.0, 0, 0])
+    pts = np.vstack([big, small]).astype(np.float32)
+    keep = np.asarray(cluster.keep_largest_cluster(pts, eps=1.0, min_points=5,
+                                                   max_nn=64))
+    assert keep[:150].mean() > 0.95
+    assert keep[150:].mean() < 0.05

@@ -32,6 +32,12 @@ import jax.numpy as jnp
 
 from .registration import exp_se3
 
+# 4×4 pose chains/products are numerically load-bearing; TPU default matmul
+# precision (bf16 inputs) visibly corrupts accumulated rotations. The
+# default_matmul_precision context also covers linalg.inv/solve, whose
+# LU/triangular kernels are matmul-backed on TPU.
+_hi_precision = functools.partial(jax.default_matmul_precision, "highest")
+
 
 class PoseGraph(NamedTuple):
     poses: jnp.ndarray       # (N, 4, 4) initial node poses (frame i → global)
@@ -67,14 +73,13 @@ def chain_poses(edge_T_seq: jnp.ndarray) -> jnp.ndarray:
     aligning scan i+1 onto scan i, as the reference accumulates at
     `server/processing.py:162`). Returns (N, 4, 4) with X_0 = I.
     """
-    n = edge_T_seq.shape[0] + 1
-
     def step(X, T):
         Xn = X @ T
         return Xn, Xn
 
-    _, rest = jax.lax.scan(step, jnp.eye(4, dtype=edge_T_seq.dtype),
-                           edge_T_seq)
+    with _hi_precision():
+        _, rest = jax.lax.scan(step, jnp.eye(4, dtype=edge_T_seq.dtype),
+                               edge_T_seq)
     return jnp.concatenate([jnp.eye(4, dtype=edge_T_seq.dtype)[None], rest],
                            axis=0)
 
@@ -94,52 +99,53 @@ def optimize(
     """
     n = graph.poses.shape[0]
     nv = 6 * (n - 1)
-    poses0 = graph.poses.astype(jnp.float32)
-    Tinv = jnp.linalg.inv(graph.edge_T.astype(jnp.float32))
-    info = graph.edge_info.astype(jnp.float32)
+    with _hi_precision():
+        poses0 = graph.poses.astype(jnp.float32)
+        Tinv = jnp.linalg.inv(graph.edge_T.astype(jnp.float32))
+        info = graph.edge_info.astype(jnp.float32)
 
-    def apply_delta(poses, xi):
-        """Right-perturb every pose except node 0."""
-        xi_full = jnp.concatenate([jnp.zeros((1, 6), xi.dtype),
-                                   xi.reshape(n - 1, 6)], axis=0)
-        deltas = jax.vmap(lambda v: exp_se3(v[:3], v[3:]))(xi_full)
-        return jnp.einsum("nij,njk->nik", poses, deltas)
+        def apply_delta(poses, xi):
+            """Right-perturb every pose except node 0."""
+            xi_full = jnp.concatenate([jnp.zeros((1, 6), xi.dtype),
+                                       xi.reshape(n - 1, 6)], axis=0)
+            deltas = jax.vmap(lambda v: exp_se3(v[:3], v[3:]))(xi_full)
+            return jnp.einsum("nij,njk->nik", poses, deltas)
 
-    def residuals(xi, poses):
-        P = apply_delta(poses, xi)
-        Xi = P[graph.edge_src]
-        Xj_inv = jnp.linalg.inv(P[graph.edge_dst])
-        E = jnp.einsum("eij,ejk,ekl->eil", Tinv, Xj_inv, Xi)
-        r_rot = log_so3(E[:, :3, :3])
-        r_t = E[:, :3, 3]
-        return jnp.concatenate([r_rot, r_t], axis=-1)  # (E, 6)
+        def residuals(xi, poses):
+            P = apply_delta(poses, xi)
+            Xi = P[graph.edge_src]
+            Xj_inv = jnp.linalg.inv(P[graph.edge_dst])
+            E = jnp.einsum("eij,ejk,ekl->eil", Tinv, Xj_inv, Xi)
+            r_rot = log_so3(E[:, :3, :3])
+            r_t = E[:, :3, 3]
+            return jnp.concatenate([r_rot, r_t], axis=-1)  # (E, 6)
 
-    def cost_of(r):
-        return jnp.sum(jnp.einsum("ei,eij,ej->e", r, info, r))
+        def cost_of(r):
+            return jnp.sum(jnp.einsum("ei,eij,ej->e", r, info, r))
 
-    def step(carry, _):
-        poses, lam = carry
-        zero = jnp.zeros(nv, jnp.float32)
-        r = residuals(zero, poses)                       # (E, 6)
-        J = jax.jacfwd(lambda x: residuals(x, poses))(zero)  # (E, 6, nv)
-        # H = Σ_e J_eᵀ Λ_e J_e ; g = Σ_e J_eᵀ Λ_e r_e
-        JL = jnp.einsum("eij,eik->ejk", info, J)         # (E, 6, nv)… Λᵀ=Λ
-        H = jnp.einsum("eiv,eiw->vw", J, JL)
-        g = jnp.einsum("eiv,eij,ej->v", J, info, r)
-        delta = -jnp.linalg.solve(
-            H + lam * jnp.eye(nv, dtype=H.dtype), g
-        )
-        new_poses = apply_delta(poses, delta)
-        c0 = cost_of(r)
-        c1 = cost_of(residuals(zero, new_poses))
-        better = c1 < c0
-        poses = jnp.where(better, new_poses, poses)
-        lam = jnp.where(better, lam * 0.5, lam * 4.0)
-        return (poses, lam), c0
+        def step(carry, _):
+            poses, lam = carry
+            zero = jnp.zeros(nv, jnp.float32)
+            r = residuals(zero, poses)                       # (E, 6)
+            J = jax.jacfwd(lambda x: residuals(x, poses))(zero)  # (E, 6, nv)
+            # H = Σ_e J_eᵀ Λ_e J_e ; g = Σ_e J_eᵀ Λ_e r_e
+            JL = jnp.einsum("eij,eik->ejk", info, J)         # Λᵀ=Λ
+            H = jnp.einsum("eiv,eiw->vw", J, JL)
+            g = jnp.einsum("eiv,eij,ej->v", J, info, r)
+            delta = -jnp.linalg.solve(
+                H + lam * jnp.eye(nv, dtype=H.dtype), g
+            )
+            new_poses = apply_delta(poses, delta)
+            c0 = cost_of(r)
+            c1 = cost_of(residuals(zero, new_poses))
+            better = c1 < c0
+            poses = jnp.where(better, new_poses, poses)
+            lam = jnp.where(better, lam * 0.5, lam * 4.0)
+            return (poses, lam), c0
 
-    (poses, _), _ = jax.lax.scan(step, (poses0, jnp.float32(damping)),
-                                 None, length=iterations)
-    return poses
+        (poses, _), _ = jax.lax.scan(step, (poses0, jnp.float32(damping)),
+                                     None, length=iterations)
+        return poses
 
 
 def build_360_graph(

@@ -273,6 +273,7 @@ def icp(
         raise ValueError("point_to_plane ICP needs dst_normals")
 
     md2 = max_correspondence_distance**2
+    hi = jax.lax.Precision.HIGHEST
 
     def correspondences(T):
         moved = transform_points(T, src_pts)
@@ -291,12 +292,11 @@ def icp(
             nq = dst_normals[idx]
             r = jnp.sum((moved - q) * nq, axis=-1)          # (N,)
             J = jnp.concatenate([jnp.cross(moved, nq), nq], axis=-1)  # (N,6)
-            hi = jax.lax.Precision.HIGHEST
             A = jnp.einsum("ni,nj->ij", J * w[:, None], J, precision=hi)
             b = -jnp.einsum("ni,n->i", J * w[:, None], r, precision=hi)
             x = jnp.linalg.solve(A + 1e-9 * jnp.eye(6, dtype=A.dtype), b)
             dT = exp_se3(x[:3], x[3:])
-        return dT @ T, None
+        return jnp.matmul(dT, T, precision=hi), None
 
     T, _ = jax.lax.scan(step, init.astype(jnp.float32), None,
                         length=max_iterations)

@@ -16,6 +16,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# The image's sitecustomize force-registers the tunneled TPU backend at
+# interpreter startup (before conftest runs), clobbering JAX_PLATFORMS. The
+# in-process config update wins as long as no backend has initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

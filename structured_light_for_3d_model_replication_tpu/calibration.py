@@ -1,0 +1,231 @@
+"""Camera–projector stereo calibration.
+
+Reimplements the reference's calibration stack (`server/sl_system.py:114-417`):
+
+* corner detection with the same enhancement chain — Gaussian blur + CLAHE
+  before ``findChessboardCorners``, sub-pixel refinement on the raw gray
+  (`server/sl_system.py:229-240`),
+* Gray-decode of the projector coordinate at each detected corner — the
+  reference XOR-accumulates per-bit at 49 corner pixels in Python
+  (`:257-288`); here the WHOLE stack is decoded in one jitted TPU kernel
+  (`ops.decode.decode_stack`) and sampled at the corner pixels, identical
+  values by construction (same int truncation of the sub-pixel coordinate),
+* quick per-pose reprojection errors for pose culling (`:307-327`),
+* final stereo calibration: ``calibrateCamera`` x2 then ``stereoCalibrate``
+  with ``CALIB_FIX_INTRINSIC`` (`:335-343`).
+
+Bundle-adjusted intrinsics over a handful of 49-corner poses are host-side
+LM solves — CPU work in any design (SURVEY.md §2d keeps the OpenCV oracle
+path). Everything downstream of (K, R, T) — the ray grid and the 3000 light
+planes — is the vmapped JAX precompute in `ops.triangulate.make_calibration`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from .config import CheckerboardConfig, ProjectorConfig
+from .io import layout as _layout
+from .io.images import list_frames, load_stack
+from .io.matcal import save_calibration_mat
+from .ops.decode import decode_bits, split_stack
+from .ops.triangulate import Calibration, make_calibration
+
+
+@dataclasses.dataclass
+class StereoResult:
+    cam_K: np.ndarray
+    cam_dist: np.ndarray
+    proj_K: np.ndarray
+    proj_dist: np.ndarray
+    R: np.ndarray
+    T: np.ndarray
+    rms: float
+
+
+def object_points(board: CheckerboardConfig) -> np.ndarray:
+    """Planar board corner coordinates in mm (`server/sl_system.py:206-209`)."""
+    objp = np.zeros((board.rows * board.cols, 3), np.float32)
+    objp[:, :2] = np.mgrid[0:board.rows, 0:board.cols].T.reshape(-1, 2)
+    return objp * board.square_mm
+
+
+def detect_chessboard(img_gray: np.ndarray, board: CheckerboardConfig):
+    """(found, corners (N,1,2) float32) with the reference's enhancement chain
+    (`server/sl_system.py:229-240`): blur+CLAHE for detection, sub-pixel
+    refinement against the raw gray image."""
+    import cv2
+
+    blurred = cv2.GaussianBlur(img_gray, (5, 5), 0)
+    clahe = cv2.createCLAHE(clipLimit=2.0, tileGridSize=(8, 8))
+    enhanced = clahe.apply(blurred)
+    found, corners = cv2.findChessboardCorners(
+        enhanced, (board.rows, board.cols), None)
+    if not found:
+        return False, None
+    # The reference refines with a fixed (11, 11) half-window
+    # (`server/sl_system.py:240`), sized for full-res captures. A window
+    # wider than half the square spacing makes cornerSubPix stray to the
+    # neighboring corners, so cap it by the observed corner pitch.
+    pts = np.asarray(corners, np.float32).reshape(-1, 2)
+    pitch = np.linalg.norm(np.diff(pts[: board.rows], axis=0), axis=-1).min()
+    win = int(np.clip(0.4 * pitch, 2, 11))
+    corners = cv2.cornerSubPix(
+        img_gray, corners, (win, win), (-1, -1),
+        (cv2.TERM_CRITERIA_EPS + cv2.TERM_CRITERIA_MAX_ITER, 30, 0.001))
+    # OpenCV version drift: normalize to the classic (N, 1, 2) layout.
+    return True, np.asarray(corners, np.float32).reshape(-1, 1, 2)
+
+
+def decode_at_corners(
+    stack: np.ndarray,
+    corners: np.ndarray,
+    proj: ProjectorConfig,
+) -> np.ndarray:
+    """Projector (u, v) at each corner pixel, (N, 2) float32.
+
+    One jitted decode of the full stack, then a gather at the int-truncated
+    corner coordinates — bit-for-bit the reference's per-corner XOR loop
+    (`server/sl_system.py:257-296`, `vp = img_p[y.astype(int), x.astype(int)]`).
+    """
+    import jax.numpy as jnp
+
+    dev = jnp.asarray(stack)
+    _, _, col_pairs, row_pairs = split_stack(dev, proj.col_bits, proj.row_bits)
+    # Same coarse-code -> projector-pixel rescale as decode_stack
+    # (ops/decode.py): stripe index * D + stripe-center offset.
+    d = proj.downsample
+    col_map = np.asarray(decode_bits(col_pairs)) * d + (d - 1) // 2
+    row_map = np.asarray(decode_bits(row_pairs)) * d + (d - 1) // 2
+    x = corners[:, 0, 0].astype(int)
+    y = corners[:, 0, 1].astype(int)
+    return np.stack([col_map[y, x], row_map[y, x]], axis=-1).astype(np.float32)
+
+
+@dataclasses.dataclass
+class CalibData:
+    obj_pts: list          # per pose (N, 3) float32
+    cam_pts: list          # per pose (N, 1, 2) float32
+    proj_pts: list         # per pose (N, 1, 2) float32
+    img_shape: tuple       # (w, h)
+    poses: list            # pose dir names that survived detection
+
+
+def load_calib_data(
+    pose_dirs: list[str],
+    proj: ProjectorConfig = ProjectorConfig(),
+    board: CheckerboardConfig = CheckerboardConfig(),
+) -> CalibData:
+    """Detect + decode every pose folder (`server/sl_system.py:204-305`)."""
+    import cv2
+
+    objp = object_points(board)
+    data = CalibData([], [], [], None, [])
+    for path in pose_dirs:
+        files = list_frames(path)
+        img = cv2.imread(files[0], cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            continue
+        if data.img_shape is None:
+            data.img_shape = (img.shape[1], img.shape[0])
+        found, corners = detect_chessboard(img, board)
+        if not found:
+            continue
+        if len(files) < proj.n_frames:
+            continue
+        stack = load_stack(path, expected_frames=None)[: proj.n_frames]
+        uv = decode_at_corners(stack, corners, proj)
+        data.obj_pts.append(objp)
+        data.cam_pts.append(corners)
+        data.proj_pts.append(uv.reshape(-1, 1, 2))
+        data.poses.append(os.path.basename(path))
+    return data
+
+
+def reprojection_errors(
+    data: CalibData,
+    proj: ProjectorConfig = ProjectorConfig(),
+) -> dict[str, tuple[float, float]]:
+    """Per-pose (camera_err, projector_err) for manual pose culling
+    (`server/sl_system.py:307-327`)."""
+    import cv2
+
+    _, mc, dc, rvc, tvc = cv2.calibrateCamera(
+        data.obj_pts, data.cam_pts, data.img_shape, None, None)
+    _, mp, dp, rvp, tvp = cv2.calibrateCamera(
+        data.obj_pts, data.proj_pts, (proj.width, proj.height), None, None)
+    errors = {}
+    for i, pose in enumerate(data.poses):
+        p2c, _ = cv2.projectPoints(data.obj_pts[i], rvc[i], tvc[i], mc, dc)
+        ec = cv2.norm(data.cam_pts[i], p2c.astype(np.float32),
+                      cv2.NORM_L2) / len(p2c)
+        p2p, _ = cv2.projectPoints(data.obj_pts[i], rvp[i], tvp[i], mp, dp)
+        ep = cv2.norm(data.proj_pts[i].astype(np.float32),
+                      p2p.astype(np.float32), cv2.NORM_L2) / len(p2p)
+        errors[pose] = (float(ec), float(ep))
+    return errors
+
+
+def analyze_calibration(
+    calib_dir: str,
+    proj: ProjectorConfig = ProjectorConfig(),
+    board: CheckerboardConfig = CheckerboardConfig(),
+):
+    """(errors, pose_names) for the pose-selection step
+    (`server/sl_system.py:187-202`; >= 3 poses required)."""
+    pose_dirs = _layout.numeric_sort([
+        os.path.join(calib_dir, d) for d in os.listdir(calib_dir)
+        if os.path.isdir(os.path.join(calib_dir, d))])
+    if len(pose_dirs) < 3:
+        raise ValueError(f"need at least 3 pose folders in {calib_dir}")
+    data = load_calib_data(pose_dirs, proj, board)
+    if len(data.obj_pts) < 3:
+        raise ValueError(
+            f"chessboard detected in only {len(data.obj_pts)} of "
+            f"{len(pose_dirs)} poses; need >= 3")
+    return reprojection_errors(data, proj), data.poses
+
+
+def stereo_calibrate(
+    data: CalibData,
+    proj: ProjectorConfig = ProjectorConfig(),
+) -> StereoResult:
+    """calibrateCamera x2 + stereoCalibrate(FIX_INTRINSIC)
+    (`server/sl_system.py:335-343`). X_p = R X_c + T."""
+    import cv2
+
+    _, mc, dc, _, _ = cv2.calibrateCamera(
+        data.obj_pts, data.cam_pts, data.img_shape, None, None)
+    _, mp, dp, _, _ = cv2.calibrateCamera(
+        data.obj_pts, data.proj_pts, (proj.width, proj.height), None, None)
+    rms, K1, D1, K2, D2, R, T, _, _ = cv2.stereoCalibrate(
+        data.obj_pts, data.cam_pts, data.proj_pts, mc, dc, mp, dp,
+        data.img_shape, flags=cv2.CALIB_FIX_INTRINSIC)
+    return StereoResult(K1, D1, K2, D2, R, T.reshape(3), float(rms))
+
+
+def calibrate_final(
+    pose_dirs: list[str],
+    output_mat: str | None = None,
+    proj: ProjectorConfig = ProjectorConfig(),
+    board: CheckerboardConfig = CheckerboardConfig(),
+) -> tuple[Calibration, StereoResult]:
+    """Full final calibration (`server/sl_system.py:329-417`): stereo solve on
+    the selected poses, then the JAX ray-grid/light-plane precompute, then the
+    reference-layout .mat artifact."""
+    data = load_calib_data(pose_dirs, proj, board)
+    if len(data.obj_pts) < 3:
+        raise ValueError(
+            f"chessboard detected in only {len(data.obj_pts)} poses; need >= 3")
+    stereo = stereo_calibrate(data, proj)
+    w, h = data.img_shape
+    calib = make_calibration(
+        stereo.cam_K, stereo.proj_K, stereo.R, stereo.T, h, w,
+        proj_width=proj.width, proj_height=proj.height)
+    if output_mat:
+        os.makedirs(os.path.dirname(output_mat) or ".", exist_ok=True)
+        save_calibration_mat(output_mat, calib)
+    return calib, stereo

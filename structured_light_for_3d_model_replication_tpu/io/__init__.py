@@ -1,0 +1,21 @@
+"""I/O layer: codecs (PLY/STL/.mat) + frame staging + session layout.
+
+Replaces the reference's L1 persistence (SURVEY.md §1): hand-rolled ASCII PLY
+(`server/sl_system.py:671-691`), Open3D cloud/mesh I/O
+(`server/processing.py:19,49,181,248,310`), scipy .mat calibration container
+(`server/sl_system.py:406-415,493`), and the dated directory layout
+(`server/config.py:10`, `server/gui.py:31-40`).
+"""
+
+from .images import (  # noqa: F401
+    device_stack,
+    list_frames,
+    load_stack,
+    load_white_rgb,
+    numeric_sort,
+    write_frame,
+)
+from .layout import SessionLayout, frame_name, list_clouds  # noqa: F401
+from .matcal import load_calibration_mat, save_calibration_mat  # noqa: F401
+from .ply import PointCloud, read_ply, write_ply  # noqa: F401
+from .stl import TriangleMesh, read_stl, write_stl  # noqa: F401

@@ -1,0 +1,107 @@
+"""Captured-frame I/O: scan folders → device arrays.
+
+The reference re-reads every frame from disk *inside* its decode loop, one
+``cv2.imread`` per bit-plane pass (`server/sl_system.py:549-564`) — 2x44
+full-frame reads interleaved with compute. Here the whole stack is decoded on
+the host once (threaded — JPEG/PNG decode is CPU-bound and releases the GIL)
+and staged to HBM in one ``jax.device_put``, so the TPU kernels see a single
+(F, H, W) array.
+
+Frame-number protocol (reference `server/sl_system.py:133-150`): file
+``{idx:02d}`` with 01=white, 02=black, then (pattern, inverse) pairs for each
+column bit, then each row bit.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import glob
+import os
+import re
+
+import numpy as np
+
+_EXTS = (".bmp", ".png", ".jpg", ".jpeg")
+
+
+def _imread_gray(path: str) -> np.ndarray:
+    import cv2
+
+    img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise IOError(f"failed to read image {path}")
+    return img
+
+
+def _imread_rgb(path: str) -> np.ndarray:
+    import cv2
+
+    img = cv2.imread(path, cv2.IMREAD_COLOR)
+    if img is None:
+        raise IOError(f"failed to read image {path}")
+    return img[..., ::-1].copy()  # BGR -> RGB at the boundary
+
+
+def list_frames(folder: str) -> list[str]:
+    """Sorted frame files; tries each extension like the reference
+    (`multi_point_cloud_process.py` globs .bmp then falls back to .png)."""
+    for ext in _EXTS:
+        files = sorted(glob.glob(os.path.join(folder, f"*{ext}")))
+        if files:
+            return files
+    raise FileNotFoundError(f"no frames ({'/'.join(_EXTS)}) in {folder}")
+
+
+def load_stack(
+    folder: str,
+    expected_frames: int | None = None,
+    workers: int = 8,
+) -> np.ndarray:
+    """(F, H, W) uint8 grayscale stack from a capture folder."""
+    files = list_frames(folder)
+    if expected_frames is not None and len(files) != expected_frames:
+        raise ValueError(
+            f"{folder}: found {len(files)} frames, expected {expected_frames}"
+        )
+    with _fut.ThreadPoolExecutor(max_workers=workers) as ex:
+        frames = list(ex.map(_imread_gray, files))
+    shapes = {f.shape for f in frames}
+    if len(shapes) != 1:
+        raise ValueError(f"{folder}: inconsistent frame shapes {shapes}")
+    return np.stack(frames)
+
+
+def load_white_rgb(folder: str) -> np.ndarray:
+    """(H, W, 3) uint8 RGB texture = frame 01 (the white reference), used for
+    point colors (`server/sl_system.py:646-651`)."""
+    return _imread_rgb(list_frames(folder)[0])
+
+
+def device_stack(folder: str, expected_frames: int | None = None):
+    """Load + one host→HBM transfer. Returns a (F, H, W) uint8 device array."""
+    import jax
+
+    return jax.device_put(load_stack(folder, expected_frames))
+
+
+def write_frame(path: str, img: np.ndarray) -> None:
+    """uint8 (H, W) or (H, W, 3) RGB → file (extension picks the codec)."""
+    import cv2
+
+    if img.ndim == 3:
+        img = img[..., ::-1]  # RGB -> BGR for OpenCV
+    if not cv2.imwrite(path, img):
+        raise IOError(f"failed to write image {path}")
+
+
+_NUM_RE = re.compile(r"(\d+)")
+
+
+def numeric_sort(paths: list[str]) -> list[str]:
+    """Sort by the last integer in the basename, then lexically — the legacy
+    fix for '10.ply' < '2.ply' (`Old/new360Merge.py:7-20`)."""
+    def key(p):
+        nums = _NUM_RE.findall(os.path.basename(p))
+        return (int(nums[-1]) if nums else -1, p)
+
+    return sorted(paths, key=key)

@@ -1,0 +1,90 @@
+"""Calibration container I/O — MATLAB ``.mat`` interop.
+
+The reference persists calibration as a ``.mat`` with keys
+``{Nc, Oc, wPlaneCol, wPlaneRow, cam_K, proj_K, R, T}`` in these exact
+layouts (`server/sl_system.py:406-415`):
+
+* ``Nc``        (3, H*W)  — camera rays, transposed flat grid
+* ``Oc``        (3, 1)
+* ``wPlaneCol`` (4, proj_w) — stored TRANSPOSED (written ``wPlaneCol.T``)
+* ``wPlaneRow`` (4, proj_h) — ditto
+* ``cam_K``/``proj_K`` (3, 3), ``R`` (3, 3), ``T`` (3, 1)
+
+Files written here load in the reference pipeline and vice versa, so an
+existing calibration survives a backend switch (`server/gui.py:543-547` reuses
+the .mat across sessions).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.io
+
+from ..ops.triangulate import Calibration, camera_rays, make_calibration
+
+_KEYS = ("Nc", "Oc", "wPlaneCol", "wPlaneRow", "cam_K", "proj_K", "R", "T")
+
+
+def save_calibration_mat(path: str, calib: Calibration) -> None:
+    """Serialize a device-resident Calibration into the reference layout."""
+    Nc = np.asarray(calib.Nc, np.float64).reshape(-1, 3).T  # (3, H*W)
+    scipy.io.savemat(path, {
+        "Nc": Nc,
+        "Oc": np.asarray(calib.Oc, np.float64).reshape(3, 1),
+        "wPlaneCol": np.asarray(calib.plane_cols, np.float64).T,  # (4, W)
+        "wPlaneRow": np.asarray(calib.plane_rows, np.float64).T,  # (4, H)
+        "cam_K": np.asarray(calib.cam_K, np.float64),
+        "proj_K": np.asarray(calib.proj_K, np.float64),
+        "R": np.asarray(calib.R, np.float64),
+        "T": np.asarray(calib.T, np.float64).reshape(3, 1),
+    })
+
+
+def load_calibration_mat(
+    path: str,
+    cam_height: int,
+    cam_width: int,
+) -> Calibration:
+    """Load a reference-layout ``.mat`` into a device Calibration.
+
+    The stored flat ray grid carries no (H, W); callers pass the capture
+    resolution. If the stored grid size disagrees (the reference hits this
+    when scan resolution differs from calibration resolution), rays are
+    regenerated from ``cam_K`` exactly as the reference does
+    (`server/sl_system.py:605-621`).
+    """
+    data = scipy.io.loadmat(path)
+    missing = [k for k in _KEYS if k not in data]
+    if missing:
+        raise ValueError(f"{path}: calibration file missing keys {missing}")
+
+    cam_K = np.asarray(data["cam_K"], np.float32)
+    proj_K = np.asarray(data["proj_K"], np.float32)
+    R = np.asarray(data["R"], np.float32)
+    T = np.asarray(data["T"], np.float32).reshape(3)
+
+    Nc_flat = np.asarray(data["Nc"], np.float32)  # (3, H*W)
+    if Nc_flat.shape[1] == cam_height * cam_width:
+        Nc = Nc_flat.T.reshape(cam_height, cam_width, 3)
+    else:
+        Nc = np.asarray(camera_rays(cam_K, cam_height, cam_width))
+
+    plane_cols = np.asarray(data["wPlaneCol"], np.float32).T  # (W, 4)
+    plane_rows = np.asarray(data["wPlaneRow"], np.float32).T  # (H, 4)
+
+    base = make_calibration(cam_K, proj_K, R, T, cam_height, cam_width,
+                            proj_width=plane_cols.shape[0],
+                            proj_height=plane_rows.shape[0])
+    # Prefer the planes/rays as stored (they are the calibration artifact);
+    # make_calibration supplies consistent dtypes/devices for the rest.
+    return base._replace(
+        Nc=_as_dev(Nc),
+        plane_cols=_as_dev(plane_cols),
+        plane_rows=_as_dev(plane_rows),
+    )
+
+
+def _as_dev(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x, jnp.float32)

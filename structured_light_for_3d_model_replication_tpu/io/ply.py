@@ -1,0 +1,164 @@
+"""PLY point-cloud codec (reader + writer, ASCII and binary_little_endian).
+
+Replaces two things in the reference:
+
+* the hand-rolled per-point ASCII writer (`server/sl_system.py:671-691`,
+  `multi_point_cloud_process.py:121-133`) — a pure-Python loop over millions of
+  points. Here ASCII goes through one ``np.savetxt``-style vectorized format
+  and binary through a single structured-array ``tofile``, both O(N) C-speed.
+* Open3D's ``o3d.io.read_point_cloud`` / ``write_point_cloud``
+  (`server/processing.py:19,49,181`).
+
+The reference's ASCII layout (x y z at %.4f + uchar red green blue) is the
+default ASCII schema, so files interchange with clouds produced by the
+reference. NOTE the reference swizzles BGR→RGB *at write time* because its
+textures come from OpenCV; this codec stores colors as given (RGB in, RGB out).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_PLY_TO_NP = {
+    "char": "i1", "int8": "i1",
+    "uchar": "u1", "uint8": "u1",
+    "short": "i2", "int16": "i2",
+    "ushort": "u2", "uint16": "u2",
+    "int": "i4", "int32": "i4",
+    "uint": "u4", "uint32": "u4",
+    "float": "f4", "float32": "f4",
+    "double": "f8", "float64": "f8",
+}
+
+
+@dataclasses.dataclass
+class PointCloud:
+    """Host-side cloud container: the framework's analogue of
+    ``o3d.geometry.PointCloud``. Device code operates on the raw arrays."""
+
+    points: np.ndarray                   # (N, 3) float32
+    colors: np.ndarray | None = None     # (N, 3) uint8
+    normals: np.ndarray | None = None    # (N, 3) float32
+
+    def __len__(self) -> int:
+        return int(self.points.shape[0])
+
+
+def _parse_header(f):
+    """Returns (fmt, n_vertex, vertex_props, skip_elements) after end_header."""
+    magic = f.readline().strip()
+    if magic != b"ply":
+        raise ValueError("not a PLY file")
+    fmt = None
+    elements = []  # list of (name, count, [(type, name), ...])
+    cur = None
+    while True:
+        line = f.readline()
+        if not line:
+            raise ValueError("unterminated PLY header")
+        tok = line.strip().split()
+        if not tok or tok[0] == b"comment":
+            continue
+        if tok[0] == b"format":
+            fmt = tok[1].decode()
+        elif tok[0] == b"element":
+            cur = (tok[1].decode(), int(tok[2]), [])
+            elements.append(cur)
+        elif tok[0] == b"property":
+            if tok[1] == b"list":
+                # list property (faces); represented as ('list', t_count, t_item, name)
+                cur[2].append(("list", tok[2].decode(), tok[3].decode(),
+                               tok[4].decode()))
+            else:
+                cur[2].append((tok[1].decode(), tok[2].decode()))
+        elif tok[0] == b"end_header":
+            break
+    return fmt, elements
+
+
+def read_ply(path: str) -> PointCloud:
+    """Read a PLY point cloud (vertex element; faces, if any, are skipped)."""
+    with open(path, "rb") as f:
+        fmt, elements = _parse_header(f)
+        vertex = next((e for e in elements if e[0] == "vertex"), None)
+        if vertex is None:
+            raise ValueError(f"{path}: no vertex element")
+        _, n, props = vertex
+        for p in props:
+            if p[0] == "list":
+                raise ValueError("list property on vertex element unsupported")
+        names = [p[1] for p in props]
+        if fmt == "ascii":
+            # Vertex is the first element in every writer we care about.
+            raw = np.loadtxt(f, dtype=np.float64, max_rows=n, ndmin=2)
+            cols = {nm: raw[:, i] for i, nm in enumerate(names)}
+        elif fmt == "binary_little_endian":
+            dt = np.dtype([(nm, "<" + _PLY_TO_NP[t]) for t, nm in props])
+            raw = np.fromfile(f, dtype=dt, count=n)
+            cols = {nm: raw[nm] for nm in names}
+        else:
+            raise ValueError(f"unsupported PLY format {fmt!r}")
+
+    pts = np.stack([cols["x"], cols["y"], cols["z"]], axis=-1).astype(np.float32)
+    colors = normals = None
+    if all(k in cols for k in ("red", "green", "blue")):
+        colors = np.stack([cols["red"], cols["green"], cols["blue"]],
+                          axis=-1).astype(np.uint8)
+    if all(k in cols for k in ("nx", "ny", "nz")):
+        normals = np.stack([cols["nx"], cols["ny"], cols["nz"]],
+                           axis=-1).astype(np.float32)
+    return PointCloud(pts, colors, normals)
+
+
+def write_ply(
+    path: str,
+    cloud: PointCloud,
+    binary: bool = True,
+) -> None:
+    """Write a point cloud. Binary little-endian by default; ASCII matches the
+    reference's schema (xyz %.4f + uchar rgb) for drop-in interop."""
+    pts = np.asarray(cloud.points, np.float32)
+    n = pts.shape[0]
+    fields = [("x", "<f4"), ("y", "<f4"), ("z", "<f4")]
+    header_props = ["property float x", "property float y", "property float z"]
+    if cloud.normals is not None:
+        fields += [("nx", "<f4"), ("ny", "<f4"), ("nz", "<f4")]
+        header_props += ["property float nx", "property float ny",
+                         "property float nz"]
+    if cloud.colors is not None:
+        fields += [("red", "u1"), ("green", "u1"), ("blue", "u1")]
+        header_props += ["property uchar red", "property uchar green",
+                         "property uchar blue"]
+
+    header = (
+        "ply\n"
+        f"format {'binary_little_endian' if binary else 'ascii'} 1.0\n"
+        f"element vertex {n}\n" + "\n".join(header_props) + "\nend_header\n"
+    )
+
+    with open(path, "wb") as f:
+        f.write(header.encode())
+        if binary:
+            rec = np.empty(n, dtype=np.dtype(fields))
+            rec["x"], rec["y"], rec["z"] = pts[:, 0], pts[:, 1], pts[:, 2]
+            if cloud.normals is not None:
+                nrm = np.asarray(cloud.normals, np.float32)
+                rec["nx"], rec["ny"], rec["nz"] = nrm[:, 0], nrm[:, 1], nrm[:, 2]
+            if cloud.colors is not None:
+                col = np.asarray(cloud.colors, np.uint8)
+                rec["red"], rec["green"], rec["blue"] = (
+                    col[:, 0], col[:, 1], col[:, 2])
+            rec.tofile(f)
+        else:
+            parts = ["%.4f %.4f %.4f"]
+            arrays = [pts]
+            if cloud.normals is not None:
+                parts.append("%.4f %.4f %.4f")
+                arrays.append(np.asarray(cloud.normals, np.float32))
+            if cloud.colors is not None:
+                parts.append("%d %d %d")
+                arrays.append(np.asarray(cloud.colors))
+            full = np.concatenate([a.astype(np.float64) for a in arrays], axis=1)
+            np.savetxt(f, full, fmt=" ".join(parts))

@@ -1,0 +1,134 @@
+"""Calibration layer: synthetic checkerboard poses -> stereo solve -> compare
+against the simulator's ground-truth rig."""
+
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu import calibration, io as slio
+from structured_light_for_3d_model_replication_tpu.config import (
+    CheckerboardConfig,
+    ProjectorConfig,
+)
+from structured_light_for_3d_model_replication_tpu.models import synthetic
+
+cv2 = pytest.importorskip("cv2")
+
+PROJ = ProjectorConfig(width=512, height=256)
+H, W = 240, 320
+BOARD = CheckerboardConfig(cols=7, rows=7, square_mm=35.0)
+
+
+@pytest.fixture(scope="module")
+def calib_session(tmp_path_factory):
+    """Render 6 synthetic poses to disk in the session layout."""
+    root = tmp_path_factory.mktemp("calib_sess")
+    cam_K, proj_K, R, T = synthetic.default_calibration(H, W, PROJ)
+    lay = slio.SessionLayout(str(root)).ensure()
+    from structured_light_for_3d_model_replication_tpu.ops.patterns import (
+        pattern_stack,
+    )
+
+    frames = np.asarray(pattern_stack(PROJ.width, PROJ.height, PROJ.col_bits,
+                                      PROJ.row_bits, PROJ.brightness))
+    gts = []
+    for i, (bR, bt) in enumerate(synthetic.calibration_pose_set(6)):
+        stack, gt = synthetic.render_calibration_pose(
+            bR, bt, cam_K, proj_K, R, T, H, W, PROJ,
+            checker_cols=BOARD.cols, checker_rows=BOARD.rows,
+            square_mm=BOARD.square_mm, pattern_frames=frames)
+        d = lay.pose_dir(i)
+        os.makedirs(d, exist_ok=True)
+        for f in range(stack.shape[0]):
+            slio.write_frame(os.path.join(d, slio.frame_name(f + 1)), stack[f])
+        gts.append(gt)
+    return lay, (cam_K, proj_K, R, T), gts
+
+
+def test_detect_chessboard_matches_gt(calib_session):
+    lay, rig, gts = calib_session
+    img = cv2.imread(os.path.join(lay.pose_dir(0), "01.png"),
+                     cv2.IMREAD_GRAYSCALE)
+    found, corners = calibration.detect_chessboard(img, BOARD)
+    assert found
+    det = corners[:, 0, :]
+    gt = gts[0]["corner_cam_px"]
+    # Unordered match: every ground-truth corner has a detection within 0.5 px.
+    d = np.linalg.norm(det[None, :, :] - gt[:, None, :], axis=-1)
+    assert d.min(axis=1).max() < 0.5
+
+
+def test_decode_at_corners_matches_gt(calib_session):
+    lay, rig, gts = calib_session
+    img = cv2.imread(os.path.join(lay.pose_dir(0), "01.png"),
+                     cv2.IMREAD_GRAYSCALE)
+    found, corners = calibration.detect_chessboard(img, BOARD)
+    stack = slio.load_stack(lay.pose_dir(0))
+    uv = calibration.decode_at_corners(stack, corners, PROJ)
+    det = corners[:, 0, :]
+    gt_cam = gts[0]["corner_cam_px"]
+    gt_proj = gts[0]["corner_proj_px"]
+    # Pair detections to gt corners, then compare decoded proj coords. The
+    # decode is quantized to the stripe index -> tolerance ~1.5 px.
+    d = np.linalg.norm(det[None, :, :] - gt_cam[:, None, :], axis=-1)
+    j = d.argmin(axis=1)
+    err = np.linalg.norm(uv[j] - gt_proj, axis=-1)
+    assert np.median(err) < 1.5
+
+
+def test_analyze_calibration_errors_small(calib_session):
+    lay, rig, gts = calib_session
+    errors, poses = calibration.analyze_calibration(lay.calib_dir, PROJ, BOARD)
+    assert len(poses) == 6
+    for pose, (ec, ep) in errors.items():
+        assert ec < 0.5, f"{pose}: camera reprojection error {ec}"
+        assert ep < 2.0, f"{pose}: projector reprojection error {ep}"
+
+
+def test_calibrate_final_recovers_rig(calib_session):
+    lay, (cam_K, proj_K, R, T), gts = calib_session
+    calib, stereo = calibration.calibrate_final(
+        lay.pose_dirs(), output_mat=lay.calib_mat, proj=PROJ, board=BOARD)
+    # Camera intrinsics within 2%; projector within 5% (its observations are
+    # integer stripe indices, so quantization bounds the solve).
+    assert abs(stereo.cam_K[0, 0] - cam_K[0, 0]) / cam_K[0, 0] < 0.02
+    assert abs(stereo.proj_K[0, 0] - proj_K[0, 0]) / proj_K[0, 0] < 0.05
+    # Extrinsics: the integer-stripe observations let intrinsic error trade
+    # against toe-in, so bound them loosely and assert the metric that
+    # matters — reconstruction closure — below.
+    dR = stereo.R @ R.T
+    ang = np.rad2deg(np.arccos(np.clip((np.trace(dR) - 1) / 2, -1, 1)))
+    assert ang < 3.0
+    assert np.linalg.norm(stereo.T - T) < 0.12 * np.linalg.norm(T)
+    assert stereo.rms < 1.5
+    # The .mat artifact exists and loads back into a usable Calibration.
+    assert os.path.exists(lay.calib_mat)
+    back = slio.load_calibration_mat(lay.calib_mat, H, W)
+    np.testing.assert_allclose(np.asarray(back.plane_cols),
+                               np.asarray(calib.plane_cols), atol=1e-5)
+
+
+def test_recovered_calibration_closes_reconstruction(calib_session):
+    """End-to-end closure: scan rendered with the TRUE rig, reconstructed
+    with the RECOVERED calibration, must land within the quantization-bound
+    error envelope of the 512-stripe test projector."""
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        decode,
+        triangulate,
+    )
+
+    lay, (cam_K, proj_K, R, T), _ = calib_session
+    calib, _ = calibration.calibrate_final(lay.pose_dirs(), proj=PROJ,
+                                           board=BOARD)
+    scan, gt = synthetic.render_scan(
+        synthetic.Scene(), cam_K, proj_K, R, T, H, W, PROJ)
+    col, row, mask = decode.decode_stack(np.asarray(scan), PROJ.col_bits,
+                                         PROJ.row_bits)
+    pts, valid = triangulate.triangulate(col, row, mask, calib)
+    v = np.asarray(valid)
+    p = np.asarray(pts).reshape(-1, 3)
+    gtp = gt["points"].reshape(-1, 3)
+    err = np.linalg.norm(p[v] - gtp[v], axis=-1)
+    assert np.median(err) < 10.0  # mm at ~900 mm range, 512-stripe projector
+    assert np.percentile(err, 90) < 25.0

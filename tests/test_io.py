@@ -1,0 +1,174 @@
+"""I/O layer tests: PLY/STL roundtrips, reference-format interop, .mat
+calibration container, frame stacks, session layout."""
+
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu import io as slio
+from structured_light_for_3d_model_replication_tpu.ops import triangulate
+
+
+def _cloud(rng, n=257, colors=True, normals=False):
+    pts = rng.standard_normal((n, 3)).astype(np.float32)
+    col = rng.integers(0, 256, (n, 3), dtype=np.uint8) if colors else None
+    nrm = None
+    if normals:
+        v = rng.standard_normal((n, 3)).astype(np.float32)
+        nrm = v / np.linalg.norm(v, axis=-1, keepdims=True)
+    return slio.PointCloud(pts, col, nrm)
+
+
+@pytest.mark.parametrize("binary", [True, False])
+@pytest.mark.parametrize("normals", [True, False])
+def test_ply_roundtrip(tmp_path, rng, binary, normals):
+    cloud = _cloud(rng, colors=True, normals=normals)
+    p = str(tmp_path / "c.ply")
+    slio.write_ply(p, cloud, binary=binary)
+    back = slio.read_ply(p)
+    atol = 1e-6 if binary else 5e-5  # ascii quantizes at %.4f
+    np.testing.assert_allclose(back.points, cloud.points, atol=atol)
+    np.testing.assert_array_equal(back.colors, cloud.colors)
+    if normals:
+        np.testing.assert_allclose(back.normals, cloud.normals, atol=atol)
+    else:
+        assert back.normals is None
+
+
+def test_ply_reads_reference_ascii_format(tmp_path, rng):
+    """Files written by the reference's hand-rolled writer
+    (`server/sl_system.py:671-691`) must load."""
+    pts = rng.standard_normal((5, 3)).astype(np.float32)
+    cols = rng.integers(0, 256, (5, 3), dtype=np.uint8)
+    p = str(tmp_path / "ref.ply")
+    with open(p, "w") as f:
+        f.write("ply\nformat ascii 1.0\n")
+        f.write(f"element vertex {len(pts)}\n")
+        f.write("property float x\nproperty float y\nproperty float z\n")
+        f.write("property uchar red\nproperty uchar green\nproperty uchar blue\n")
+        f.write("end_header\n")
+        for q, c in zip(pts, cols):
+            f.write(f"{q[0]:.4f} {q[1]:.4f} {q[2]:.4f} {c[0]} {c[1]} {c[2]}\n")
+    back = slio.read_ply(p)
+    np.testing.assert_allclose(back.points, pts, atol=5e-5)
+    np.testing.assert_array_equal(back.colors, cols)
+
+
+def test_stl_roundtrip(tmp_path):
+    # Unit tetrahedron: 4 vertices, 4 faces, shared topology.
+    v = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]], np.float32)
+    f = np.array([[0, 2, 1], [0, 1, 3], [0, 3, 2], [1, 2, 3]], np.int32)
+    mesh = slio.TriangleMesh(v, f)
+    p = str(tmp_path / "m.stl")
+    slio.write_stl(p, mesh)
+    back = slio.read_stl(p)
+    assert back.faces.shape == (4, 3)
+    assert back.vertices.shape == (4, 3)
+    # Same vertex set (order may differ after dedup).
+    a = set(map(tuple, np.round(back.vertices, 6)))
+    b = set(map(tuple, np.round(v, 6)))
+    assert a == b
+
+
+def test_stl_ascii_roundtrip(tmp_path):
+    v = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]], np.float32)
+    f = np.array([[0, 2, 1], [0, 1, 3], [0, 3, 2], [1, 2, 3]], np.int32)
+    p = str(tmp_path / "m_ascii.stl")
+    slio.write_stl(p, slio.TriangleMesh(v, f), binary=False)
+    back = slio.read_stl(p)
+    assert back.faces.shape == (4, 3)
+    assert set(map(tuple, np.round(back.vertices, 6))) == \
+        set(map(tuple, np.round(v, 6)))
+
+
+def test_vertex_normals_sphereish():
+    # Octahedron vertex normals should point radially outward.
+    v = np.array([[1, 0, 0], [-1, 0, 0], [0, 1, 0], [0, -1, 0],
+                  [0, 0, 1], [0, 0, -1]], np.float32)
+    f = np.array([[0, 2, 4], [2, 1, 4], [1, 3, 4], [3, 0, 4],
+                  [2, 0, 5], [1, 2, 5], [3, 1, 5], [0, 3, 5]], np.int32)
+    mesh = slio.TriangleMesh(v, f)
+    vn = mesh.compute_vertex_normals()
+    cos = np.sum(vn * v, axis=-1)
+    assert (cos > 0.9).all()
+
+
+def test_matcal_roundtrip(tmp_path, synth_rig, small_proj):
+    cam_K, proj_K, R, T = synth_rig
+    H, W = 96, 160
+    calib = triangulate.make_calibration(
+        cam_K, proj_K, R, T, H, W,
+        proj_width=small_proj.width, proj_height=small_proj.height)
+    p = str(tmp_path / "calib.mat")
+    slio.save_calibration_mat(p, calib)
+    back = slio.load_calibration_mat(p, H, W)
+    np.testing.assert_allclose(np.asarray(back.Nc), np.asarray(calib.Nc),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(back.plane_cols),
+                               np.asarray(calib.plane_cols), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(back.plane_rows),
+                               np.asarray(calib.plane_rows), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(back.R), np.asarray(calib.R),
+                               atol=1e-7)
+
+
+def test_matcal_resolution_mismatch_regenerates_rays(tmp_path, synth_rig,
+                                                     small_proj):
+    cam_K, proj_K, R, T = synth_rig
+    calib = triangulate.make_calibration(
+        cam_K, proj_K, R, T, 96, 160,
+        proj_width=small_proj.width, proj_height=small_proj.height)
+    p = str(tmp_path / "calib.mat")
+    slio.save_calibration_mat(p, calib)
+    back = slio.load_calibration_mat(p, 48, 80)  # different capture res
+    assert np.asarray(back.Nc).shape == (48, 80, 3)
+    expect = np.asarray(triangulate.camera_rays(cam_K, 48, 80))
+    np.testing.assert_allclose(np.asarray(back.Nc), expect, atol=1e-6)
+
+
+def test_stack_loader_roundtrip(tmp_path, rng):
+    folder = str(tmp_path / "scan")
+    os.makedirs(folder)
+    frames = rng.integers(0, 256, (6, 32, 48), dtype=np.uint8)
+    for i, fr in enumerate(frames):
+        slio.write_frame(os.path.join(folder, slio.frame_name(i + 1)), fr)
+    stack = slio.load_stack(folder, expected_frames=6)
+    np.testing.assert_array_equal(stack, frames)
+
+    rgb = rng.integers(0, 256, (32, 48, 3), dtype=np.uint8)
+    slio.write_frame(os.path.join(folder, "01.png"), rgb)
+    back = slio.load_white_rgb(folder)
+    np.testing.assert_array_equal(back, rgb)
+
+
+def test_stack_loader_frame_count_check(tmp_path, rng):
+    folder = str(tmp_path / "scan")
+    os.makedirs(folder)
+    slio.write_frame(os.path.join(folder, "01.png"),
+                     np.zeros((8, 8), np.uint8))
+    with pytest.raises(ValueError):
+        slio.load_stack(folder, expected_frames=4)
+
+
+def test_numeric_sort():
+    paths = ["s/10.ply", "s/2.ply", "s/1.ply", "s/30deg_scan.ply"]
+    out = slio.numeric_sort(paths)
+    assert out == ["s/1.ply", "s/2.ply", "s/10.ply", "s/30deg_scan.ply"]
+
+
+def test_session_layout(tmp_path, rng):
+    lay = slio.SessionLayout(str(tmp_path / "sess")).ensure()
+    assert os.path.isdir(lay.calib_dir)
+    # Two stops, one complete (2 frames expected), one partial.
+    d0 = lay.stop_dir("obj", 30, 0)
+    d1 = lay.stop_dir("obj", 30, 30)
+    os.makedirs(d0)
+    os.makedirs(d1)
+    img = np.zeros((8, 8), np.uint8)
+    slio.write_frame(os.path.join(d0, "01.bmp"), img)
+    slio.write_frame(os.path.join(d0, "02.bmp"), img)
+    slio.write_frame(os.path.join(d1, "01.bmp"), img)
+    done = lay.completed_stops("obj", 30, expected_frames=2)
+    assert done == [d0]
+    assert lay.stop_dirs("obj", 30) == [d0, d1]

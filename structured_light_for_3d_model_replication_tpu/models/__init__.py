@@ -11,9 +11,9 @@ from . import oracle, synthetic  # noqa: F401
 
 
 def __getattr__(name):
-    if name == "pipeline":
+    if name in ("pipeline", "meshing"):
         # import_module (not `from . import`) so an in-progress circular
         # import resolves from sys.modules instead of recursing into this
         # __getattr__ via the package attribute lookup.
-        return importlib.import_module(f"{__name__}.pipeline")
+        return importlib.import_module(f"{__name__}.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,144 @@
+"""Cloud → printable mesh workflows.
+
+The framework's analogue of the reference's meshing entry points
+(`ProcessingLogic.reconstruct_stl`, `server/processing.py:184-249`, and
+`ProcessingLogic.mesh_360`, `server/processing.py:251-310`): estimate and
+orient normals, run the (TPU) Poisson solve, extract + trim, write STL.
+
+Orientation modes mirror `server/processing.py:267-289`:
+* ``"radial"``  — orient toward the cloud center, then negate (outward);
+* ``"tangent"`` — Hoppe MST propagation (`orient_normals_consistent_tangent_
+  plane(100)`), falling back to radial on failure, like the reference's
+  try/except at `:284-289`;
+* ``"camera"``  — toward an explicit camera location.
+
+"Surface" (non-watertight) mode: the reference ball-pivots with radii =
+avg-NN-dist × multipliers (`server/processing.py:222-235`). Ball pivoting is
+sequential front propagation — a poor fit for a vector machine — so the
+TPU-native surface mode is the same Poisson solve with an aggressive density
+trim (open surface where there was no data), with the multiplier string kept
+for CLI compatibility.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.ply import PointCloud
+from ..io.stl import TriangleMesh, write_stl
+from ..ops import marching, orientation, poisson
+from ..ops import pointcloud as pc_ops
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+def ensure_oriented_normals(
+    cloud: PointCloud,
+    mode: str = "radial",
+    k: int = 30,
+    camera: np.ndarray | None = None,
+) -> np.ndarray:
+    """Estimate (if absent) and globally orient normals; returns (N,3)."""
+    pts = np.asarray(cloud.points, np.float32)
+    if cloud.normals is not None and len(cloud.normals) == len(pts):
+        normals = np.asarray(cloud.normals, np.float32)
+    else:
+        normals, _ = (np.asarray(a) for a in
+                      pc_ops.estimate_normals(pts, k=k))
+
+    center = pts.mean(axis=0)
+    if mode == "radial":
+        # Toward center then negate → outward (`server/processing.py:270-277`).
+        normals = np.asarray(pc_ops.orient_normals(pts, normals, center,
+                                                   outward=True))
+    elif mode == "tangent":
+        try:
+            normals = orientation.orient_normals_consistent_tangent_plane(
+                pts, normals, k=100)
+        except Exception as exc:  # reference falls back to radial (:284-289)
+            log.warning("tangent orientation failed (%s); radial fallback",
+                        exc)
+            normals = np.asarray(pc_ops.orient_normals(pts, normals, center,
+                                                       outward=True))
+    elif mode == "camera":
+        if camera is None:
+            raise ValueError("orientation='camera' needs a camera location")
+        normals = np.asarray(pc_ops.orient_normals(
+            pts, normals, np.asarray(camera, np.float32), outward=False))
+    else:
+        raise ValueError(f"unknown orientation mode {mode!r}")
+    cloud.normals = normals
+    return normals
+
+
+def mesh_from_cloud(
+    cloud: PointCloud,
+    mode: str = "watertight",
+    depth: int = 8,
+    quantile_trim: float = 0.02,
+    orientation_mode: str = "radial",
+    camera: np.ndarray | None = None,
+    radii_multipliers: str = "1,2,4",
+    cg_iters: int = 300,
+) -> TriangleMesh:
+    """Poisson-mesh a cloud (the body of `reconstruct_stl` / `mesh_360`).
+
+    ``mode="watertight"`` trims the given density quantile (reference default
+    2%, `server/processing.py:217`; pass 0.0 for fully watertight — the
+    `mesh_360` GUI default, `server/gui.py:65`). ``mode="surface"`` trims
+    hard (25%) as the ball-pivot substitute. ``depth`` maps to a 2^depth
+    dense grid, capped at 8 (reference caps at 16, `server/processing.py:
+    207-208` — octrees go deeper than dense grids).
+    """
+    if mode not in ("watertight", "surface"):
+        raise ValueError(f"unknown mesh mode {mode!r}")
+    del radii_multipliers  # accepted for reference-CLI parity
+    pts = np.asarray(cloud.points, np.float32)
+    if pts.shape[0] < 16:
+        raise ValueError(f"too few points to mesh ({pts.shape[0]})")
+    normals = ensure_oriented_normals(cloud, orientation_mode,
+                                      camera=camera)
+    grid = poisson.reconstruct(pts, normals, depth=int(depth),
+                               cg_iters=cg_iters)
+    trim = quantile_trim if mode == "watertight" else max(quantile_trim, 0.25)
+    mesh = marching.extract(grid, quantile_trim=trim)
+    log.info("meshed %d points -> %d verts / %d faces (mode=%s depth=%d)",
+             pts.shape[0], len(mesh.vertices), len(mesh.faces), mode, depth)
+    return mesh
+
+
+def reconstruct_stl(
+    cloud: PointCloud,
+    out_path: str,
+    mode: str = "watertight",
+    depth: int = 8,
+    quantile_trim: float = 0.02,
+    orientation_mode: str = "radial",
+    **kw,
+) -> TriangleMesh:
+    """Cloud → STL file (drop-in for `ProcessingLogic.reconstruct_stl`,
+    `server/processing.py:184-249`)."""
+    mesh = mesh_from_cloud(cloud, mode=mode, depth=depth,
+                           quantile_trim=quantile_trim,
+                           orientation_mode=orientation_mode, **kw)
+    write_stl(out_path, mesh)
+    return mesh
+
+
+def mesh_360(
+    cloud: PointCloud,
+    out_path: str,
+    depth: int = 8,
+    quantile_trim: float = 0.0,
+    orientation_mode: str = "radial",
+    **kw,
+) -> TriangleMesh:
+    """Merged-360° cloud → watertight STL (drop-in for
+    `ProcessingLogic.mesh_360`, `server/processing.py:251-310`; watertight
+    trim default 0.0 per `server/gui.py:65`)."""
+    mesh = mesh_from_cloud(cloud, mode="watertight", depth=depth,
+                           quantile_trim=quantile_trim,
+                           orientation_mode=orientation_mode, **kw)
+    write_stl(out_path, mesh)
+    return mesh

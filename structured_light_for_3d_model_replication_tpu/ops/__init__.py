@@ -5,8 +5,11 @@ from . import (  # noqa: F401
     decode,
     features,
     knn,
+    marching,
+    orientation,
     patterns,
     pointcloud,
+    poisson,
     posegraph,
     registration,
     segmentation,

@@ -1,0 +1,67 @@
+"""Structured logging for the framework.
+
+The reference logs with bare ``print`` throughout (`server/sl_system.py:490,
+574-576`, `server/server.py:35,51,73,91` — emoji-tagged console lines). Here
+every module gets a namespaced stdlib logger with one process-wide
+configuration point, an opt-in JSON-lines mode for machine consumption, and an
+env override (``SL_TPU_LOG=debug``) so benchmark runs can be silenced or
+traced without code edits.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+_CONFIGURED = False
+ROOT_NAME = "structured_light_for_3d_model_replication_tpu"
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def configure(level: str | int | None = None, json_lines: bool = False,
+              stream=None) -> None:
+    """Configure the framework's root logger (idempotent; call again to
+    reconfigure). Level resolution order: arg > $SL_TPU_LOG > INFO."""
+    global _CONFIGURED
+    if level is None:
+        level = os.environ.get("SL_TPU_LOG", "info")
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
+    root = logging.getLogger(ROOT_NAME)
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(stream or sys.stderr)
+    if json_lines or os.environ.get("SL_TPU_LOG_JSON"):
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s",
+            datefmt="%H:%M:%S"))
+    root.addHandler(handler)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Namespaced logger; lazily configures defaults on first use."""
+    if not _CONFIGURED:
+        configure()
+    if not name.startswith(ROOT_NAME):
+        name = f"{ROOT_NAME}.{name}"
+    return logging.getLogger(name)

@@ -1,0 +1,119 @@
+"""360° merge workflows: sequential chain + pose-graph, cleanup workflows."""
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.io import ply as ply_io
+from structured_light_for_3d_model_replication_tpu.models import merge
+
+
+def _bumpy_cloud(rng, n=600):
+    u = rng.normal(size=(n, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    r = 1.0 + 0.3 * np.sin(4 * u[:, 0]) * np.cos(3 * u[:, 1]) \
+        + 0.15 * np.sin(5 * u[:, 2])
+    return (u * r[:, None]).astype(np.float32)
+
+
+def _rot_z(deg):
+    th = np.deg2rad(deg)
+    c, s = np.cos(th), np.sin(th)
+    T = np.eye(4, dtype=np.float32)
+    T[:3, :3] = np.array([[c, -s, 0], [s, c, 0], [0, 0, 1]], np.float32)
+    return T
+
+
+def _ring_views(rng, n_views=4, deg=12.0, n_pts=600):
+    """Views of one object from a turntable: view i sees the object rotated
+    by -i·deg (so registering view i onto i-1 recovers ≈ rot_z(deg))."""
+    base = _bumpy_cloud(rng, n_pts)
+    views = []
+    for i in range(n_views):
+        T = _rot_z(-deg * i)
+        pts = base @ T[:3, :3].T
+        pts += rng.normal(scale=0.003, size=pts.shape)
+        # Vary point counts to exercise padding.
+        keep = rng.random(n_pts) > (0.05 * i)
+        colors = np.full((keep.sum(), 3), 128, np.uint8)
+        views.append(ply_io.PointCloud(pts[keep].astype(np.float32), colors))
+    return views
+
+
+FAST = merge.MergeParams(
+    voxel_size=0.08,
+    ransac_iterations=2048,
+    icp_iterations=20,
+    fpfh_max_nn=32,
+    normals_k=12,
+    posegraph_iterations=20,
+)
+
+
+def _pose_errors(poses, deg):
+    """Pose i maps view-i points into view 0's frame; view i holds the object
+    rotated by -i·deg, so the undoing pose is Rz(+i·deg)."""
+    return [float(np.abs(P - _rot_z(deg * i)).max())
+            for i, P in enumerate(poses)]
+
+
+def test_merge_pro_360_recovers_ring(rng):
+    views = _ring_views(rng)
+    merged, poses = merge.merge_pro_360(views, FAST)
+    assert poses.shape == (4, 4, 4)
+    errs = _pose_errors(poses, 12.0)
+    assert max(errs) < 0.15, f"chain pose errors {errs}"
+    assert 100 < len(merged) < 4 * 600
+    assert merged.normals is not None and merged.colors is not None
+    nrm = np.linalg.norm(merged.normals, axis=1)
+    np.testing.assert_allclose(nrm, 1.0, atol=1e-3)
+
+
+def test_merge_posegraph_360_at_least_as_good(rng):
+    views = _ring_views(rng)
+    merged, poses = merge.merge_posegraph_360(views, FAST)
+    errs = _pose_errors(poses, 12.0)
+    assert max(errs) < 0.15, f"posegraph pose errors {errs}"
+    assert len(merged) > 100
+
+
+def test_merge_360_files_roundtrip(rng, tmp_path):
+    views = _ring_views(rng, n_views=3)
+    for i, v in enumerate(views):
+        ply_io.write_ply(str(tmp_path / f"scan_{i}.ply"), v)
+    out = str(tmp_path / "merged.ply")
+    merged = merge.merge_360_files(str(tmp_path), out, FAST,
+                                   method="sequential")
+    back = ply_io.read_ply(out)
+    assert len(back) == len(merged) > 0
+
+
+def test_merge_requires_two_clouds(rng):
+    with pytest.raises(ValueError):
+        merge.merge_pro_360([ply_io.PointCloud(_bumpy_cloud(rng))], FAST)
+
+
+def test_remove_background_drops_plane(rng):
+    obj = _bumpy_cloud(rng, 400) + np.array([0, 0, 3.0], np.float32)
+    g = np.stack(np.meshgrid(np.linspace(-5, 5, 30),
+                             np.linspace(-5, 5, 30)), -1).reshape(-1, 2)
+    wall = np.concatenate([g, np.zeros((len(g), 1))], 1).astype(np.float32)
+    wall += rng.normal(scale=0.01, size=wall.shape).astype(np.float32)
+    cloud = ply_io.PointCloud(
+        np.concatenate([obj, wall]).astype(np.float32))
+    cleaned = merge.remove_background(cloud, distance_threshold=0.1,
+                                      num_iterations=256)
+    # The wall (900 pts, dominant plane) goes; the object mostly stays.
+    assert len(cleaned) < len(cloud) - 700
+    assert len(cleaned) > 300
+
+
+def test_remove_outliers_drops_far_points(rng):
+    core = _bumpy_cloud(rng, 500)
+    junk = rng.uniform(-20, 20, size=(20, 3)).astype(np.float32)
+    cloud = ply_io.PointCloud(np.concatenate([core, junk]),
+                              colors=np.zeros((520, 3), np.uint8))
+    cleaned = merge.remove_outliers(cloud, nb_neighbors=10, std_ratio=2.0)
+    assert len(cleaned) < 520
+    kept = set(map(tuple, np.round(cleaned.points, 4)))
+    junk_kept = sum(tuple(np.round(j, 4)) in kept for j in junk)
+    assert junk_kept <= 3

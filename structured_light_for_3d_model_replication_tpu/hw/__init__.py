@@ -1,0 +1,18 @@
+"""Hardware/device-communication layer (reference L4 + satellites).
+
+Three transports with the reference's exact wire protocols — pull-mode HTTP
+command channel (`server/server.py`), push-mode Android Camera2 host client
+(`android_camera_host/`), serial turntable (`server/arduino.py` /
+`ESP_code.ino`) — plus headless virtual equivalents for every device so the
+full capture pipeline runs without hardware (:mod:`.rig`).
+
+`WindowProjector` needs cv2 and `SerialTurntable` needs pyserial; both import
+lazily inside the class so this package (and the virtual rig) works on bare
+images.
+"""
+
+from .camera import CameraSettings, PullCamera, PushCamera, SyntheticCamera  # noqa: F401
+from .command_server import CommandChannel, CommandServer  # noqa: F401
+from .projector import VirtualProjector, WindowProjector  # noqa: F401
+from .rig import VirtualRig  # noqa: F401
+from .turntable import SerialTurntable, SimulatedTurntable, TurntableError  # noqa: F401

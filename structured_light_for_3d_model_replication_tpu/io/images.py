@@ -24,22 +24,37 @@ import numpy as np
 _EXTS = (".bmp", ".png", ".jpg", ".jpeg")
 
 
-def _imread_gray(path: str) -> np.ndarray:
-    import cv2
+def _cv2():
+    """cv2 if present, else None (this image ships PIL but not OpenCV)."""
+    try:
+        import cv2
+        return cv2
+    except ImportError:
+        return None
 
-    img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
-    if img is None:
-        raise IOError(f"failed to read image {path}")
-    return img
+
+def _imread_gray(path: str) -> np.ndarray:
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            raise IOError(f"failed to read image {path}")
+        return img
+    from PIL import Image
+
+    return np.asarray(Image.open(path).convert("L"))
 
 
 def _imread_rgb(path: str) -> np.ndarray:
-    import cv2
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise IOError(f"failed to read image {path}")
+        return img[..., ::-1].copy()  # BGR -> RGB at the boundary
+    from PIL import Image
 
-    img = cv2.imread(path, cv2.IMREAD_COLOR)
-    if img is None:
-        raise IOError(f"failed to read image {path}")
-    return img[..., ::-1].copy()  # BGR -> RGB at the boundary
+    return np.asarray(Image.open(path).convert("RGB"))
 
 
 def list_frames(folder: str) -> list[str]:
@@ -86,12 +101,15 @@ def device_stack(folder: str, expected_frames: int | None = None):
 
 def write_frame(path: str, img: np.ndarray) -> None:
     """uint8 (H, W) or (H, W, 3) RGB → file (extension picks the codec)."""
-    import cv2
+    cv2 = _cv2()
+    if cv2 is not None:
+        out = img[..., ::-1] if img.ndim == 3 else img  # RGB -> BGR
+        if not cv2.imwrite(path, out):
+            raise IOError(f"failed to write image {path}")
+        return
+    from PIL import Image
 
-    if img.ndim == 3:
-        img = img[..., ::-1]  # RGB -> BGR for OpenCV
-    if not cv2.imwrite(path, img):
-        raise IOError(f"failed to write image {path}")
+    Image.fromarray(np.asarray(img, np.uint8)).save(path)
 
 
 _NUM_RE = re.compile(r"(\d+)")

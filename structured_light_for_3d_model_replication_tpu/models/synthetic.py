@@ -129,6 +129,62 @@ def raycast(scene: Scene, rays: np.ndarray):
     return t_best, albedo, is_object, hit
 
 
+class FrameShader:
+    """Geometry of one scene pose, precomputed once; shades ANY projector
+    frame into the camera image. ``render_scan`` uses it per stop; the
+    virtual hardware rig (`hw/`) uses it to answer captures of whatever the
+    virtual projector currently displays — the headless phone simulator the
+    reference lacks (SURVEY §4: "capture paths cannot run headless")."""
+
+    def __init__(self, scene: Scene, cam_K, proj_K, R, T,
+                 cam_height: int, cam_width: int,
+                 proj: ProjectorConfig = ProjectorConfig()):
+        self.cam_height, self.cam_width = cam_height, cam_width
+        self.proj = proj
+        rays = camera_rays_np(cam_K, cam_height, cam_width).reshape(-1, 3)
+        t, albedo, is_object, hit = raycast(scene, rays)
+        points = t[:, None] * rays  # (N, 3), camera frame
+
+        # Project every hit point into the projector.
+        P_p = points @ R.T + T[None, :]
+        z = P_p[:, 2]
+        ok_z = z > 1e-6
+        u = np.where(ok_z, (proj_K[0, 0] * P_p[:, 0] + proj_K[0, 2] * z)
+                     / np.where(ok_z, z, 1.0), -1.0)
+        v = np.where(ok_z, (proj_K[1, 1] * P_p[:, 1] + proj_K[1, 2] * z)
+                     / np.where(ok_z, z, 1.0), -1.0)
+        ui = np.round(u).astype(np.int64)
+        vi = np.round(v).astype(np.int64)
+        lit = (hit & ok_z & (ui >= 0) & (ui < proj.width)
+               & (vi >= 0) & (vi < proj.height))
+        self._ui = np.clip(ui, 0, proj.width - 1)
+        self._vi = np.clip(vi, 0, proj.height - 1)
+        self._lit = lit
+        self._hit = hit
+        self._albedo = albedo
+        self._ambient = scene.ambient
+        self.ground_truth = {
+            "points": points.reshape(cam_height, cam_width, 3),
+            "proj_u": u.reshape(cam_height, cam_width),
+            "proj_v": v.reshape(cam_height, cam_width),
+            "object_mask": is_object.reshape(cam_height, cam_width),
+            "hit_mask": hit.reshape(cam_height, cam_width),
+            "lit_mask": lit.reshape(cam_height, cam_width),
+        }
+
+    def shade(self, frame: np.ndarray) -> np.ndarray:
+        """(proj_h, proj_w[, 3]) frame -> (cam_h, cam_w) uint8 camera image
+        (color frames shade by luminance — the synthetic camera is mono)."""
+        frame = np.asarray(frame)
+        if frame.ndim == 3:
+            frame = frame.mean(axis=-1)
+        proj_val = frame[self._vi, self._ui].astype(np.float64)
+        val = np.where(self._lit, self._albedo * proj_val + self._ambient,
+                       np.where(self._hit, self._ambient, 0.0))
+        return np.clip(val, 0, 255).astype(np.uint8).reshape(
+            self.cam_height, self.cam_width)
+
+
 def render_scan(
     scene: Scene,
     cam_K: np.ndarray,
@@ -152,43 +208,10 @@ def render_scan(
     if pattern_frames is None:
         pattern_frames = np.asarray(pattern_stack_for(proj))
 
-    rays = camera_rays_np(cam_K, cam_height, cam_width).reshape(-1, 3)
-    t, albedo, is_object, hit = raycast(scene, rays)
-    points = t[:, None] * rays  # (N, 3), camera frame
-
-    # Project every hit point into the projector.
-    P_p = points @ R.T + T[None, :]
-    z = P_p[:, 2]
-    ok_z = z > 1e-6
-    u = np.where(ok_z, (proj_K[0, 0] * P_p[:, 0] + proj_K[0, 2] * z)
-                 / np.where(ok_z, z, 1.0), -1.0)
-    v = np.where(ok_z, (proj_K[1, 1] * P_p[:, 1] + proj_K[1, 2] * z)
-                 / np.where(ok_z, z, 1.0), -1.0)
-    ui = np.round(u).astype(np.int64)
-    vi = np.round(v).astype(np.int64)
-    lit = hit & ok_z & (ui >= 0) & (ui < proj.width) & (vi >= 0) & (vi < proj.height)
-    ui_c = np.clip(ui, 0, proj.width - 1)
-    vi_c = np.clip(vi, 0, proj.height - 1)
-
-    n_frames = pattern_frames.shape[0]
-    stack = np.empty((n_frames, cam_height * cam_width), dtype=np.uint8)
-    amb = scene.ambient
-    for f in range(n_frames):
-        frame = pattern_frames[f]
-        proj_val = frame[vi_c, ui_c].astype(np.float64)
-        val = np.where(lit, albedo * proj_val + amb, np.where(hit, amb, 0.0))
-        stack[f] = np.clip(val, 0, 255).astype(np.uint8)
-    stack = stack.reshape(n_frames, cam_height, cam_width)
-
-    gt = {
-        "points": points.reshape(cam_height, cam_width, 3),
-        "proj_u": u.reshape(cam_height, cam_width),
-        "proj_v": v.reshape(cam_height, cam_width),
-        "object_mask": is_object.reshape(cam_height, cam_width),
-        "hit_mask": hit.reshape(cam_height, cam_width),
-        "lit_mask": lit.reshape(cam_height, cam_width),
-    }
-    return stack, gt
+    shader = FrameShader(scene, cam_K, proj_K, R, T, cam_height, cam_width,
+                         proj)
+    stack = np.stack([shader.shade(f) for f in pattern_frames])
+    return stack, shader.ground_truth
 
 
 def render_calibration_pose(

@@ -1,0 +1,192 @@
+"""Scan orchestration: capture workflows over the hardware abstractions.
+
+Headless equivalent of the reference's L5/L3 capture machinery — the Tkinter
+GUI's worker-thread workflows (`server/gui.py`) and ``SLSystem``'s
+display-then-trigger loops (`server/sl_system.py:114-182,422-481`) — written
+against the :mod:`.hw` interfaces so the same code drives a physical rig
+(window projector + phone + ESP32) or the virtual one (:class:`~.hw.rig
+.VirtualRig`). No UI thread: callers run it directly or on their own worker.
+
+Workflows:
+
+* :meth:`Scanner.capture_scan` — project the protocol-ordered frame stack
+  (white, black, then col/row bit pattern+inverse pairs —
+  `server/sl_system.py:133-150,436-470`), capturing one camera image per
+  frame into ``{idx:02d}.png``; abort the scan if any capture times out
+  (`server/sl_system.py:468-471`).
+* :meth:`Scanner.capture_calibration_pose` — the same stack at the
+  calibration dwell into ``calib/pose_N/`` (`server/sl_system.py:114-182`).
+* :meth:`Scanner.auto_scan_360` — the flagship loop (`server/gui.py:686-773`):
+  capture a stop, rotate, wait for DONE (warn-but-continue on timeout,
+  `server/gui.py:760-762`), 0.5 s settle, repeat; with per-stop progress
+  timing (elapsed / avg / remaining, `server/gui.py:727-731`) and RESUME —
+  stops whose folders already hold a full stack are skipped
+  (`io/layout.completed_stops`), which the reference cannot do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable
+
+import numpy as np
+
+from .config import ProjectorConfig
+from .io.layout import SessionLayout, frame_name
+from .ops.patterns import pattern_stack_for
+from .utils.log import get_logger
+
+log = get_logger(__name__)
+
+SCAN_DWELL_MS = 200    # server/sl_system.py:465
+CALIB_DWELL_MS = 250   # server/sl_system.py:172
+SETTLE_S = 0.5         # server/gui.py:763
+ROTATE_TIMEOUT_S = 10.0  # server/gui.py:760
+
+
+class ScanAborted(RuntimeError):
+    """A frame capture timed out — the stack is incomplete and unusable."""
+
+
+@dataclasses.dataclass
+class ScanProgress:
+    """Per-stop timing surfaced to UIs (`server/gui.py:727-731`)."""
+
+    stop: int
+    total_stops: int
+    elapsed_s: float
+    avg_stop_s: float
+    remaining_s: float
+
+
+class Scanner:
+    def __init__(
+        self,
+        camera,
+        projector,
+        turntable=None,
+        proj: ProjectorConfig = ProjectorConfig(),
+        layout: SessionLayout | None = None,
+        settle_s: float = SETTLE_S,
+    ):
+        self.camera = camera
+        self.projector = projector
+        self.turntable = turntable
+        self.proj = proj
+        self.layout = layout or SessionLayout.today().ensure()
+        self.settle_s = settle_s
+        self._frames: np.ndarray | None = None
+
+    def _pattern_frames(self) -> np.ndarray:
+        if self._frames is None:
+            self._frames = np.asarray(pattern_stack_for(self.proj))
+        return self._frames
+
+    # ------------------------------------------------------------------
+    # Single-stop capture
+    # ------------------------------------------------------------------
+
+    def capture_stack(self, out_dir: str, dwell_ms: int = SCAN_DWELL_MS,
+                      ext: str = "png") -> list[str]:
+        """Project every protocol frame and capture it to
+        ``out_dir/{idx:02d}.{ext}`` (1-based numbering like the reference's
+        `{idx:02d}` scheme, `server/sl_system.py:436-451`)."""
+        os.makedirs(out_dir, exist_ok=True)
+        frames = self._pattern_frames()
+        paths = []
+        for i, frame in enumerate(frames):
+            self.projector.show(frame, dwell_ms=dwell_ms)
+            path = os.path.join(out_dir, frame_name(i + 1, ext))
+            if not self.camera.capture(path):
+                raise ScanAborted(
+                    f"capture timed out on frame {i + 1}/{len(frames)} "
+                    f"({path})")
+            paths.append(path)
+        return paths
+
+    def capture_scan(self, name: str, dwell_ms: int = SCAN_DWELL_MS
+                     ) -> str:
+        """One scan folder under ``scans/`` (`SLSystem.capture_scan`,
+        `server/sl_system.py:422-481`). Returns the folder path."""
+        out = self.layout.scan_dir(name)
+        self.capture_stack(out, dwell_ms=dwell_ms)
+        log.info("scan %s captured (%d frames)", name,
+                 self.proj.n_frames)
+        return out
+
+    def capture_calibration_pose(self, pose: int,
+                                 dwell_ms: int = CALIB_DWELL_MS) -> str:
+        """One checkerboard pose under ``calib/pose_N/``
+        (`SLSystem.capture_calibration`, `server/sl_system.py:114-182`)."""
+        out = self.layout.pose_dir(pose)
+        self.capture_stack(out, dwell_ms=dwell_ms)
+        log.info("calibration pose %d captured", pose)
+        return out
+
+    # ------------------------------------------------------------------
+    # Auto 360°
+    # ------------------------------------------------------------------
+
+    def auto_scan_360(
+        self,
+        base_name: str,
+        degrees_per_turn: float = 30.0,
+        turns: int = 12,
+        dwell_ms: int = SCAN_DWELL_MS,
+        resume: bool = True,
+        on_progress: Callable[[ScanProgress], None] | None = None,
+    ) -> list[str]:
+        """The flagship capture loop (`server/gui.py:686-773`). Returns the
+        list of per-stop folders (``{base}_{angle}deg_scan``).
+
+        Without a turntable the rotation is skipped entirely and the caller
+        is expected to turn the object — the reference's "Simulation mode"
+        prompt (`server/gui.py:690-693`) maps to passing a
+        :class:`~.hw.turntable.SimulatedTurntable`.
+
+        Resume contract: rotations are RELATIVE, and the loop still rotates
+        through skipped stops, so a resumed session recaptures missing stops
+        at the correct angles iff the turntable starts at the 0° home
+        position (re-home the table — or restart the virtual rig, whose
+        simulated table boots at 0°).
+        """
+        done_before = set(
+            self.layout.completed_stops(base_name, degrees_per_turn,
+                                        self.proj.n_frames)
+            if resume else [])
+        t0 = time.monotonic()
+        stops = []
+        captured = 0
+        for i in range(turns):
+            angle = i * degrees_per_turn
+            out = self.layout.stop_dir(base_name, degrees_per_turn, angle)
+            if out in done_before:
+                log.info("stop %d/%d (%.0f°) already complete — resumed past",
+                         i + 1, turns, angle)
+            else:
+                self.capture_stack(out, dwell_ms=dwell_ms)
+                captured += 1
+            stops.append(out)
+
+            if on_progress is not None:
+                elapsed = time.monotonic() - t0
+                avg = elapsed / max(captured, 1)
+                remaining = avg * sum(
+                    1 for j in range(i + 1, turns)
+                    if self.layout.stop_dir(base_name, degrees_per_turn,
+                                            j * degrees_per_turn)
+                    not in done_before)
+                on_progress(ScanProgress(i + 1, turns, elapsed, avg,
+                                         remaining))
+
+            if i < turns - 1 and self.turntable is not None:
+                self.turntable.rotate(degrees_per_turn)
+                if not self.turntable.wait_for_done(ROTATE_TIMEOUT_S):
+                    log.warning("rotation %d DONE timeout — continuing", i)
+                time.sleep(self.settle_s)
+        log.info("auto 360 complete: %d stops (%d captured, %d resumed) "
+                 "in %.1fs", turns, captured, len(done_before & set(stops)),
+                 time.monotonic() - t0)
+        return stops

@@ -1,0 +1,267 @@
+"""Device-communication layer + scan orchestration, fully headless."""
+
+import json
+import os
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu import scanner as scan_mod
+from structured_light_for_3d_model_replication_tpu.config import ProjectorConfig
+from structured_light_for_3d_model_replication_tpu.hw import (
+    CommandChannel,
+    CommandServer,
+    PushCamera,
+    SimulatedTurntable,
+    VirtualProjector,
+    VirtualRig,
+)
+from structured_light_for_3d_model_replication_tpu.io.layout import SessionLayout
+from structured_light_for_3d_model_replication_tpu.models import synthetic
+from structured_light_for_3d_model_replication_tpu.ops.patterns import (
+    pattern_stack_for,
+)
+
+TINY = ProjectorConfig(width=64, height=32)
+
+
+# ---------------------------------------------------------------------------
+# Turntable
+# ---------------------------------------------------------------------------
+
+
+def test_simulated_turntable_motion():
+    tt = SimulatedTurntable(time_scale=0.01)
+    tt.rotate(30.0)
+    assert tt.wait_for_done(timeout=5.0)
+    assert tt.angle_deg == pytest.approx(30.0)
+    tt.rotate(345.0)
+    assert tt.wait_for_done(timeout=5.0)
+    assert tt.angle_deg == pytest.approx(15.0)  # wraps mod 360
+
+
+def test_simulated_turntable_timeout_warns_not_raises():
+    tt = SimulatedTurntable(time_scale=10.0)  # 30° takes ~50 s scaled
+    tt.rotate(30.0)
+    assert tt.wait_for_done(timeout=0.05) is False  # reference: warn, go on
+
+
+# ---------------------------------------------------------------------------
+# Pull-mode command server (phone protocol loopback)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def command_server():
+    srv = CommandServer(host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def test_poll_idle_then_capture_roundtrip(command_server, tmp_path):
+    base = f"http://127.0.0.1:{command_server.port}"
+    st = _get_json(base + "/poll_command")
+    assert st["command"] == "idle"
+    idle_id = st["id"]
+
+    target = str(tmp_path / "shot.jpg")
+    results = {}
+
+    def pc_side():
+        results["ok"] = command_server.channel.trigger_capture(target,
+                                                               timeout=10)
+
+    t = threading.Thread(target=pc_side)
+    t.start()
+    # Phone side: poll until the capture command with a fresh id appears.
+    for _ in range(100):
+        st = _get_json(base + "/poll_command")
+        if st["command"] == "capture" and st["id"] != idle_id:
+            break
+    assert st["command"] == "capture"
+
+    # Upload as multipart/form-data exactly like the React client.
+    payload = b"\xff\xd8JPEGDATA\xff\xd9"
+    boundary = "BoUnDaRy123"
+    body = (
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="file"; filename="c.jpg"\r\n'
+        f"Content-Type: image/jpeg\r\n\r\n"
+    ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+    req = urllib.request.Request(
+        base + "/upload", data=body,
+        headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.loads(r.read().decode())["saved"] == "shot.jpg"
+
+    t.join(timeout=10)
+    assert results["ok"] is True
+    with open(target, "rb") as f:
+        assert f.read() == payload
+    # Command resets to idle after the handshake.
+    assert _get_json(base + "/poll_command")["command"] == "idle"
+    assert _get_json(base + "/status")["connected"] is True
+
+
+def test_trigger_capture_times_out_without_upload():
+    ch = CommandChannel()
+    assert ch.trigger_capture("/tmp/never.jpg", timeout=0.05) is False
+
+
+# ---------------------------------------------------------------------------
+# Push-mode camera (Android host protocol against a stub)
+# ---------------------------------------------------------------------------
+
+
+class _AndroidHostStub(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = json.dumps({"camera": "open"} if self.path == "/status"
+                          else {"iso_range": [100, 3200]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if self.path == "/capture/jpeg":
+            jpeg = b"\xff\xd8stubjpeg\xff\xd9"
+            self.send_response(200)
+            self.send_header("X-Capture-Meta",
+                             json.dumps({"iso": 400, "exposure_ns": 1000}))
+            self.send_header("Content-Length", str(len(jpeg)))
+            self.end_headers()
+            self.wfile.write(jpeg)
+        else:  # /settings echoes back what it applied
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_push_camera_protocol(tmp_path):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _AndroidHostStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        cam = PushCamera(f"http://127.0.0.1:{httpd.server_address[1]}")
+        assert cam.status()["camera"] == "open"
+        assert "iso_range" in cam.capabilities()
+        from structured_light_for_3d_model_replication_tpu.hw import (
+            CameraSettings,
+        )
+        echoed = cam.apply_settings(CameraSettings(iso=800))
+        assert echoed["iso"] == 800 and echoed["ae_mode"] == "off"
+        out = str(tmp_path / "push.jpg")
+        assert cam.capture(out)
+        assert cam.last_meta == {"iso": 400, "exposure_ns": 1000}
+        assert open(out, "rb").read().startswith(b"\xff\xd8")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Virtual rig + scanner orchestration
+# ---------------------------------------------------------------------------
+
+
+def _make_scanner(tmp_path, turntable=True):
+    rig = VirtualRig(proj=TINY, cam_height=24, cam_width=40)
+    layout = SessionLayout(root=str(tmp_path / "session")).ensure()
+    sc = scan_mod.Scanner(
+        rig.camera, rig.projector,
+        turntable=rig.turntable if turntable else None,
+        proj=TINY, layout=layout, settle_s=0.0)
+    return rig, sc
+
+
+def test_capture_stack_matches_render_scan(tmp_path):
+    rig, sc = _make_scanner(tmp_path)
+    out = sc.capture_scan("obj")
+    from structured_light_for_3d_model_replication_tpu.io.images import (
+        load_stack,
+    )
+    stack = load_stack(out)
+    want, _ = synthetic.render_scan(
+        rig.scene, rig.cam_K, rig.proj_K, rig.R, rig.T,
+        rig.cam_height, rig.cam_width, TINY)
+    assert stack.shape == (TINY.n_frames, 24, 40)
+    np.testing.assert_array_equal(stack, want)
+
+
+def test_auto_scan_rotates_scene_and_resumes(tmp_path):
+    rig, sc = _make_scanner(tmp_path)
+    rig.turntable.time_scale = 0.001
+    progress = []
+    stops = sc.auto_scan_360("obj", degrees_per_turn=120.0, turns=3,
+                             on_progress=progress.append)
+    assert len(stops) == 3
+    assert all(os.path.isdir(s) for s in stops)
+    # The turntable really rotated the scene between stops: the object
+    # (asymmetric bump) moves, so the white frames differ somewhere.
+    from structured_light_for_3d_model_replication_tpu.io.images import (
+        load_stack,
+    )
+    s0 = load_stack(stops[0])
+    s1 = load_stack(stops[1])
+    assert (s0[0] != s1[0]).any()
+    assert [p.stop for p in progress] == [1, 2, 3]
+    assert progress[-1].remaining_s == pytest.approx(0.0)
+
+    # Resume: a second run captures nothing new (camera disabled proves it).
+    sc.camera = None
+    stops2 = sc.auto_scan_360("obj", degrees_per_turn=120.0, turns=3)
+    assert stops2 == stops
+
+
+def test_capture_abort_on_camera_timeout(tmp_path):
+    class DeadCamera:
+        connected = False
+
+        def capture(self, path):
+            return False
+
+    rig, sc = _make_scanner(tmp_path)
+    sc.camera = DeadCamera()
+    with pytest.raises(scan_mod.ScanAborted):
+        sc.capture_scan("obj")
+
+
+def test_virtual_projector_rejects_wrong_shape():
+    vp = VirtualProjector(TINY)
+    with pytest.raises(ValueError):
+        vp.show(np.zeros((8, 8), np.uint8))
+
+
+def test_rig_ground_truth_tracks_angle():
+    rig = VirtualRig(proj=TINY, cam_height=24, cam_width=40)
+    rig.turntable.time_scale = 0.001
+    gt0 = rig.ground_truth["object_mask"].copy()
+    rig.turntable.rotate(90.0)
+    rig.turntable.wait_for_done(5.0)
+    gt1 = rig.ground_truth["object_mask"]
+    assert (gt0 != gt1).any()
+
+
+def test_pattern_protocol_order(tmp_path):
+    """The displayed sequence is white, black, then pattern/inverse pairs."""
+    rig, sc = _make_scanner(tmp_path)
+    sc.capture_scan("proto")
+    frames = rig.projector.history
+    assert len(frames) == TINY.n_frames
+    want = np.asarray(pattern_stack_for(TINY))
+    for got, exp in zip(frames, want):
+        np.testing.assert_array_equal(got, exp)

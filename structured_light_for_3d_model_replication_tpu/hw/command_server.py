@@ -92,6 +92,7 @@ class CommandChannel:
             # Only an ARMED capture accepts an upload; anything else is a
             # stray (double upload, or a late one from a timed-out command).
             path = self._save_path if self._command == "capture" else None
+            cmd_id = self._command_id
         if path is None:
             raise RuntimeError("upload with no capture armed")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -99,7 +100,12 @@ class CommandChannel:
             f.write(data)
         if self.on_upload is not None:
             self.on_upload(path)
-        self._uploaded.set()
+        with self._lock:
+            # Signal only if THIS command is still the armed one: a slow
+            # upload that straddles a timeout + re-arm must not satisfy the
+            # NEXT capture (its file was written to the old path).
+            if self._command_id == cmd_id:
+                self._uploaded.set()
         return path
 
 

@@ -45,7 +45,10 @@ class WindowProjector:
         """Display the frame and block for the projection dwell so the
         camera sees a settled image (`server/sl_system.py:464-465`)."""
         self._cv2.imshow(self.WINDOW_NAME, np.asarray(frame))
-        self._cv2.waitKey(self.dwell_ms if dwell_ms is None else dwell_ms)
+        # waitKey(0) means "block for a keypress" to OpenCV — clamp so a
+        # zero dwell pumps the event loop without hanging the scan.
+        self._cv2.waitKey(max(1, self.dwell_ms if dwell_ms is None
+                              else dwell_ms))
 
     def close(self) -> None:
         self._cv2.destroyWindow(self.WINDOW_NAME)

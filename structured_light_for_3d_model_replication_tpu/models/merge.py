@@ -65,12 +65,31 @@ class MergeParams:
     final_std_ratio: float = 2.0
     loop_closure: bool = True         # pose-graph variant only
     posegraph_iterations: int = 50
+    # Per-scan point cap for REGISTRATION (the KNN/FPFH/ICP stages are
+    # O(M²) tiled matmuls, so M must stay bounded regardless of capture
+    # resolution). Registration on a subsample is exactly what the reference
+    # does too — its per-pair preprocess voxel-downsamples before ICP
+    # (`server/processing.py:83,146-147`); poses from the subsample are
+    # applied to the FULL clouds at merge time.
+    max_points: int = 16_384
+    # Slot cap for the FINAL cleanup chain after the global voxel downsample
+    # (the SOR KNN is O(M²) too). Voxel-downsampled cells land in a
+    # contiguous valid prefix, so when the padded merge exceeds this cap a
+    # uniform random compaction bounds the cleanup cost.
+    final_max_points: int = 1_048_576
 
 
 class _Padded:
-    """N clouds stacked to one (N, M, 3) array + valid masks (+ colors)."""
+    """N clouds stacked to one (N, M, 3) array + valid masks (+ colors).
 
-    def __init__(self, clouds: Sequence[ply_io.PointCloud]):
+    Holds BOTH the full-resolution stack (for the final merge) and a
+    registration view capped at ``max_points`` per scan (for the O(M²)
+    KNN/FPFH/ICP stages). When a cloud exceeds the cap, a deterministic
+    uniform subsample stands in for registration only.
+    """
+
+    def __init__(self, clouds: Sequence[ply_io.PointCloud],
+                 max_points: int | None = None):
         if len(clouds) < 2:
             raise ValueError("need at least two clouds to merge")
         m = _round_up(max(len(c.points) for c in clouds))
@@ -89,6 +108,26 @@ class _Padded:
         self.valid = jnp.asarray(val)
         self.colors = jnp.asarray(col)
         self.counts = [len(c.points) for c in clouds]
+
+        if max_points is not None and m > _round_up(max_points):
+            mr = _round_up(max_points)
+            rpts = np.zeros((n, mr, 3), np.float32)
+            rval = np.zeros((n, mr), bool)
+            rng = np.random.default_rng(0)
+            for i, c in enumerate(clouds):
+                k = len(c.points)
+                if k > mr:
+                    sel = rng.choice(k, mr, replace=False)
+                    rpts[i] = c.points[sel]
+                    rval[i] = True
+                else:
+                    rpts[i, :k] = c.points
+                    rval[i, :k] = True
+            self.reg_points = jnp.asarray(rpts)
+            self.reg_valid = jnp.asarray(rval)
+        else:
+            self.reg_points = self.points
+            self.reg_valid = self.valid
 
 
 # ---------------------------------------------------------------------------
@@ -160,11 +199,13 @@ def _register_preprocessed(src, dst, params: MergeParams, key=None):
     return fine, info
 
 
-def register_sequence(padded: _Padded, params: MergeParams,
+def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
+                      params: MergeParams,
                       loop_closure: bool = False, key=None):
     """Edge transforms for the ring: seq edge i maps scan i+1 into scan i's
     frame; the optional loop edge maps scan 0 into scan N-1's frame
-    (`Old/360Merge.py:53-56`).
+    (`Old/360Merge.py:53-56`). ``points`` is the padded (N, M, 3) stack with
+    its (N, M) valid mask — M should already be capped (see ``_Padded``).
 
     Python loop over a once-compiled pair step — identical static shapes per
     edge mean a single XLA program, executed N-1 (+1) times back-to-back on
@@ -172,10 +213,10 @@ def register_sequence(padded: _Padded, params: MergeParams,
     """
     if key is None:
         key = jax.random.PRNGKey(0)
-    n = padded.points.shape[0]
+    n = points.shape[0]
     keys = jax.random.split(key, n)
     pre = [
-        _preprocess(padded.points[i], padded.valid[i], params.voxel_size,
+        _preprocess(points[i], valid[i], params.voxel_size,
                     params.normals_k, params.fpfh_max_nn)
         for i in range(n)
     ]
@@ -208,6 +249,13 @@ def _finalize(points, colors, valid, params: MergeParams,
     → statistical outlier removal → normals. Returns a compact host cloud."""
     dpts, dcol, dvalid, _ = pointcloud.voxel_downsample(
         points, params.voxel_size, valid=valid, attrs=colors, with_attrs=True)
+    cap = _round_up(params.final_max_points)
+    if dpts.shape[0] > cap:
+        # Bound the O(M²) SOR below: uniform random compaction of the voxel
+        # cells into `cap` static slots (drops cells only if more than `cap`
+        # survive the downsample).
+        dpts, dcol, dvalid = pointcloud.random_subsample(
+            dpts, cap, valid=dvalid, attrs=dcol, key=jax.random.PRNGKey(7))
     keep = pointcloud.statistical_outlier_removal(
         dpts, valid=dvalid,
         nb_neighbors=params.final_nb_neighbors,
@@ -249,9 +297,9 @@ def merge_pro_360(
     loop closure. Returns (merged PointCloud, poses (N,4,4) np.ndarray).
     """
     params = params or MergeParams()
-    padded = _Padded(clouds)
-    seq_T, _, _, _, _ = register_sequence(padded, params,
-                                          loop_closure=False, key=key)
+    padded = _Padded(clouds, max_points=params.max_points)
+    seq_T, _, _, _, _ = register_sequence(padded.reg_points, padded.reg_valid,
+                                          params, loop_closure=False, key=key)
     poses = posegraph.chain_poses(seq_T)
     merged = _apply_poses_and_merge(padded, poses, params)
     log.info("merge_pro_360: %d scans → %d points", len(clouds), len(merged))
@@ -269,9 +317,10 @@ def merge_posegraph_360(
     optimized poses. Returns (merged PointCloud, poses (N,4,4) np.ndarray).
     """
     params = params or MergeParams()
-    padded = _Padded(clouds)
+    padded = _Padded(clouds, max_points=params.max_points)
     seq_T, seq_info, loop_T, loop_info, _ = register_sequence(
-        padded, params, loop_closure=params.loop_closure, key=key)
+        padded.reg_points, padded.reg_valid, params,
+        loop_closure=params.loop_closure, key=key)
     graph = posegraph.build_360_graph(seq_T, seq_info, loop_T, loop_info)
     poses = posegraph.optimize(graph, iterations=params.posegraph_iterations)
     merged = _apply_poses_and_merge(padded, poses, params)

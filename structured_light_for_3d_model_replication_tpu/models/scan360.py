@@ -1,0 +1,174 @@
+"""The flagship end-to-end workflow: 360° capture stacks → merged cloud.
+
+This is the whole compute path of the reference's auto-scan post-processing
+run as one device-resident program chain: the GUI's per-stop
+`SLSystem.generate_cloud` (`server/sl_system.py:483-653`) followed by
+`ProcessingLogic.merge_pro_360` (`server/processing.py:115-181`) — but where
+the reference round-trips every stage through image files and ASCII PLYs, this
+pipeline keeps everything in HBM from the raw uint8 stacks to the final merged
+cloud:
+
+1. batched decode+triangulate of all N stops (one vmapped XLA program);
+2. per-stop fixed-size random subsample (static-shape stand-in for the
+   reference's pre-ICP voxel downsample, `server/processing.py:83`);
+3. ring registration — FPFH + feature RANSAC + point-to-plane ICP per edge
+   (`server/processing.py:146-156`), optionally with the loop-closure edge and
+   pose-graph LM of the legacy merge (`Old/360Merge.py:43-84`);
+4. every FULL-resolution cloud transformed by its pose and merged through the
+   final voxel → SOR → normals cleanup (`server/processing.py:171-181`).
+
+The only host↔device traffic is the input stacks in and the final compacted
+cloud out. This file is the north-star benchmark target (BASELINE.md: 24
+stops × 46 frames @1080p in < 2 s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import DecodeConfig, TriangulationConfig
+from ..io import ply as ply_io
+from ..ops import pointcloud, posegraph, registration
+from ..ops.triangulate import Calibration
+from ..utils.log import get_logger
+from . import merge as merge_mod
+from . import pipeline as pipeline_mod
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Scan360Params:
+    """End-to-end knobs. ``merge`` carries the registration/cleanup settings
+    (reference GUI defaults); ``view_cap`` bounds each stop's contribution to
+    the final full-resolution merge (slots, post voxel-downsample)."""
+
+    merge: merge_mod.MergeParams = merge_mod.MergeParams()
+    method: str = "sequential"  # or "posegraph"
+    view_cap: int = 131_072
+
+
+def scan_stacks_to_cloud(
+    stacks: jnp.ndarray,
+    calib: Calibration,
+    col_bits: int,
+    row_bits: int,
+    params: Scan360Params = Scan360Params(),
+    decode_cfg: DecodeConfig = DecodeConfig(),
+    tri_cfg: TriangulationConfig = TriangulationConfig(),
+    key=None,
+):
+    """(N, F, H, W) uint8 capture stacks → (merged PointCloud, poses (N,4,4)).
+
+    Stops are assumed in turntable order (stop i+1 photographed after one
+    rotation step), which is what the ring registration chain relies on —
+    same assumption as the reference's numeric filename sort
+    (`Old/new360Merge.py:7-20`).
+    """
+    if params.method not in ("sequential", "posegraph"):
+        raise ValueError(f"method must be 'sequential' or 'posegraph', "
+                         f"got {params.method!r}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    n = stacks.shape[0]
+    mp = params.merge
+
+    # 1. Decode + triangulate every stop in one vmapped program.
+    recon = pipeline_mod.reconstruct_batch_fn(col_bits, row_bits, decode_cfg,
+                                              tri_cfg)
+    res = recon(stacks, calib)
+
+    # 2. Fixed-size registration view of each stop (device-side). Clamped to
+    # the slot count: a small camera may have fewer pixels than the cap
+    # (top_k needs m ≤ n).
+    m_reg = min(merge_mod._round_up(mp.max_points), res.points.shape[1])
+    k_sub, k_reg = jax.random.split(key)
+    sub_keys = jax.random.split(k_sub, n)
+    reg_pts, _, reg_val = jax.vmap(
+        lambda p, v, k: pointcloud.random_subsample(p, m_reg, valid=v, key=k)
+    )(res.points, res.valid, sub_keys)
+
+    # 3. Ring registration → per-stop poses.
+    loop = params.method == "posegraph" and mp.loop_closure
+    seq_T, seq_info, loop_T, loop_info, _ = merge_mod.register_sequence(
+        reg_pts, reg_val, mp, loop_closure=loop, key=k_reg)
+    if params.method == "posegraph":
+        graph = posegraph.build_360_graph(seq_T, seq_info, loop_T, loop_info)
+        poses = posegraph.optimize(graph, iterations=mp.posegraph_iterations)
+    else:
+        poses = posegraph.chain_poses(seq_T)
+
+    # 4. Merge the FULL-resolution clouds under the poses. Each stop is first
+    # reduced per-view (voxel downsample, then a uniform random compaction
+    # into view_cap static slots — unbiased even when more than view_cap
+    # cells survive; a prefix slice would chop off one spatial side, since
+    # cells come out in lexicographic order), then the final global cleanup
+    # chain runs on the concatenation.
+    view_cap = merge_mod._round_up(min(params.view_cap, res.points.shape[1]))
+
+    def reduce_view(pose, pts, colors, valid, k):
+        moved = registration.transform_points(pose, pts)
+        dpts, dcol, dvalid, _ = pointcloud.voxel_downsample(
+            moved, mp.voxel_size, valid=valid,
+            attrs=colors.astype(jnp.float32), with_attrs=True)
+        return pointcloud.random_subsample(dpts, view_cap, valid=dvalid,
+                                           attrs=dcol, key=k)
+
+    view_keys = jax.random.split(jax.random.fold_in(key, 1), n)
+    vpts, vcol, vval = jax.vmap(reduce_view)(
+        jnp.asarray(poses, jnp.float32), res.points, res.colors, res.valid,
+        view_keys)
+    merged = merge_mod._finalize(
+        vpts.reshape(-1, 3), vcol.reshape(-1, 3), vval.reshape(-1), mp,
+        has_colors=True)
+    log.info("scan_stacks_to_cloud: %d stops → %d points (%s)", n,
+             len(merged), params.method)
+    return merged, np.asarray(poses)
+
+
+def scan_folders_to_cloud(
+    stop_dirs,
+    calib_path: str,
+    output_path: str | None = None,
+    col_bits: int | None = None,
+    row_bits: int | None = None,
+    params: Scan360Params = Scan360Params(),
+    decode_cfg: DecodeConfig = DecodeConfig(),
+    tri_cfg: TriangulationConfig = TriangulationConfig(),
+    key=None,
+):
+    """File-level wrapper: a list of per-stop frame folders + a `.mat`
+    calibration → merged cloud (optionally written to ``output_path``).
+
+    Mirrors the reference's batch flow (`multi_point_cloud_process.py`
+    followed by the merge tab) with the file round-trips removed.
+    """
+    import math
+
+    from ..io import images as img_io
+    from ..io import matcal
+
+    stacks = np.stack([img_io.load_stack(d) for d in stop_dirs])
+    _, _, h, w = stacks.shape
+    cal = matcal.load_calibration_mat(calib_path, h, w)
+    # Bit counts follow the projector extent, `ceil(log2(dim))` — exactly how
+    # the reference sizes its Gray sequences (`server/sl_system.py:52-54`).
+    if col_bits is None:
+        col_bits = math.ceil(math.log2(cal.plane_cols.shape[0]))
+    if row_bits is None:
+        row_bits = math.ceil(math.log2(cal.plane_rows.shape[0]))
+    expect = 2 + 2 * (col_bits + row_bits)
+    if stacks.shape[1] != expect:
+        raise ValueError(
+            f"stack has {stacks.shape[1]} frames but {col_bits}+{row_bits} "
+            f"bits imply {expect} (white, black, then pattern/inverse pairs)")
+    merged, poses = scan_stacks_to_cloud(
+        jnp.asarray(stacks), cal, col_bits, row_bits,
+        params=params, decode_cfg=decode_cfg, tri_cfg=tri_cfg, key=key)
+    if output_path is not None:
+        ply_io.write_ply(output_path, merged)
+    return merged, poses

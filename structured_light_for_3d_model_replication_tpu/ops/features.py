@@ -93,7 +93,8 @@ def fpfh(
     wgt = jnp.where(pair_ok, 1.0 / jnp.maximum(dist, 1e-12), 0.0)  # (N, K)
     nb_spfh = spfh[idx]  # (N, K, 33)
     wsum = jnp.maximum(jnp.sum(wgt, axis=1), 1e-12)[:, None]
-    f = spfh + jnp.einsum("nk,nkf->nf", wgt, nb_spfh) / wsum
+    f = spfh + jnp.einsum("nk,nkf->nf", wgt, nb_spfh,
+                          precision=jax.lax.Precision.HIGHEST) / wsum
 
     # L1-normalize each 11-bin sub-histogram to 100.
     f3 = f.reshape(n, 3, N_BINS)

@@ -143,6 +143,49 @@ def radius_outlier_removal(
 
 
 # ---------------------------------------------------------------------------
+# Fixed-size subsampling (static-shape compaction)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def random_subsample(
+    points: jnp.ndarray,
+    m: int,
+    valid: jnp.ndarray | None = None,
+    attrs: jnp.ndarray | None = None,
+    key=None,
+):
+    """Uniform random subset of the VALID points, compacted to a static size.
+
+    Returns ``(out_points (m,3), out_attrs (m,...) or None, out_valid (m,))``.
+    When fewer than ``m`` valid points exist, every valid point is kept and
+    the surplus slots are masked off. This is the static-shape bridge between
+    the dense per-pixel pipeline (H·W slots, most invalid) and the cloud ops
+    (registration wants a few thousand well-spread points): Open3D gets the
+    same effect from ``voxel_down_sample`` before ICP
+    (`server/processing.py:83`); a random subset is the shape-static analogue.
+    """
+    n = points.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, dtype=bool)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    # Valid points get a random positive score, invalid -inf: top_k picks a
+    # uniform random m-subset of the valid set, never a padded slot (unless
+    # fewer than m valid points exist — then out_valid masks the surplus).
+    score = jnp.where(valid, jax.random.uniform(key, (n,)), -jnp.inf)
+    _, idx = jax.lax.top_k(score, m)
+    out_valid = valid[idx]
+    out_points = jnp.where(out_valid[:, None], points[idx], 0.0)
+    out_attrs = None
+    if attrs is not None:
+        taken = attrs[idx]
+        mask = out_valid.reshape((m,) + (1,) * (taken.ndim - 1))
+        out_attrs = jnp.where(mask, taken, 0)
+    return out_points, out_attrs, out_valid
+
+
+# ---------------------------------------------------------------------------
 # Normals: analytic 3×3 symmetric eigensolver (branch-free, vmapped)
 # ---------------------------------------------------------------------------
 

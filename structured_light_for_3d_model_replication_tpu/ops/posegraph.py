@@ -109,19 +109,22 @@ def optimize(
             xi_full = jnp.concatenate([jnp.zeros((1, 6), xi.dtype),
                                        xi.reshape(n - 1, 6)], axis=0)
             deltas = jax.vmap(lambda v: exp_se3(v[:3], v[3:]))(xi_full)
-            return jnp.einsum("nij,njk->nik", poses, deltas)
+            return jnp.einsum("nij,njk->nik", poses, deltas,
+                              precision=jax.lax.Precision.HIGHEST)
 
         def residuals(xi, poses):
             P = apply_delta(poses, xi)
             Xi = P[graph.edge_src]
             Xj_inv = jnp.linalg.inv(P[graph.edge_dst])
-            E = jnp.einsum("eij,ejk,ekl->eil", Tinv, Xj_inv, Xi)
+            E = jnp.einsum("eij,ejk,ekl->eil", Tinv, Xj_inv, Xi,
+                            precision=jax.lax.Precision.HIGHEST)
             r_rot = log_so3(E[:, :3, :3])
             r_t = E[:, :3, 3]
             return jnp.concatenate([r_rot, r_t], axis=-1)  # (E, 6)
 
         def cost_of(r):
-            return jnp.sum(jnp.einsum("ei,eij,ej->e", r, info, r))
+            return jnp.sum(jnp.einsum("ei,eij,ej->e", r, info, r,
+                                     precision=jax.lax.Precision.HIGHEST))
 
         def step(carry, _):
             poses, lam = carry
@@ -129,9 +132,12 @@ def optimize(
             r = residuals(zero, poses)                       # (E, 6)
             J = jax.jacfwd(lambda x: residuals(x, poses))(zero)  # (E, 6, nv)
             # H = Σ_e J_eᵀ Λ_e J_e ; g = Σ_e J_eᵀ Λ_e r_e
-            JL = jnp.einsum("eij,eik->ejk", info, J)         # Λᵀ=Λ
-            H = jnp.einsum("eiv,eiw->vw", J, JL)
-            g = jnp.einsum("eiv,eij,ej->v", J, info, r)
+            JL = jnp.einsum("eij,eik->ejk", info, J,
+                            precision=jax.lax.Precision.HIGHEST)         # Λᵀ=Λ
+            H = jnp.einsum("eiv,eiw->vw", J, JL,
+                            precision=jax.lax.Precision.HIGHEST)
+            g = jnp.einsum("eiv,eij,ej->v", J, info, r,
+                            precision=jax.lax.Precision.HIGHEST)
             delta = -jnp.linalg.solve(
                 H + lam * jnp.eye(nv, dtype=H.dtype), g
             )

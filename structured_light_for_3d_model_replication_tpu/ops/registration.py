@@ -85,11 +85,12 @@ def kabsch(
     H = jnp.einsum("...ni,...nj->...ij", s, d, precision=hi)
     U, _, Vt = jnp.linalg.svd(H)
     det = jnp.linalg.det(jnp.einsum("...ij,...jk->...ik", Vt.swapaxes(-1, -2),
-                                    U.swapaxes(-1, -2)))
+                                    U.swapaxes(-1, -2), precision=hi))
     D = jnp.ones(H.shape[:-2] + (3,), H.dtype)
     D = D.at[..., 2].set(det)
     R = jnp.einsum("...ji,...j,...kj->...ik", Vt, D, U, precision=hi)
-    t = cd[..., 0, :] - jnp.einsum("...ij,...j->...i", R, cs[..., 0, :])
+    t = cd[..., 0, :] - jnp.einsum("...ij,...j->...i", R, cs[..., 0, :],
+                                   precision=hi)
     T = jnp.zeros(H.shape[:-2] + (4, 4), H.dtype)
     T = T.at[..., :3, :3].set(R)
     T = T.at[..., :3, 3].set(t)

@@ -49,8 +49,9 @@ def segment_plane(
         ln = jnp.linalg.norm(nrm)
         ok = (ln > 1e-12) & jnp.all(valid[i])
         nrm = nrm / jnp.where(ln > 1e-12, ln, 1.0)
-        d = -jnp.dot(nrm, p0)
-        dist = jnp.abs(pts @ nrm + d)
+        d = -jnp.dot(nrm, p0, precision=jax.lax.Precision.HIGHEST)
+        dist = jnp.abs(jnp.einsum("ni,i->n", pts, nrm,
+                                  precision=jax.lax.Precision.HIGHEST) + d)
         cnt = jnp.sum((dist <= distance_threshold) * vf)
         return jnp.concatenate([nrm, d[None]]), jnp.where(ok, cnt, -1.0)
 
@@ -82,9 +83,11 @@ def segment_plane(
     C = jnp.einsum("ni,nj->ij", xc, xc,
                    precision=jax.lax.Precision.HIGHEST) / cnt
     nrm = smallest_eigenvector_sym3(C)
-    d = -jnp.dot(nrm, mu)
+    d = -jnp.dot(nrm, mu, precision=jax.lax.Precision.HIGHEST)
     refit = jnp.concatenate([nrm, d[None]])
-    refit_inl = (jnp.abs(pts @ nrm + d) <= distance_threshold) & valid
+    refit_inl = (jnp.abs(jnp.einsum("ni,i->n", pts, nrm,
+                                    precision=jax.lax.Precision.HIGHEST) + d)
+                 <= distance_threshold) & valid
     use_refit = jnp.sum(refit_inl) >= jnp.sum(inl)
     plane = jnp.where(use_refit, refit, best)
     inliers = jnp.where(use_refit, refit_inl, inl)

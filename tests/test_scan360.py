@@ -1,0 +1,111 @@
+"""End-to-end fused 360° pipeline: stacks → registered merged cloud."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from structured_light_for_3d_model_replication_tpu.models import (
+    merge,
+    scan360,
+    synthetic,
+)
+from structured_light_for_3d_model_replication_tpu.ops import pointcloud
+from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+    make_calibration,
+)
+
+from .conftest import CAM_H, CAM_W, SMALL_PROJ
+
+
+def test_random_subsample_static_shape(rng):
+    pts = jnp.asarray(rng.normal(size=(503, 3)).astype(np.float32))
+    valid = jnp.asarray(rng.random(503) > 0.4)
+    out, _, ov = pointcloud.random_subsample(pts, 128, valid=valid)
+    assert out.shape == (128, 3) and ov.shape == (128,)
+    assert bool(ov.all())  # plenty of valid points to fill 128 slots
+    # Every selected point really is one of the valid inputs.
+    src = np.asarray(pts)[np.asarray(valid)]
+    sel = np.asarray(out)
+    assert all(np.isclose(src, p, atol=0).all(1).any() for p in sel)
+
+
+def test_random_subsample_fewer_valid_than_m(rng):
+    pts = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    valid = jnp.zeros(64, bool).at[:10].set(True)
+    attrs = jnp.asarray(rng.integers(0, 255, (64, 3)).astype(np.float32))
+    out, oa, ov = pointcloud.random_subsample(pts, 32, valid=valid,
+                                              attrs=attrs)
+    assert int(ov.sum()) == 10
+    assert np.all(np.asarray(out)[~np.asarray(ov)] == 0)
+    assert oa.shape == (32, 3)
+
+
+@pytest.fixture(scope="module")
+def turntable_stacks(synth_rig):
+    cam_K, proj_K, R, T = synth_rig
+    # No wall (merged object only) and strongly asymmetric geometry: bumps
+    # well off the turntable axis give the ring registration rotation signal
+    # (a lone on-axis sphere is rotation-invariant and would let ICP slide).
+    scene = synthetic.Scene(
+        wall_z=None,
+        spheres=(
+            synthetic.Sphere((0.0, 10.0, 500.0), 80.0, 0.9),
+            synthetic.Sphere((60.0, -40.0, 460.0), 35.0, 0.7),
+            synthetic.Sphere((-70.0, 40.0, 530.0), 30.0, 0.8),
+            synthetic.Sphere((20.0, 70.0, 440.0), 25.0, 0.75),
+        ),
+    )
+    scans = synthetic.render_turntable_scans(
+        scene, n_stops=4, degrees_per_stop=10.0,
+        cam_K=cam_K, proj_K=proj_K, R=R, T=T,
+        cam_height=CAM_H, cam_width=CAM_W, proj=SMALL_PROJ)
+    stacks = np.stack([s for s, _ in scans])
+    return stacks, (cam_K, proj_K, R, T)
+
+
+FAST = scan360.Scan360Params(
+    merge=merge.MergeParams(
+        voxel_size=6.0,           # mm, synthetic scene scale
+        ransac_iterations=2048,
+        icp_iterations=20,
+        fpfh_max_nn=32,
+        normals_k=12,
+        max_points=2048,
+        posegraph_iterations=20,
+    ),
+    view_cap=8192,
+)
+
+
+@pytest.mark.parametrize("method", ["sequential", "posegraph"])
+def test_scan_stacks_to_cloud(turntable_stacks, method):
+    stacks, (cam_K, proj_K, R, T) = turntable_stacks
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    params = scan360.Scan360Params(merge=FAST.merge, method=method,
+                                   view_cap=FAST.view_cap)
+    merged, poses = scan360.scan_stacks_to_cloud(
+        jnp.asarray(stacks), calib, SMALL_PROJ.col_bits, SMALL_PROJ.row_bits,
+        params=params)
+    assert poses.shape == (4, 4, 4)
+    assert len(merged) > 200
+    assert merged.colors is not None and merged.normals is not None
+    # Pose i should rotate by ≈ +i·10° about the (vertical) turntable axis:
+    # check the rotation angle magnitude of pose 1 is ~10°.
+    R1 = poses[1][:3, :3]
+    angle = np.degrees(np.arccos(np.clip((np.trace(R1) - 1) / 2, -1, 1)))
+    assert abs(angle - 10.0) < 3.0, f"pose-1 angle {angle}°, expected ≈10°"
+
+
+def test_scan_stacks_method_validation(turntable_stacks):
+    stacks, (cam_K, proj_K, R, T) = turntable_stacks
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    with pytest.raises(ValueError, match="method"):
+        scan360.scan_stacks_to_cloud(
+            jnp.asarray(stacks), calib, SMALL_PROJ.col_bits,
+            SMALL_PROJ.row_bits,
+            params=scan360.Scan360Params(method="nope"))

@@ -31,6 +31,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Sequence
 
 import jax
@@ -40,6 +41,7 @@ import numpy as np
 from ..io import ply as ply_io
 from ..io.layout import list_clouds
 from ..ops import features, pointcloud, posegraph, registration, segmentation
+from ..ops.knn import knn
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -70,8 +72,10 @@ class MergeParams:
     # resolution). Registration on a subsample is exactly what the reference
     # does too — its per-pair preprocess voxel-downsamples before ICP
     # (`server/processing.py:83,146-147`); poses from the subsample are
-    # applied to the FULL clouds at merge time.
-    max_points: int = 16_384
+    # applied to the FULL clouds at merge time. 8192 is comparable to the
+    # point counts the reference's voxel-downsampled clouds actually carry
+    # into RANSAC/ICP, and the O(M²) stages scale 4× per halving.
+    max_points: int = 8_192
     # Slot cap for the FINAL cleanup chain after the global voxel downsample
     # (the SOR KNN is O(M²) too). Voxel-downsampled cells land in a
     # contiguous valid prefix, so when the padded merge exceeds this cap a
@@ -166,42 +170,147 @@ def register_pair(
     return _register_preprocessed(src, dst, params, key=key)
 
 
+@functools.lru_cache(maxsize=None)
+def _edge_fn(params: MergeParams):
+    """ONE jitted program for a whole edge registration (RANSAC → ICP →
+    information matrix). Fusing the edge matters beyond XLA fusion: each
+    eager op or separate jit call is a device round trip, and on a remote
+    (tunneled) TPU a 23-edge ring at ~10 launches/edge pays seconds of pure
+    latency. params is a frozen dataclass → hashable cache key."""
+
+    return jax.jit(_edge_body(params))
+
+
+@functools.lru_cache(maxsize=None)
+def _edge_body(params: MergeParams):
+    """The edge registration math, unjitted — shared by the per-edge jit
+    (:func:`_edge_fn`) and the whole-ring ``lax.scan`` (:func:`_ring_fn`),
+    where it becomes the scan body compiled ONCE for all edges."""
+    it = params.icp_iterations
+    # Coarse-to-fine correspondence radius (geometric 4→1 over the ICP
+    # iterations): converges from rough inits where a fixed tight radius
+    # finds zero correspondences and stalls.
+    anneal = tuple(float(4.0 ** (1.0 - i / max(it - 1, 1)))
+                   for i in range(it))
+
+    def run(s_pts, s_val, s_feat, d_pts, d_val, d_nrm, d_feat, key, hint):
+        v = params.voxel_size
+        coarse = registration.ransac_feature_registration(
+            s_pts, s_feat, d_pts, d_feat,
+            distance_threshold=1.5 * v,
+            src_valid=s_val, dst_valid=d_val,
+            num_iterations=params.ransac_iterations,
+            key=key,
+        )
+
+        # Feature RANSAC can fail outright on feature-poor geometry (a
+        # smooth surface of revolution gives FPFH almost no signal). Pick
+        # the best of {RANSAC result, caller's hint (e.g. the previous ring
+        # edge — a turntable rotates by a constant step), identity} by
+        # correspondence count at a loose radius, then anneal ICP down.
+        cands = jnp.stack([coarse.transformation, hint,
+                           jnp.eye(4, dtype=jnp.float32)])
+
+        def count_corr(T):
+            moved = registration.transform_points(T, s_pts)
+            d2, _, nbv = knn(d_pts, 1, queries=moved, points_valid=d_val,
+                             queries_valid=s_val)
+            return jnp.sum(nbv[:, 0] & (d2[:, 0] <= (4.0 * v) ** 2))
+
+        counts = jax.vmap(count_corr)(cands)
+        init = cands[jnp.argmax(counts)]
+
+        fine = registration.icp(
+            s_pts, d_pts,
+            max_correspondence_distance=v,
+            init=init,
+            dst_normals=d_nrm,
+            src_valid=s_val, dst_valid=d_val,
+            max_iterations=it,
+            method="point_to_plane",
+            schedule=anneal,
+        )
+        info = registration.information_matrix(
+            s_pts, d_pts, fine.transformation,
+            max_correspondence_distance=v,
+            src_valid=s_val, dst_valid=d_val,
+        )
+        return fine.transformation, fine.fitness, fine.inlier_rmse, info
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_fn(params: MergeParams, n: int, loop_closure: bool):
+    """The ENTIRE ring — N per-stop preprocesses + N-1 (+ loop) edge
+    registrations — as ONE jitted program built from two ``lax.scan``s.
+
+    Why scan and not vmap: the edge body is itself scan-heavy (RANSAC
+    hypothesis batches, annealed ICP), and vmapping it explodes compile
+    time; ``lax.scan`` compiles the body once and reuses it per step. Why
+    one program at all: on a remote/tunneled TPU every launch is a network
+    round trip, and a 24-stop ring as ~50 launches pays seconds of pure
+    latency. The previous edge's transform rides the scan CARRY as the next
+    edge's init hint (a turntable advances by a constant step)."""
+    body = _edge_body(params)
+
+    def prep_body(carry, xs):
+        pts, val = xs
+        return carry, _preprocess(pts, val, params.voxel_size,
+                                  params.normals_k, params.fpfh_max_nn)
+
+    def edge_step(hint, xs):
+        T, fit, rmse, info = body(*xs, hint)
+        return T, (T, fit, rmse, info)
+
+    n_edges = n - 1 + int(loop_closure)
+    src_ix = tuple(range(1, n)) + ((0,) if loop_closure else ())
+    dst_ix = tuple(range(0, n - 1)) + ((n - 1,) if loop_closure else ())
+
+    def run(points, valid, keys):
+        _, pre = jax.lax.scan(prep_body, 0, (points, valid))
+        si = jnp.asarray(src_ix)
+        di = jnp.asarray(dst_ix)
+        xs = (pre[0][si], pre[1][si], pre[3][si],
+              pre[0][di], pre[1][di], pre[2][di], pre[3][di],
+              keys[:n_edges])
+        _, outs = jax.lax.scan(edge_step, jnp.eye(4, dtype=jnp.float32), xs)
+        return outs  # (T (E,4,4), fit (E,), rmse (E,), info (E,6,6))
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
+def _preprocess_fn(voxel: float, normals_k: int, fpfh_max_nn: int):
+    """Whole per-scan preprocess as one jitted program (same launch-count
+    rationale as :func:`_edge_fn`)."""
+
+    def run(pts, valid):
+        return _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn)
+
+    return jax.jit(run)
+
+
+
+
 def _register_preprocessed(src, dst, params: MergeParams, key=None):
     """Pair registration on already-preprocessed (pts, valid, normals, feat)
     tuples — lets ring workflows preprocess each scan ONCE even though every
     scan serves as src of one edge and dst of another."""
     if key is None:
         key = jax.random.PRNGKey(0)
-    v = params.voxel_size
     s_pts, s_val, _, s_feat = src
     d_pts, d_val, d_nrm, d_feat = dst
-    coarse = registration.ransac_feature_registration(
-        s_pts, s_feat, d_pts, d_feat,
-        distance_threshold=1.5 * v,
-        src_valid=s_val, dst_valid=d_val,
-        num_iterations=params.ransac_iterations,
-        key=key,
-    )
-    fine = registration.icp(
-        s_pts, d_pts,
-        max_correspondence_distance=v,
-        init=coarse.transformation,
-        dst_normals=d_nrm,
-        src_valid=s_val, dst_valid=d_val,
-        max_iterations=params.icp_iterations,
-        method="point_to_plane",
-    )
-    info = registration.information_matrix(
-        s_pts, d_pts, fine.transformation,
-        max_correspondence_distance=v,
-        src_valid=s_val, dst_valid=d_val,
-    )
-    return fine, info
+    T, fitness, rmse, info = _edge_fn(params)(
+        s_pts, s_val, s_feat, d_pts, d_val, d_nrm, d_feat, key,
+        jnp.eye(4, dtype=jnp.float32))
+    return registration.RegistrationResult(T, fitness, rmse), info
 
 
 def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
                       params: MergeParams,
-                      loop_closure: bool = False, key=None):
+                      loop_closure: bool = False, key=None,
+                      strategy: str = "loop"):
     """Edge transforms for the ring: seq edge i maps scan i+1 into scan i's
     frame; the optional loop edge maps scan 0 into scan N-1's frame
     (`Old/360Merge.py:53-56`). ``points`` is the padded (N, M, 3) stack with
@@ -215,27 +324,55 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
         key = jax.random.PRNGKey(0)
     n = points.shape[0]
     keys = jax.random.split(key, n)
-    pre = [
-        _preprocess(points[i], valid[i], params.voxel_size,
-                    params.normals_k, params.fpfh_max_nn)
-        for i in range(n)
-    ]
-    seq_T, seq_info, fits = [], [], []
+
+    if strategy == "scan":
+        # One launch for the whole ring (lax.scan over stops and edges,
+        # see _ring_fn) — lowest dispatch latency, but the scan-of-scans
+        # program takes MUCH longer to compile cold; opt in when the
+        # persistent compilation cache is warm.
+        Ts, fit, rmse, infos = _ring_fn(params, n, loop_closure)(
+            points, valid, keys)
+    elif strategy == "loop":
+        # Python loop over two once-compiled programs (per-stop preprocess,
+        # per-edge registration). Dispatch stays fully async — the previous
+        # edge's transform chains into the next edge's init hint as a
+        # device array, and the single host sync happens at the
+        # diagnostics below.
+        prep = _preprocess_fn(params.voxel_size, params.normals_k,
+                              params.fpfh_max_nn)
+        edge = _edge_fn(params)
+        pre = [prep(points[i], valid[i]) for i in range(n)]
+        hint = jnp.eye(4, dtype=jnp.float32)
+        outs = []
+        for i in range(1, n):
+            s_pts, s_val, _, s_feat = pre[i]
+            d_pts, d_val, d_nrm, d_feat = pre[i - 1]
+            out = edge(s_pts, s_val, s_feat, d_pts, d_val, d_nrm, d_feat,
+                       keys[i - 1], hint)
+            outs.append(out)
+            hint = out[0]
+        if loop_closure:
+            s_pts, s_val, _, s_feat = pre[0]
+            d_pts, d_val, d_nrm, d_feat = pre[n - 1]
+            outs.append(edge(s_pts, s_val, s_feat, d_pts, d_val, d_nrm,
+                             d_feat, keys[n - 1], hint))
+        Ts = jnp.stack([o[0] for o in outs])
+        fit = jnp.stack([o[1] for o in outs])
+        rmse = jnp.stack([o[2] for o in outs])
+        infos = jnp.stack([o[3] for o in outs])
+    else:
+        raise ValueError(f"unknown ring strategy {strategy!r}")
+    fit_np = np.asarray(fit)
+    rmse_np = np.asarray(rmse)
     for i in range(1, n):
-        res, info = _register_preprocessed(pre[i], pre[i - 1], params,
-                                           key=keys[i - 1])
-        seq_T.append(res.transformation)
-        seq_info.append(info)
-        fits.append(float(res.fitness))
         log.info("edge %d→%d fitness=%.3f rmse=%.4f", i, i - 1,
-                 float(res.fitness), float(res.inlier_rmse))
+                 fit_np[i - 1], rmse_np[i - 1])
+    seq_T, seq_info = Ts[: n - 1], infos[: n - 1]
     loop_T = loop_info = None
     if loop_closure:
-        res, loop_info = _register_preprocessed(pre[0], pre[n - 1], params,
-                                                key=keys[n - 1])
-        loop_T = res.transformation
-        log.info("loop edge 0→%d fitness=%.3f", n - 1, float(res.fitness))
-    return (jnp.stack(seq_T), jnp.stack(seq_info), loop_T, loop_info, fits)
+        loop_T, loop_info = Ts[n - 1], infos[n - 1]
+        log.info("loop edge 0→%d fitness=%.3f", n - 1, fit_np[n - 1])
+    return (seq_T, seq_info, loop_T, loop_info, list(fit_np[: n - 1]))
 
 
 # ---------------------------------------------------------------------------
@@ -243,26 +380,40 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
+def _finalize_fn(params: MergeParams, cap: int):
+    """Device half of the final cleanup as ONE program (launch-count
+    discipline, see `_edge_fn`)."""
+
+    def run(points, colors, valid):
+        dpts, dcol, dvalid, _ = pointcloud.voxel_downsample(
+            points, params.voxel_size, valid=valid, attrs=colors,
+            with_attrs=True)
+        if dpts.shape[0] > cap:
+            # Bound the O(M²) SOR below: stratified decimation of the voxel
+            # cells into `cap` static slots (cells are in lexicographic
+            # order so the stride stays spatially spread).
+            dpts, dcol, dvalid = pointcloud.stratified_subsample(
+                dpts, cap, valid=dvalid, attrs=dcol)
+        keep = pointcloud.statistical_outlier_removal(
+            dpts, valid=dvalid,
+            nb_neighbors=params.final_nb_neighbors,
+            std_ratio=params.final_std_ratio)
+        normals, nvalid = pointcloud.estimate_normals(dpts, valid=keep,
+                                                      k=params.normals_k)
+        return dpts, dcol, normals, keep & nvalid
+
+    return jax.jit(run)
+
+
 def _finalize(points, colors, valid, params: MergeParams,
               has_colors: bool = True):
     """Final cleanup chain (`server/processing.py:171-181`): voxel downsample
     → statistical outlier removal → normals. Returns a compact host cloud."""
-    dpts, dcol, dvalid, _ = pointcloud.voxel_downsample(
-        points, params.voxel_size, valid=valid, attrs=colors, with_attrs=True)
     cap = _round_up(params.final_max_points)
-    if dpts.shape[0] > cap:
-        # Bound the O(M²) SOR below: uniform random compaction of the voxel
-        # cells into `cap` static slots (drops cells only if more than `cap`
-        # survive the downsample).
-        dpts, dcol, dvalid = pointcloud.random_subsample(
-            dpts, cap, valid=dvalid, attrs=dcol, key=jax.random.PRNGKey(7))
-    keep = pointcloud.statistical_outlier_removal(
-        dpts, valid=dvalid,
-        nb_neighbors=params.final_nb_neighbors,
-        std_ratio=params.final_std_ratio)
-    normals, nvalid = pointcloud.estimate_normals(dpts, valid=keep,
-                                                  k=params.normals_k)
-    keep_np = np.asarray(keep & nvalid)
+    dpts, dcol, normals, keep = _finalize_fn(params, cap)(
+        points, colors, valid)
+    keep_np = np.asarray(keep)
     colors_u8 = None
     if has_colors:
         colors_u8 = np.clip(np.asarray(dcol)[keep_np], 0,

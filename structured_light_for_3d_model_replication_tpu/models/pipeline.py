@@ -55,6 +55,19 @@ def reconstruct(
     return CloudResult(points, colors, valid, col_map, row_map)
 
 
+def to_point_cloud(res: CloudResult):
+    """Compact a (single-scan) CloudResult to a host PointCloud — the
+    file-boundary step the reference does inline in its PLY writer
+    (`server/sl_system.py:671-691`)."""
+    import numpy as np
+
+    from ..io.ply import PointCloud
+
+    keep = np.asarray(res.valid)
+    return PointCloud(points=np.asarray(res.points)[keep],
+                      colors=np.asarray(res.colors)[keep])
+
+
 @functools.lru_cache(maxsize=None)
 def reconstruct_batch_fn(col_bits: int, row_bits: int,
                          decode_cfg: DecodeConfig = DecodeConfig(),

@@ -8,8 +8,8 @@ the reference round-trips every stage through image files and ASCII PLYs, this
 pipeline keeps everything in HBM from the raw uint8 stacks to the final merged
 cloud:
 
-1. batched decode+triangulate of all N stops (one vmapped XLA program);
-2. per-stop fixed-size random subsample (static-shape stand-in for the
+1. batched decode+triangulate of all N stops (chunked vmapped XLA programs);
+2. per-stop fixed-size stratified subsample (static-shape stand-in for the
    reference's pre-ICP voxel downsample, `server/processing.py:83`);
 3. ring registration — FPFH + feature RANSAC + point-to-plane ICP per edge
    (`server/processing.py:146-156`), optionally with the loop-closure edge and
@@ -25,6 +25,7 @@ stops × 46 frames @1080p in < 2 s).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,7 @@ from ..config import DecodeConfig, TriangulationConfig
 from ..io import ply as ply_io
 from ..ops import pointcloud, posegraph, registration
 from ..ops.triangulate import Calibration
+from ..utils import trace
 from ..utils.log import get_logger
 from . import merge as merge_mod
 from . import pipeline as pipeline_mod
@@ -49,7 +51,38 @@ class Scan360Params:
 
     merge: merge_mod.MergeParams = merge_mod.MergeParams()
     method: str = "sequential"  # or "posegraph"
+    # Ring dispatch strategy: "loop" (default; two small compiled programs)
+    # or "scan" (whole ring in one launch — lowest latency on remote TPUs,
+    # but a much heavier cold compile; see merge.register_sequence).
+    ring_strategy: str = "loop"
     view_cap: int = 131_072
+    # Stops decoded/triangulated per device dispatch. The dense per-pixel
+    # intermediates of ONE 1080p stop already saturate the chip; vmapping
+    # every stop at once would multiply peak HBM by N (24×1080p ≈ 25 GB of
+    # fusion temporaries — more than a v5e has). Chunking bounds memory at
+    # chunk × per-stop while keeping dispatch overhead amortized.
+    stop_chunk: int = 6
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_views_fn(view_cap: int):
+    """Per-view reduction (transform → stratified decimation into view_cap
+    slots) as ONE jitted vmapped program — a bare ``jax.vmap`` would
+    dispatch every inner op eagerly, paying a device round trip each
+    (ruinous on a remote TPU).
+
+    Deliberately NO per-view voxel downsample: ``_finalize`` voxel-dedups
+    the concatenation globally anyway, and a per-view pass would sort every
+    view's full 2M-pixel cloud (3 sort passes each — it dominated the whole
+    merge stage). The stratified decimation is a cumsum + binary search:
+    no sort at all."""
+
+    def reduce_view(pose, pts, colors, valid):
+        moved = registration.transform_points(pose, pts)
+        return pointcloud.stratified_subsample(
+            moved, view_cap, valid=valid, attrs=colors.astype(jnp.float32))
+
+    return jax.jit(jax.vmap(reduce_view))
 
 
 def scan_stacks_to_cloud(
@@ -64,6 +97,11 @@ def scan_stacks_to_cloud(
 ):
     """(N, F, H, W) uint8 capture stacks → (merged PointCloud, poses (N,4,4)).
 
+    ``stacks`` may be a device array or a host ``np.ndarray`` — pass the
+    host array for large scans: chunks are then staged to HBM one at a time
+    (a 24-stop 1080p session is 2.3 GB of uint8 that never needs to be
+    device-resident all at once).
+
     Stops are assumed in turntable order (stop i+1 photographed after one
     rotation step), which is what the ring registration chain relies on —
     same assumption as the reference's numeric filename sort
@@ -77,54 +115,95 @@ def scan_stacks_to_cloud(
     n = stacks.shape[0]
     mp = params.merge
 
-    # 1. Decode + triangulate every stop in one vmapped program.
+    # 1. Decode + triangulate every stop, chunked (see ``stop_chunk``). Only
+    # the dense outputs actually needed downstream (points/colors/valid) are
+    # retained across chunks — the heavy fusion temporaries die with each
+    # dispatch, and the decoded col/row maps are dropped. Raw stacks may
+    # arrive as host arrays: then each chunk is staged to HBM on its own and
+    # the full uint8 stack never lives on device at once.
     recon = pipeline_mod.reconstruct_batch_fn(col_bits, row_bits, decode_cfg,
                                               tri_cfg)
-    res = recon(stacks, calib)
+    chunk = max(1, min(params.stop_chunk, n))
+    # Pad the stop axis to a chunk multiple (repeating the last stop) so
+    # every dispatch reuses ONE compiled batch shape — a ragged tail chunk
+    # would force a second multi-minute compile of the heaviest programs.
+    # Padded outputs are sliced away immediately after each loop.
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    if n_pad != n:
+        pad = [stacks[-1:]] * (n_pad - n)
+        cat = np.concatenate if isinstance(stacks, np.ndarray) \
+            else jnp.concatenate
+        stacks = cat([stacks] + pad)
+    with trace.span("scan360.decode_triangulate", stops=n, chunk=chunk):
+        pts_p, col_p, val_p = [], [], []
+        for s in range(0, n_pad, chunk):
+            part = stacks[s:s + chunk]
+            if isinstance(part, np.ndarray):
+                part = jax.device_put(jnp.asarray(part))
+            r = recon(part, calib)
+            pts_p.append(r.points)
+            col_p.append(r.colors)
+            val_p.append(r.valid)
+        res = pipeline_mod.CloudResult(
+            jnp.concatenate(pts_p)[:n], jnp.concatenate(col_p)[:n],
+            jnp.concatenate(val_p)[:n], None, None)
+        del pts_p, col_p, val_p
 
     # 2. Fixed-size registration view of each stop (device-side). Clamped to
     # the slot count: a small camera may have fewer pixels than the cap
     # (top_k needs m ≤ n).
     m_reg = min(merge_mod._round_up(mp.max_points), res.points.shape[1])
-    k_sub, k_reg = jax.random.split(key)
-    sub_keys = jax.random.split(k_sub, n)
-    reg_pts, _, reg_val = jax.vmap(
-        lambda p, v, k: pointcloud.random_subsample(p, m_reg, valid=v, key=k)
-    )(res.points, res.valid, sub_keys)
+    with trace.span("scan360.subsample", m=m_reg):
+        reg_pts, _, reg_val = jax.vmap(
+            lambda p, v: pointcloud.stratified_subsample(p, m_reg, valid=v)
+        )(res.points, res.valid)
 
     # 3. Ring registration → per-stop poses.
     loop = params.method == "posegraph" and mp.loop_closure
-    seq_T, seq_info, loop_T, loop_info, _ = merge_mod.register_sequence(
-        reg_pts, reg_val, mp, loop_closure=loop, key=k_reg)
-    if params.method == "posegraph":
-        graph = posegraph.build_360_graph(seq_T, seq_info, loop_T, loop_info)
-        poses = posegraph.optimize(graph, iterations=mp.posegraph_iterations)
-    else:
-        poses = posegraph.chain_poses(seq_T)
+    with trace.span("scan360.register", edges=n - 1 + int(loop)):
+        seq_T, seq_info, loop_T, loop_info, _ = merge_mod.register_sequence(
+            reg_pts, reg_val, mp, loop_closure=loop, key=key,
+            strategy=params.ring_strategy)
+        if params.method == "posegraph":
+            graph = posegraph.build_360_graph(seq_T, seq_info, loop_T,
+                                              loop_info)
+            poses = posegraph.optimize(graph,
+                                       iterations=mp.posegraph_iterations)
+        else:
+            poses = posegraph.chain_poses(seq_T)
 
-    # 4. Merge the FULL-resolution clouds under the poses. Each stop is first
-    # reduced per-view (voxel downsample, then a uniform random compaction
-    # into view_cap static slots — unbiased even when more than view_cap
-    # cells survive; a prefix slice would chop off one spatial side, since
-    # cells come out in lexicographic order), then the final global cleanup
-    # chain runs on the concatenation.
+    # 4. Merge the FULL-resolution clouds under the poses. Each stop is
+    # first reduced per-view (transform + stratified decimation into
+    # view_cap static slots; the global voxel dedup happens in _finalize),
+    # then the final cleanup chain runs on the concatenation.
     view_cap = merge_mod._round_up(min(params.view_cap, res.points.shape[1]))
+    reduce_views = _reduce_views_fn(view_cap)
+    poses_f = jnp.asarray(poses, jnp.float32)
+    with trace.span("scan360.merge", view_cap=view_cap):
+        # Same chunk-shape discipline as stage 1: pad the stop axis with
+        # zeroed stops (all-False valid masks — they contribute nothing),
+        # slice after.
+        def pad_stops(a):
+            if n_pad == n:
+                return a
+            zeros = jnp.zeros((n_pad - n,) + a.shape[1:], a.dtype)
+            return jnp.concatenate([a, zeros])
 
-    def reduce_view(pose, pts, colors, valid, k):
-        moved = registration.transform_points(pose, pts)
-        dpts, dcol, dvalid, _ = pointcloud.voxel_downsample(
-            moved, mp.voxel_size, valid=valid,
-            attrs=colors.astype(jnp.float32), with_attrs=True)
-        return pointcloud.random_subsample(dpts, view_cap, valid=dvalid,
-                                           attrs=dcol, key=k)
-
-    view_keys = jax.random.split(jax.random.fold_in(key, 1), n)
-    vpts, vcol, vval = jax.vmap(reduce_view)(
-        jnp.asarray(poses, jnp.float32), res.points, res.colors, res.valid,
-        view_keys)
-    merged = merge_mod._finalize(
-        vpts.reshape(-1, 3), vcol.reshape(-1, 3), vval.reshape(-1), mp,
-        has_colors=True)
+        rp, rc, rv = (pad_stops(res.points), pad_stops(res.colors),
+                      pad_stops(res.valid))
+        pp = jnp.concatenate(
+            [poses_f, jnp.broadcast_to(jnp.eye(4), (n_pad - n, 4, 4))]
+        ) if n_pad != n else poses_f
+        vparts = []
+        for s in range(0, n_pad, chunk):
+            e = s + chunk
+            vparts.append(reduce_views(pp[s:e], rp[s:e], rc[s:e], rv[s:e]))
+        vpts = jnp.concatenate([p for p, _, _ in vparts])[:n]
+        vcol = jnp.concatenate([c for _, c, _ in vparts])[:n]
+        vval = jnp.concatenate([v for _, _, v in vparts])[:n]
+        merged = merge_mod._finalize(
+            vpts.reshape(-1, 3), vcol.reshape(-1, 3), vval.reshape(-1), mp,
+            has_colors=True)
     log.info("scan_stacks_to_cloud: %d stops → %d points (%s)", n,
              len(merged), params.method)
     return merged, np.asarray(poses)
@@ -167,7 +246,7 @@ def scan_folders_to_cloud(
             f"stack has {stacks.shape[1]} frames but {col_bits}+{row_bits} "
             f"bits imply {expect} (white, black, then pattern/inverse pairs)")
     merged, poses = scan_stacks_to_cloud(
-        jnp.asarray(stacks), cal, col_bits, row_bits,
+        stacks, cal, col_bits, row_bits,
         params=params, decode_cfg=decode_cfg, tri_cfg=tri_cfg, key=key)
     if output_path is not None:
         ply_io.write_ply(output_path, merged)

@@ -9,14 +9,26 @@ algebra, which is exactly what the MXU is for:
 
 * pairwise squared distances per (query-tile × key-tile) block via the
   ``|q|² + |p|² − 2 q·pᵀ`` expansion — the ``q·pᵀ`` term is a matmul;
-* a running top-k merge over key tiles carried through ``lax.scan``, so HBM
-  never holds more than one (Tq × Tk) distance block per step;
 * static shapes throughout: inputs are padded, padding is masked with +inf
   distance, k is a compile-time constant.
 
-Exact (not approximate) — same neighbor sets as a KDTree up to distance ties.
-O(M·N) FLOPs, but at TPU matmul rates that beats a host KDTree for the point
-counts this pipeline sees (≤ a few million after voxel downsampling).
+The top-k reduction is where TPUs need care — the sort unit is the weak one,
+so three paths exist:
+
+* ``k == 1`` — a running argmin carried through the key-block scan. No sort
+  at all; ICP correspondences and mutual feature matching live here.
+* ``method="approx"`` (default on TPU for k > 1) — per-block
+  ``lax.approx_min_k`` (the TPU's PartialReduce hardware op, ~1000× faster
+  than ``lax.top_k`` at these shapes), candidates merged across blocks with
+  a second ``approx_min_k`` and ordered with one tiny exact ``top_k`` over
+  the final k. Recall ≈ 0.95² per query; the downstream consumers (SOR
+  statistics, PCA normals, FPFH histograms) are insensitive to a missed
+  ~5% of neighbors.
+* ``method="exact"`` (default off-TPU, and the oracle for tests) — the
+  classic carried exact ``top_k`` merge.
+
+O(M·N) FLOPs either way; at TPU matmul rates this beats a host KDTree for
+the point counts this pipeline sees (≤ a few million after downsampling).
 """
 
 from __future__ import annotations
@@ -41,7 +53,19 @@ def pad_points(points: jnp.ndarray, valid: jnp.ndarray | None, multiple: int):
     return points, valid
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _block_dists(q, q2, kp, kv, p2):
+    """(Tq, Tk) squared distances, invalid keys masked to +inf."""
+    cross = jax.lax.dot_general(
+        q, kp.T, (((1,), (0,)), ((), ())),
+        # HIGHEST: fp32 dot products — bf16 would misorder close
+        # neighbors, changing neighbor SETS, not just distances.
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    d = q2 + p2[None, :] - 2.0 * cross
+    return jnp.where(kv[None, :], d, jnp.inf)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7))
 def _knn_padded(
     queries: jnp.ndarray,   # (M, D) float32, M % q_tile == 0
     q_valid: jnp.ndarray,   # (M,) bool
@@ -50,6 +74,7 @@ def _knn_padded(
     k: int,
     q_tile: int,
     k_tile: int,
+    approx: bool,
 ):
     M, dim = queries.shape
     N = points.shape[0]
@@ -64,17 +89,49 @@ def _knn_padded(
         q, qv = args  # (Tq, D), (Tq,)
         q2 = jnp.sum(q * q, axis=-1, keepdims=True)  # (Tq, 1)
 
+        if k == 1:
+            # Sort-free running argmin.
+            def step(carry, blk):
+                best_d, best_i = carry  # (Tq,), (Tq,)
+                kp, kv, p2, base = blk
+                d = _block_dists(q, q2, kp, kv, p2)
+                j = jnp.argmin(d, axis=1)
+                dmin = jnp.take_along_axis(d, j[:, None], 1)[:, 0]
+                better = dmin < best_d
+                return (jnp.where(better, dmin, best_d),
+                        jnp.where(better, base + j.astype(jnp.int32),
+                                  best_i)), None
+
+            init = (jnp.full((q.shape[0],), jnp.inf, jnp.float32),
+                    jnp.zeros((q.shape[0],), jnp.int32))
+            (bd, bi), _ = jax.lax.scan(
+                step, init, (key_blocks, key_valid, p2_blocks, base_idx))
+            return bd[:, None], bi[:, None]
+
+        if approx:
+            # Per-block PartialReduce candidates, merged with a second
+            # approx pass, ordered with one tiny exact sort over k.
+            def step(_, blk):
+                kp, kv, p2, base = blk
+                d = _block_dists(q, q2, kp, kv, p2)
+                nd, nloc = jax.lax.approx_min_k(d, k)
+                return None, (nd, base + nloc.astype(jnp.int32))
+
+            _, (cd, ci) = jax.lax.scan(
+                step, None, (key_blocks, key_valid, p2_blocks, base_idx))
+            # (B, Tq, k) -> (Tq, B*k)
+            cd = jnp.moveaxis(cd, 0, 1).reshape(q.shape[0], -1)
+            ci = jnp.moveaxis(ci, 0, 1).reshape(q.shape[0], -1)
+            md, marg = jax.lax.approx_min_k(cd, k)
+            mi = jnp.take_along_axis(ci, marg, axis=1)
+            neg, order = jax.lax.top_k(-md, k)  # ascending exact order
+            return -neg, jnp.take_along_axis(mi, order, axis=1)
+
+        # Exact: carried top-k merge.
         def step(carry, blk):
             best_d, best_i = carry  # (Tq, k)
             kp, kv, p2, base = blk
-            # HIGHEST: fp32 dot products — bf16 would misorder close
-            # neighbors, changing neighbor SETS, not just distances.
-            cross = jax.lax.dot_general(
-                q, kp.T, (((1,), (0,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-            )  # (Tq, Tk)
-            d = q2 + p2[None, :] - 2.0 * cross
-            d = jnp.where(kv[None, :], d, jnp.inf)
+            d = _block_dists(q, q2, kp, kv, p2)
             idx = base + jnp.arange(k_tile, dtype=jnp.int32)
             cat_d = jnp.concatenate([best_d, d], axis=1)
             cat_i = jnp.concatenate(
@@ -96,10 +153,14 @@ def _knn_padded(
     qv_tiles = q_valid.reshape(M // q_tile, q_tile)
     # lax.map over query tiles: one (Tq, Tk) block resident at a time.
     best_d, best_i = jax.lax.map(per_query_tile, (q_tiles, qv_tiles))
-    best_d = best_d.reshape(M, k)
-    best_i = best_i.reshape(M, k)
+    best_d = best_d.reshape(M, -1)
+    best_i = best_i.reshape(M, -1)
     # Squared distances can go epsilon-negative in fp32; clamp for sqrt users.
     return jnp.maximum(best_d, 0.0), best_i
+
+
+def _default_method() -> str:
+    return "approx" if jax.default_backend() not in ("cpu",) else "exact"
 
 
 def knn(
@@ -110,16 +171,24 @@ def knn(
     queries_valid: jnp.ndarray | None = None,
     exclude_self: bool = False,
     q_tile: int = 1024,
-    k_tile: int = 2048,
+    k_tile: int | None = None,
+    method: str = "auto",
 ):
     """k nearest points for each query (defaults: queries = points).
 
-    Returns (sq_dists (M, k), indices (M, k), neighbor_valid (M, k)).
-    Invalid/padded points never appear as neighbors; when fewer than k valid
-    points exist, surplus slots have neighbor_valid False (dist inf capped to
-    0 — check the mask). With ``exclude_self`` the query's own index is
-    dropped (the Open3D SOR convention of "k neighbors other than me").
+    Returns (sq_dists (M, k), indices (M, k), neighbor_valid (M, k)),
+    distances ascending. Invalid/padded points never appear as neighbors;
+    when fewer than k valid points exist, surplus slots have neighbor_valid
+    False (dist inf capped to 0 — check the mask). With ``exclude_self`` the
+    query's own index is dropped (the Open3D SOR convention of "k neighbors
+    other than me"). ``method``: "exact", "approx" (recall ≈ 0.9, TPU
+    PartialReduce), or "auto" (approx on accelerators, exact on CPU; k=1 is
+    always exact via running argmin).
     """
+    if method == "auto":
+        method = _default_method()
+    if method not in ("exact", "approx"):
+        raise ValueError(f"unknown knn method {method!r}")
     self_query = queries is None
     if self_query:
         queries, queries_valid = points, points_valid
@@ -129,10 +198,16 @@ def knn(
 
     points = jnp.asarray(points, jnp.float32)
     queries = jnp.asarray(queries, jnp.float32)
+    if k_tile is None:
+        # Bigger blocks amortize the per-block reduction; the approx path's
+        # PartialReduce handles wide rows cheaply, the exact path's sort
+        # does not.
+        k_tile = 8192 if (method == "approx" or kk == 1) else 2048
     p_pad, pv_pad = pad_points(points, points_valid, k_tile)
     q_pad, qv_pad = pad_points(queries, queries_valid, q_tile)
 
-    d, i = _knn_padded(q_pad, qv_pad, p_pad, pv_pad, kk, q_tile, k_tile)
+    d, i = _knn_padded(q_pad, qv_pad, p_pad, pv_pad, kk, q_tile, k_tile,
+                       method == "approx")
     d, i = d[:n_q], i[:n_q]
 
     if exclude_self and self_query:

@@ -185,6 +185,53 @@ def random_subsample(
     return out_points, out_attrs, out_valid
 
 
+@functools.partial(jax.jit, static_argnames=("m",))
+def stratified_subsample(
+    points: jnp.ndarray,
+    m: int,
+    valid: jnp.ndarray | None = None,
+    attrs: jnp.ndarray | None = None,
+):
+    """Every ⌈n_valid/m⌉-th valid point, compacted to ``m`` static slots.
+
+    The deterministic sibling of :func:`random_subsample`: instead of a
+    top_k over random scores (whose sorting-network cost explodes for large
+    ``m`` on TPU), ranks come from a cumsum over the valid mask and the j-th
+    output is the ⌊j·n_valid/m⌋-th valid point, found by binary search —
+    O(n + m·log n). Selection is stratified along the input order, which for
+    voxel-downsample outputs (cells emitted in lexicographic order) means
+    spatially spread, and for image-order pixel clouds means spread over
+    rows. When fewer than ``m`` valid points exist every valid point is
+    kept once (surplus slots masked), like random_subsample.
+    """
+    n = points.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, dtype=bool)
+    rank = jnp.cumsum(valid.astype(jnp.int32))  # 1-based rank of each valid
+    n_valid = rank[-1]
+    j = jnp.arange(m, dtype=jnp.int32)
+    # Target ranks: stratified when n_valid > m, identity (+mask) otherwise.
+    # Computed as j·(n_valid/m) — NOT (j·n_valid)/m, whose product overflows
+    # fp32 grid at 4K-camera sizes — then repaired to be strictly
+    # increasing: in exact math t_j − j is nondecreasing, so a running max
+    # over it undoes any ±1 fp32 floor misround that would duplicate a rank.
+    stride = n_valid.astype(jnp.float32) / float(m)
+    t = jnp.floor(j.astype(jnp.float32) * stride).astype(jnp.int32) + 1
+    u = jax.lax.associative_scan(jnp.maximum, t - j)
+    t = jnp.minimum(u + j, jnp.maximum(n_valid, 1))
+    targets = jnp.where(n_valid > m, t, j + 1)
+    idx = jnp.searchsorted(rank, targets, side="left").astype(jnp.int32)
+    idx = jnp.minimum(idx, n - 1)
+    out_valid = j < jnp.minimum(n_valid, m)
+    out_points = jnp.where(out_valid[:, None], points[idx], 0.0)
+    out_attrs = None
+    if attrs is not None:
+        taken = attrs[idx]
+        mask = out_valid.reshape((m,) + (1,) * (taken.ndim - 1))
+        out_attrs = jnp.where(mask, taken, 0)
+    return out_points, out_attrs, out_valid
+
+
 # ---------------------------------------------------------------------------
 # Normals: analytic 3×3 symmetric eigensolver (branch-free, vmapped)
 # ---------------------------------------------------------------------------

@@ -57,6 +57,10 @@ def synth_scan(synth_rig):
     )
 
 
-@pytest.fixture(scope="session")
+@pytest.fixture()
 def rng():
+    """Function-scoped so every test draws the SAME deterministic stream
+    regardless of which other tests ran first — a session-scoped generator
+    makes assertions order-dependent (adding a test shifts everyone else's
+    draws)."""
     return np.random.default_rng(0)

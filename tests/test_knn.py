@@ -53,3 +53,37 @@ def test_knn_separate_queries(rng):
     d2, idx, nbv = knn_ops.knn(pts, 3, queries=q, q_tile=64, k_tile=128)
     ref_d, ref_i = cKDTree(pts).query(q, k=3)
     np.testing.assert_allclose(np.sqrt(np.asarray(d2)), ref_d, atol=1e-3)
+
+
+def test_knn_approx_path_cpu_parity(rng):
+    """On CPU approx_min_k lowers to an exact reduction, so the approx code
+    path must reproduce the exact neighbor sets — this exercises the
+    per-block candidate collection + two-stage merge logic."""
+    pts = rng.normal(size=(500, 3)).astype(np.float32)
+    d_ex, i_ex, v_ex = knn_ops.knn(pts, 8, q_tile=64, k_tile=128,
+                                   method="exact")
+    d_ap, i_ap, v_ap = knn_ops.knn(pts, 8, q_tile=64, k_tile=128,
+                                   method="approx")
+    np.testing.assert_allclose(np.asarray(d_ap), np.asarray(d_ex),
+                               atol=1e-5)
+    # Ascending order must hold on both paths.
+    assert np.all(np.diff(np.asarray(d_ap), axis=1) >= -1e-6)
+    assert np.array_equal(np.asarray(v_ap), np.asarray(v_ex))
+
+
+def test_knn_k1_argmin_path(rng):
+    """k=1 takes the sort-free running-argmin path."""
+    pts = rng.normal(size=(300, 3)).astype(np.float32)
+    q = rng.normal(size=(90, 3)).astype(np.float32)
+    d2, idx, nbv = knn_ops.knn(pts, 1, queries=q, q_tile=64, k_tile=64)
+    ref_d, ref_i = cKDTree(pts).query(q, k=1)
+    np.testing.assert_allclose(np.sqrt(np.asarray(d2)[:, 0]), ref_d,
+                               atol=1e-3)
+    assert np.array_equal(np.asarray(idx)[:, 0], ref_i)
+
+
+def test_knn_method_validation(rng):
+    pts = rng.normal(size=(32, 3)).astype(np.float32)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="method"):
+        knn_ops.knn(pts, 2, method="bogus")

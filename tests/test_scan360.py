@@ -41,6 +41,33 @@ def test_random_subsample_fewer_valid_than_m(rng):
     assert oa.shape == (32, 3)
 
 
+def test_stratified_subsample_matches_valid_set(rng):
+    pts = jnp.asarray(rng.normal(size=(997, 3)).astype(np.float32))
+    valid = jnp.asarray(rng.random(997) > 0.3)
+    out, _, ov = pointcloud.stratified_subsample(pts, 256, valid=valid)
+    assert out.shape == (256, 3) and bool(ov.all())
+    src = np.asarray(pts)[np.asarray(valid)]
+    sel = np.asarray(out)
+    # Every selected point is a valid input point, and selection is strided
+    # (no duplicates when n_valid > m).
+    assert all(np.isclose(src, p).all(1).any() for p in sel)
+    assert len(np.unique(sel, axis=0)) == 256
+
+
+def test_stratified_subsample_fewer_valid(rng):
+    pts = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    valid = jnp.zeros(64, bool).at[10:25].set(True)
+    attrs = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    out, oa, ov = pointcloud.stratified_subsample(pts, 32, valid=valid,
+                                                  attrs=attrs)
+    assert int(ov.sum()) == 15
+    kept = np.asarray(out)[np.asarray(ov)]
+    src = np.asarray(pts)[10:25]
+    assert np.allclose(np.sort(kept, axis=0), np.sort(src, axis=0))
+    assert oa.shape == (32, 3)
+    assert np.all(np.asarray(out)[~np.asarray(ov)] == 0)
+
+
 @pytest.fixture(scope="module")
 def turntable_stacks(synth_rig):
     cam_K, proj_K, R, T = synth_rig

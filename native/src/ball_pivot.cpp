@@ -1,0 +1,317 @@
+// Ball-pivoting surface reconstruction (Bernardini et al. 1999).
+//
+// The reference's "surface" meshing mode calls Open3D's C++
+// create_from_point_cloud_ball_pivoting with radii = avg-NN-dist x {1,2,4}
+// (server/processing.py:222-235, Old/STLrecon.py:13-50). Front propagation
+// is inherently sequential and pointer-heavy — the one pipeline stage that
+// genuinely belongs on a scalar host core, so it lives here in C++ with a
+// grid-hash accelerator, while normals/KNN come from the TPU side.
+//
+// Multi-radius: passes run smallest radius first; later passes only pivot
+// from still-boundary edges, filling holes left by the smaller ball.
+//
+// C ABI for ctypes: sl_ball_pivot(...) fills a caller-provided triangle
+// buffer and returns the triangle count (or -needed if the buffer is too
+// small, so the caller can retry).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct V3 {
+  float x, y, z;
+};
+
+static inline V3 operator-(V3 a, V3 b) { return {a.x - b.x, a.y - b.y, a.z - b.z}; }
+static inline V3 operator+(V3 a, V3 b) { return {a.x + b.x, a.y + b.y, a.z + b.z}; }
+static inline V3 operator*(V3 a, float s) { return {a.x * s, a.y * s, a.z * s}; }
+static inline float dot(V3 a, V3 b) { return a.x * b.x + a.y * b.y + a.z * b.z; }
+static inline V3 cross(V3 a, V3 b) {
+  return {a.y * b.z - a.z * b.y, a.z * b.x - a.x * b.z, a.x * b.y - a.y * b.x};
+}
+static inline float norm(V3 a) { return std::sqrt(dot(a, a)); }
+static inline V3 normalize(V3 a) {
+  float n = norm(a);
+  return n > 0 ? a * (1.0f / n) : a;
+}
+
+struct Grid {
+  float cell;
+  std::unordered_map<uint64_t, std::vector<int32_t>> cells;
+
+  static uint64_t key(int64_t x, int64_t y, int64_t z) {
+    // 21 bits per axis, offset to positive.
+    const int64_t off = 1 << 20;
+    return ((uint64_t)(x + off) << 42) | ((uint64_t)(y + off) << 21) |
+           (uint64_t)(z + off);
+  }
+
+  void build(const V3* pts, int32_t n, float cell_size) {
+    cell = cell_size;
+    cells.clear();
+    for (int32_t i = 0; i < n; i++) {
+      cells[key((int64_t)std::floor(pts[i].x / cell),
+                (int64_t)std::floor(pts[i].y / cell),
+                (int64_t)std::floor(pts[i].z / cell))]
+          .push_back(i);
+    }
+  }
+
+  template <class F>
+  void neighbors(V3 p, float radius, F&& fn) const {
+    int64_t x0 = (int64_t)std::floor((p.x - radius) / cell);
+    int64_t x1 = (int64_t)std::floor((p.x + radius) / cell);
+    int64_t y0 = (int64_t)std::floor((p.y - radius) / cell);
+    int64_t y1 = (int64_t)std::floor((p.y + radius) / cell);
+    int64_t z0 = (int64_t)std::floor((p.z - radius) / cell);
+    int64_t z1 = (int64_t)std::floor((p.z + radius) / cell);
+    for (int64_t x = x0; x <= x1; x++)
+      for (int64_t y = y0; y <= y1; y++)
+        for (int64_t z = z0; z <= z1; z++) {
+          auto it = cells.find(key(x, y, z));
+          if (it == cells.end()) continue;
+          for (int32_t i : it->second) fn(i);
+        }
+  }
+};
+
+struct EdgeKey {
+  int32_t a, b;  // undirected: a < b
+  bool operator==(const EdgeKey& o) const { return a == o.a && b == o.b; }
+};
+struct EdgeHash {
+  size_t operator()(const EdgeKey& e) const {
+    return ((size_t)e.a << 32) ^ (size_t)e.b;
+  }
+};
+
+struct FrontEdge {
+  int32_t a, b;       // directed edge on the front
+  int32_t opposite;   // third vertex of the triangle that created it
+  V3 center;          // ball center of that triangle
+};
+
+struct BPA {
+  const V3* pts;
+  const V3* nrm;
+  int32_t n;
+  float r;
+  Grid grid;
+
+  std::vector<uint8_t> used;                       // vertex in mesh
+  std::unordered_set<EdgeKey, EdgeHash> done_edges;  // edges already fronted
+  std::unordered_map<EdgeKey, int32_t, EdgeHash> edge_count;  // facets/edge
+  std::deque<FrontEdge> front;
+  std::vector<int32_t>* tris;
+
+  // Ball center touching a,b,c on the side agreeing with the normals;
+  // returns false if the three points cannot support a ball of radius r.
+  bool ball_center(int32_t ia, int32_t ib, int32_t ic, V3& out) const {
+    V3 a = pts[ia], b = pts[ib], c = pts[ic];
+    V3 ab = b - a, ac = c - a;
+    V3 nt = cross(ab, ac);
+    float nt2 = dot(nt, nt);
+    if (nt2 < 1e-20f) return false;
+    // Circumcenter (barycentric formula).
+    float d11 = dot(ab, ab), d22 = dot(ac, ac), d12 = dot(ab, ac);
+    float denom = 2.0f * nt2;
+    float s = (d11 * d22 - d22 * d12) / denom;
+    float t = (d22 * d11 - d11 * d12) / denom;
+    V3 cc = a + ab * s + ac * t;
+    float rc2 = dot(cc - a, cc - a);
+    float h2 = r * r - rc2;
+    if (h2 < 0) return false;
+    V3 nn = normalize(nt);
+    // Ball sits on the outward side: majority normal vote.
+    V3 avg = nrm[ia] + nrm[ib] + nrm[ic];
+    if (dot(nn, avg) < 0) nn = nn * -1.0f;
+    out = cc + nn * std::sqrt(h2);
+    return true;
+  }
+
+  bool ball_empty(V3 center, int32_t ia, int32_t ib, int32_t ic) const {
+    bool empty = true;
+    const float r2 = r * r * (1.0f - 1e-4f);
+    grid.neighbors(center, r, [&](int32_t i) {
+      if (!empty || i == ia || i == ib || i == ic) return;
+      V3 d = pts[i] - center;
+      if (dot(d, d) < r2) empty = false;
+    });
+    return empty;
+  }
+
+  void emit(int32_t a, int32_t b, int32_t c, V3 center) {
+    tris->push_back(a);
+    tris->push_back(b);
+    tris->push_back(c);
+    used[a] = used[b] = used[c] = 1;
+    push_edge(b, a, c, center);
+    push_edge(c, b, a, center);
+    push_edge(a, c, b, center);
+  }
+
+  void push_edge(int32_t a, int32_t b, int32_t opp, V3 center) {
+    EdgeKey k{std::min(a, b), std::max(a, b)};
+    int32_t& cnt = edge_count[k];
+    cnt++;
+    if (cnt == 1) front.push_back({a, b, opp, center});
+  }
+
+  bool edge_open(int32_t a, int32_t b) const {
+    EdgeKey k{std::min(a, b), std::max(a, b)};
+    auto it = edge_count.find(k);
+    return it != edge_count.end() && it->second < 2;
+  }
+
+  // Pivot the ball around directed edge (a, b): choose the candidate point
+  // hit first when rotating from the current ball position.
+  bool pivot(const FrontEdge& e, int32_t& hit, V3& hit_center) {
+    V3 a = pts[e.a], b = pts[e.b];
+    V3 m = (a + b) * 0.5f;
+    V3 axis = normalize(b - a);
+    V3 u0 = e.center - m;
+    u0 = u0 - axis * dot(u0, axis);  // reference direction in pivot plane
+    float u0n = norm(u0);
+    if (u0n < 1e-12f) return false;
+    u0 = u0 * (1.0f / u0n);
+    V3 v0 = cross(axis, u0);
+
+    float best_angle = 1e9f;
+    int32_t best = -1;
+    V3 best_center{};
+    float search = 2.0f * r + norm(b - a);
+    grid.neighbors(m, search, [&](int32_t i) {
+      if (i == e.a || i == e.b || i == e.opposite) return;
+      V3 c;
+      if (!ball_center(e.a, e.b, i, c)) return;
+      V3 w = c - m;
+      w = w - axis * dot(w, axis);
+      float wn = norm(w);
+      if (wn < 1e-12f) return;
+      w = w * (1.0f / wn);
+      float ang = std::atan2(dot(w, v0), dot(w, u0));
+      if (ang < 1e-5f) ang += 6.28318530717958647692f;  // strictly forward
+      if (ang < best_angle && ball_empty(c, e.a, e.b, i)) {
+        best_angle = ang;
+        best = i;
+        best_center = c;
+      }
+    });
+    if (best < 0) return false;
+    hit = best;
+    hit_center = best_center;
+    return true;
+  }
+
+  bool find_seed() {
+    for (int32_t i = 0; i < n; i++) {
+      if (used[i]) continue;
+      bool seeded = false;
+      grid.neighbors(pts[i], 2.0f * r, [&](int32_t j) {
+        if (seeded || j <= i || used[j]) return;
+        grid.neighbors(pts[i], 2.0f * r, [&](int32_t k) {
+          if (seeded || k <= j || used[k]) return;
+          V3 c;
+          if (!ball_center(i, j, k, c)) return;
+          if (!ball_empty(c, i, j, k)) return;
+          // Orientation: triangle normal agrees with vertex normals.
+          V3 nt = cross(pts[j] - pts[i], pts[k] - pts[i]);
+          if (dot(nt, nrm[i] + nrm[j] + nrm[k]) >= 0) {
+            emit(i, j, k, c);
+          } else {
+            V3 c2;
+            if (ball_center(i, k, j, c2) && ball_empty(c2, i, k, j)) {
+              emit(i, k, j, c2);
+            } else {
+              return;
+            }
+          }
+          seeded = true;
+        });
+      });
+      if (seeded) return true;
+    }
+    return false;
+  }
+
+  void run() {
+    while (true) {
+      while (!front.empty()) {
+        FrontEdge e = front.front();
+        front.pop_front();
+        if (!edge_open(e.a, e.b)) continue;
+        EdgeKey k{std::min(e.a, e.b), std::max(e.a, e.b)};
+        if (done_edges.count(k)) continue;
+        int32_t hit;
+        V3 c;
+        if (pivot(e, hit, c)) {
+          // Front edges are pushed REVERSED relative to their owning
+          // triangle's boundary direction, so emitting (a, b, hit) makes
+          // the new face traverse the shared edge opposite to its owner —
+          // consistent manifold winding.
+          if (edge_open(e.a, e.b)) {
+            done_edges.insert(k);
+            emit(e.a, e.b, hit, c);
+          }
+        } else {
+          done_edges.insert(k);  // boundary edge
+        }
+      }
+      if (!find_seed()) break;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// points/normals (n*3) float32; radii (n_radii) ascending; out_tris int32
+// capacity max_tris*3. Returns triangle count, or -1 on bad args.
+int32_t sl_ball_pivot(int32_t n, const float* points, const float* normals,
+                      const float* radii, int32_t n_radii, int32_t* out_tris,
+                      int32_t max_tris) {
+  if (n < 3 || n_radii < 1) return -1;
+  std::vector<int32_t> tris;
+  tris.reserve(std::min(max_tris, 4 * n) * 3);
+
+  BPA bpa;
+  bpa.pts = reinterpret_cast<const V3*>(points);
+  bpa.nrm = reinterpret_cast<const V3*>(normals);
+  bpa.n = n;
+  bpa.tris = &tris;
+  bpa.used.assign(n, 0);
+
+  for (int32_t ri = 0; ri < n_radii; ri++) {
+    bpa.r = radii[ri];
+    bpa.grid.build(bpa.pts, n, std::max(bpa.r, 1e-6f));
+    // Re-seed the front from boundary edges of the existing mesh: edges
+    // with exactly one facet pivot again with the larger ball.
+    bpa.front.clear();
+    bpa.done_edges.clear();
+    if (ri > 0) {
+      for (size_t t = 0; t + 2 < tris.size(); t += 3) {
+        int32_t a = tris[t], b = tris[t + 1], c = tris[t + 2];
+        V3 center;
+        if (!bpa.ball_center(a, b, c, center)) continue;
+        if (bpa.edge_open(a, b)) bpa.front.push_back({b, a, c, center});
+        if (bpa.edge_open(b, c)) bpa.front.push_back({c, b, a, center});
+        if (bpa.edge_open(c, a)) bpa.front.push_back({a, c, b, center});
+      }
+    }
+    bpa.run();
+  }
+
+  int32_t count = (int32_t)(tris.size() / 3);
+  if (count > max_tris) return -count;
+  memcpy(out_tris, tris.data(), tris.size() * sizeof(int32_t));
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,184 @@
+// Host-side graph algorithms over TPU-computed KNN graphs.
+//
+// The irregular, pointer-chasing half of the pipeline: union-find DBSCAN
+// clustering (the Open3D cluster_dbscan call in the reference's outlier lab,
+// Old/StatisticalOutlierRemoval.py:9) and minimum-spanning-tree consistent
+// normal orientation (orient_normals_consistent_tangent_plane,
+// server/processing.py:201,282). The neighbor lists arrive precomputed from
+// the device KNN (structured_light_for_3d_model_replication_tpu/ops/knn.py); this code only walks
+// graphs, which a scalar core does better than a vector machine.
+//
+// C ABI for ctypes. All buffers caller-allocated.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Union-find
+// ---------------------------------------------------------------------------
+
+static int32_t uf_find(std::vector<int32_t>& parent, int32_t x) {
+  while (parent[x] != x) {
+    parent[x] = parent[parent[x]];  // path halving
+    x = parent[x];
+  }
+  return x;
+}
+
+static void uf_union(std::vector<int32_t>& parent, std::vector<int32_t>& rank,
+                     int32_t a, int32_t b) {
+  a = uf_find(parent, a);
+  b = uf_find(parent, b);
+  if (a == b) return;
+  if (rank[a] < rank[b]) std::swap(a, b);
+  parent[b] = a;
+  if (rank[a] == rank[b]) rank[a]++;
+}
+
+// DBSCAN over a precomputed (n, k) KNN graph.
+//   nbr_idx   (n*k) int32 — neighbor indices
+//   nbr_ok    (n*k) uint8 — neighbor is valid AND within eps
+//   core      (n)   uint8 — point has >= min_points neighbors within eps
+//   labels    (n)   int32 out — cluster id per point, -1 = noise
+// Returns the number of clusters. Semantics match Open3D cluster_dbscan:
+// core points within eps union into one cluster; border points (non-core
+// with a core neighbor) join that core's cluster; the rest are noise.
+int32_t sl_dbscan_labels(int32_t n, int32_t k, const int32_t* nbr_idx,
+                         const uint8_t* nbr_ok, const uint8_t* core,
+                         int32_t* labels) {
+  std::vector<int32_t> parent(n), rank(n, 0);
+  for (int32_t i = 0; i < n; i++) parent[i] = i;
+
+  // Union core-core edges.
+  for (int32_t i = 0; i < n; i++) {
+    if (!core[i]) continue;
+    for (int32_t j = 0; j < k; j++) {
+      if (!nbr_ok[i * k + j]) continue;
+      int32_t nb = nbr_idx[i * k + j];
+      if (core[nb]) uf_union(parent, rank, i, nb);
+    }
+  }
+
+  // Compact root ids -> cluster labels for cores.
+  std::vector<int32_t> root_label(n, -1);
+  int32_t next = 0;
+  for (int32_t i = 0; i < n; i++) {
+    if (!core[i]) continue;
+    int32_t r = uf_find(parent, i);
+    if (root_label[r] < 0) root_label[r] = next++;
+    labels[i] = root_label[r];
+  }
+
+  // Border points adopt the cluster of any core neighbor; noise = -1.
+  for (int32_t i = 0; i < n; i++) {
+    if (core[i]) continue;
+    labels[i] = -1;
+    for (int32_t j = 0; j < k; j++) {
+      if (!nbr_ok[i * k + j]) continue;
+      int32_t nb = nbr_idx[i * k + j];
+      if (core[nb]) {
+        labels[i] = root_label[uf_find(parent, nb)];
+        break;
+      }
+    }
+  }
+  return next;
+}
+
+// ---------------------------------------------------------------------------
+// MST consistent normal orientation
+// ---------------------------------------------------------------------------
+
+// Orient normals consistently by propagating along a minimum spanning tree
+// whose edge weight is 1 - |n_i . n_j| (Hoppe et al.; the algorithm behind
+// orient_normals_consistent_tangent_plane). Graph edges come from the
+// (n, k) KNN table; the tree is built per connected component with Prim's
+// algorithm and flips follow sign(n_parent . n_child).
+//   normals (n*3) float32, modified IN PLACE
+//   seed_dir (3)  float32 — roots are flipped to agree with this direction
+//                 (camera/outward hint); pass zeros to keep root signs.
+// Returns the number of connected components.
+int32_t sl_mst_orient_normals(int32_t n, int32_t k, const float* /*points*/,
+                              float* normals, const int32_t* nbr_idx,
+                              const uint8_t* nbr_ok, const float* seed_dir) {
+  struct Edge {
+    float w;
+    int32_t from, to;
+    bool operator<(const Edge& o) const { return w > o.w; }  // min-heap
+  };
+
+  std::vector<uint8_t> visited(n, 0);
+  std::priority_queue<Edge> heap;
+  int32_t components = 0;
+
+  auto dot3 = [&](const float* a, const float* b) {
+    return a[0] * b[0] + a[1] * b[1] + a[2] * b[2];
+  };
+
+  for (int32_t s = 0; s < n; s++) {
+    if (visited[s]) continue;
+    components++;
+    visited[s] = 1;
+    // Root sign: agree with the seed direction if one was given.
+    float sd = dot3(&normals[3 * s], seed_dir);
+    if (sd < 0.0f) {
+      for (int d = 0; d < 3; d++) normals[3 * s + d] = -normals[3 * s + d];
+    }
+    for (int32_t j = 0; j < k; j++) {
+      if (!nbr_ok[s * k + j]) continue;
+      int32_t nb = nbr_idx[s * k + j];
+      float w = 1.0f - std::abs(dot3(&normals[3 * s], &normals[3 * nb]));
+      heap.push({w, s, nb});
+    }
+    while (!heap.empty()) {
+      Edge e = heap.top();
+      heap.pop();
+      if (visited[e.to]) continue;
+      visited[e.to] = 1;
+      // Flip child to agree with parent.
+      if (dot3(&normals[3 * e.from], &normals[3 * e.to]) < 0.0f) {
+        for (int d = 0; d < 3; d++)
+          normals[3 * e.to + d] = -normals[3 * e.to + d];
+      }
+      for (int32_t j = 0; j < k; j++) {
+        if (!nbr_ok[e.to * k + j]) continue;
+        int32_t nb = nbr_idx[e.to * k + j];
+        if (visited[nb]) continue;
+        float w =
+            1.0f - std::abs(dot3(&normals[3 * e.to], &normals[3 * nb]));
+        heap.push({w, e.to, nb});
+      }
+    }
+  }
+  return components;
+}
+
+// ---------------------------------------------------------------------------
+// Connected components over the KNN graph (keep-largest-cluster helper)
+// ---------------------------------------------------------------------------
+
+int32_t sl_connected_components(int32_t n, int32_t k, const int32_t* nbr_idx,
+                                const uint8_t* nbr_ok, int32_t* labels) {
+  std::vector<int32_t> parent(n), rank(n, 0);
+  for (int32_t i = 0; i < n; i++) parent[i] = i;
+  for (int32_t i = 0; i < n; i++) {
+    for (int32_t j = 0; j < k; j++) {
+      if (nbr_ok[i * k + j]) uf_union(parent, rank, i, nbr_idx[i * k + j]);
+    }
+  }
+  std::vector<int32_t> root_label(n, -1);
+  int32_t next = 0;
+  for (int32_t i = 0; i < n; i++) {
+    int32_t r = uf_find(parent, i);
+    if (root_label[r] < 0) root_label[r] = next++;
+    labels[i] = root_label[r];
+  }
+  return next;
+}
+
+}  // extern "C"

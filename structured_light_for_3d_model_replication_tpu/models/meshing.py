@@ -14,10 +14,11 @@ Orientation modes mirror `server/processing.py:267-289`:
 
 "Surface" (non-watertight) mode: the reference ball-pivots with radii =
 avg-NN-dist × multipliers (`server/processing.py:222-235`). Ball pivoting is
-sequential front propagation — a poor fit for a vector machine — so the
-TPU-native surface mode is the same Poisson solve with an aggressive density
-trim (open surface where there was no data), with the multiplier string kept
-for CLI compatibility.
+sequential front propagation — a poor fit for a vector machine — so it runs
+in the native C++ layer (`native/src/ball_pivot.cpp`) with the same
+radii-from-average-NN-distance recipe; if the native library is unavailable
+the fallback is the Poisson solve with an aggressive density trim (open
+surface where there was no data).
 """
 
 from __future__ import annotations
@@ -93,12 +94,21 @@ def mesh_from_cloud(
     """
     if mode not in ("watertight", "surface"):
         raise ValueError(f"unknown mesh mode {mode!r}")
-    del radii_multipliers  # accepted for reference-CLI parity
     pts = np.asarray(cloud.points, np.float32)
     if pts.shape[0] < 16:
         raise ValueError(f"too few points to mesh ({pts.shape[0]})")
     normals = ensure_oriented_normals(cloud, orientation_mode,
                                       camera=camera)
+
+    if mode == "surface":
+        mesh = _ball_pivot_mesh(pts, normals, radii_multipliers)
+        if mesh is not None:
+            log.info("ball-pivoted %d points -> %d verts / %d faces",
+                     pts.shape[0], len(mesh.vertices), len(mesh.faces))
+            return mesh
+        log.warning("native ball pivoting unavailable; Poisson surface "
+                    "fallback")
+
     grid = poisson.reconstruct(pts, normals, depth=int(depth),
                                cg_iters=cg_iters)
     trim = quantile_trim if mode == "watertight" else max(quantile_trim, 0.25)
@@ -106,6 +116,31 @@ def mesh_from_cloud(
     log.info("meshed %d points -> %d verts / %d faces (mode=%s depth=%d)",
              pts.shape[0], len(mesh.vertices), len(mesh.faces), mode, depth)
     return mesh
+
+
+def _ball_pivot_mesh(pts: np.ndarray, normals: np.ndarray,
+                     radii_multipliers: str) -> TriangleMesh | None:
+    """Ball-pivoting via the native layer; None when unavailable.
+
+    Radii recipe mirrors `server/processing.py:222-235`: average NN distance
+    scaled by the parsed multiplier list (default "1,2,4")."""
+    from .. import native
+    from ..ops.knn import knn
+
+    if not native.available():
+        return None
+    multipliers = [float(x) for x in str(radii_multipliers).split(",") if x]
+    if not multipliers:
+        multipliers = [1.0, 2.0, 4.0]
+    d2, _, nbv = knn(pts, 1, exclude_self=True)
+    d = np.sqrt(np.asarray(d2)[:, 0])
+    avg = float(d[np.asarray(nbv)[:, 0]].mean()) if np.asarray(
+        nbv).any() else 1.0
+    radii = [avg * m for m in multipliers]
+    tris = native.ball_pivot(pts, normals, radii)
+    if len(tris) == 0:
+        return None
+    return TriangleMesh(vertices=pts.copy(), faces=tris)
 
 
 def reconstruct_stl(

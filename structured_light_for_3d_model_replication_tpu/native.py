@@ -1,0 +1,226 @@
+"""ctypes bindings for the native runtime layer (``native/``).
+
+The split follows the hardware: data-parallel math lives on the TPU
+(ops/*), while the pointer-chasing host work — file codecs, union-find
+clustering, MST normal orientation, ball-pivoting front propagation, grid
+KNN — lives in C++ (the role Open3D's C++ core plays for the reference).
+
+The shared library is built lazily with ``make`` on first use and cached;
+every caller has a pure-Python/JAX fallback, so the native layer is an
+accelerator, never a hard dependency. ``available()`` reports status;
+``SL_NATIVE=0`` disables it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from .utils.log import get_logger
+
+log = get_logger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libslnative.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=300)
+        return True
+    except Exception as e:
+        log.warning("native build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SL_NATIVE", "1") == "0":
+            return None
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            log.warning("native library load failed: %s", e)
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i32, i64, u8 = ctypes.c_int32, ctypes.c_int64, ctypes.c_uint8
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i32p = ctypes.POINTER(i32)
+    u8p = ctypes.POINTER(u8)
+    lib.sl_ply_write.argtypes = [ctypes.c_char_p, i64, f32p, u8p, f32p, i32]
+    lib.sl_ply_write.restype = i32
+    lib.sl_stl_write.argtypes = [ctypes.c_char_p, i64, f32p, i64, i32p]
+    lib.sl_stl_write.restype = i32
+    lib.sl_dbscan_labels.argtypes = [i32, i32, i32p, u8p, u8p, i32p]
+    lib.sl_dbscan_labels.restype = i32
+    lib.sl_mst_orient_normals.argtypes = [i32, i32, f32p, f32p, i32p, u8p,
+                                          f32p]
+    lib.sl_mst_orient_normals.restype = i32
+    lib.sl_connected_components.argtypes = [i32, i32, i32p, u8p, i32p]
+    lib.sl_connected_components.restype = i32
+    lib.sl_ball_pivot.argtypes = [i32, f32p, f32p, f32p, i32, i32p, i32]
+    lib.sl_ball_pivot.restype = i32
+    lib.sl_grid_knn.argtypes = [i32, f32p, i32, f32p, i32, ctypes.c_float,
+                                i32, i32p, f32p]
+    lib.sl_grid_knn.restype = None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _f32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# Wrappers (None-safe: callers check available() or catch RuntimeError)
+# ---------------------------------------------------------------------------
+
+
+def ply_write(path: str, points, colors=None, normals=None,
+              binary: bool = True) -> None:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    pts = _f32(points)
+    n = len(pts)
+    col = None if colors is None else np.ascontiguousarray(colors, np.uint8)
+    nrm = None if normals is None else _f32(normals)
+    rc = lib.sl_ply_write(
+        path.encode(), n, _ptr(pts, ctypes.c_float),
+        None if col is None else _ptr(col, ctypes.c_uint8),
+        None if nrm is None else _ptr(nrm, ctypes.c_float),
+        1 if binary else 0)
+    if rc != 0:
+        raise IOError(f"native PLY write failed ({rc}): {path}")
+
+
+def stl_write(path: str, vertices, faces) -> None:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    v = _f32(vertices)
+    f = np.ascontiguousarray(faces, np.int32)
+    rc = lib.sl_stl_write(path.encode(), len(v), _ptr(v, ctypes.c_float),
+                          len(f), _ptr(f, ctypes.c_int32))
+    if rc != 0:
+        raise IOError(f"native STL write failed ({rc}): {path}")
+
+
+def dbscan_labels(nbr_idx, nbr_ok, core) -> tuple[np.ndarray, int]:
+    """(labels (n,), n_clusters) from a KNN graph; -1 = noise."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    idx = np.ascontiguousarray(nbr_idx, np.int32)
+    ok = np.ascontiguousarray(nbr_ok, np.uint8)
+    co = np.ascontiguousarray(core, np.uint8)
+    n, k = idx.shape
+    labels = np.empty(n, np.int32)
+    count = lib.sl_dbscan_labels(n, k, _ptr(idx, ctypes.c_int32),
+                                 _ptr(ok, ctypes.c_uint8),
+                                 _ptr(co, ctypes.c_uint8),
+                                 _ptr(labels, ctypes.c_int32))
+    return labels, int(count)
+
+
+def mst_orient_normals(points, normals, nbr_idx, nbr_ok,
+                       seed_dir=(0.0, 0.0, 0.0)) -> tuple[np.ndarray, int]:
+    """Consistently oriented copy of ``normals`` + component count."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    pts = _f32(points)
+    nrm = _f32(normals).copy()
+    idx = np.ascontiguousarray(nbr_idx, np.int32)
+    ok = np.ascontiguousarray(nbr_ok, np.uint8)
+    sd = _f32(np.asarray(seed_dir, np.float32))
+    n, k = idx.shape
+    comps = lib.sl_mst_orient_normals(
+        n, k, _ptr(pts, ctypes.c_float), _ptr(nrm, ctypes.c_float),
+        _ptr(idx, ctypes.c_int32), _ptr(ok, ctypes.c_uint8),
+        _ptr(sd, ctypes.c_float))
+    return nrm, int(comps)
+
+
+def connected_components(nbr_idx, nbr_ok) -> tuple[np.ndarray, int]:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    idx = np.ascontiguousarray(nbr_idx, np.int32)
+    ok = np.ascontiguousarray(nbr_ok, np.uint8)
+    n, k = idx.shape
+    labels = np.empty(n, np.int32)
+    count = lib.sl_connected_components(n, k, _ptr(idx, ctypes.c_int32),
+                                        _ptr(ok, ctypes.c_uint8),
+                                        _ptr(labels, ctypes.c_int32))
+    return labels, int(count)
+
+
+def ball_pivot(points, normals, radii) -> np.ndarray:
+    """(T, 3) int32 triangle indices from ball-pivoting reconstruction."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    pts = _f32(points)
+    nrm = _f32(normals)
+    rad = _f32(np.sort(np.asarray(radii, np.float32)))
+    n = len(pts)
+    cap = max(4 * n, 1024)
+    for _ in range(2):
+        out = np.empty((cap, 3), np.int32)
+        rc = lib.sl_ball_pivot(n, _ptr(pts, ctypes.c_float),
+                               _ptr(nrm, ctypes.c_float),
+                               _ptr(rad, ctypes.c_float), len(rad),
+                               _ptr(out, ctypes.c_int32), cap)
+        if rc >= 0:
+            return out[:rc].copy()
+        cap = -rc  # buffer was too small; retry with the reported need
+    raise RuntimeError("ball_pivot: buffer negotiation failed")
+
+
+def grid_knn(points, k, queries=None, cell_size: float = 0.0,
+             exclude_self: bool | None = None):
+    """Exact host KNN: (d2 (m,k), idx (m,k)); idx -1 where fewer than k."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    pts = _f32(points)
+    self_query = queries is None
+    q = pts if self_query else _f32(queries)
+    if exclude_self is None:
+        exclude_self = self_query
+    m, n = len(q), len(pts)
+    idx = np.empty((m, k), np.int32)
+    d2 = np.empty((m, k), np.float32)
+    lib.sl_grid_knn(n, _ptr(pts, ctypes.c_float), m,
+                    _ptr(q, ctypes.c_float), k, cell_size,
+                    1 if exclude_self else 0, _ptr(idx, ctypes.c_int32),
+                    _ptr(d2, ctypes.c_float))
+    return d2, idx

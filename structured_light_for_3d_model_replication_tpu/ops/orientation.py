@@ -48,6 +48,25 @@ def orient_normals_consistent_tangent_plane(
     # Device: KNN graph (indices + distances), one tiled-matmul pass.
     d2, idx, nbv = (np.asarray(a) for a in knn(pts, k_eff))
 
+    # Native fast path: C++ Prim MST + flip propagation over the same graph
+    # (edge weights 1−|n·n| are flip-invariant, so propagation order cannot
+    # change them), then a per-component majority radial vote to pick the
+    # outward sign — same convention as the scipy path's root seeding.
+    from .. import native
+
+    if native.available():
+        out, _ = native.mst_orient_normals(pts, nrm, idx, nbv,
+                                           seed_dir=(0.0, 0.0, 0.0))
+        labels, ncomp = native.connected_components(idx, nbv)
+        r = pts - pts.mean(axis=0)
+        vote = np.einsum("ij,ij->i", out, r)
+        for comp in range(ncomp):
+            m = labels == comp
+            total = float(vote[m].sum())
+            if (total < 0) == outward and total != 0.0:
+                out[m] = -out[m]
+        return out
+
     rows = np.repeat(np.arange(n), k_eff)
     cols = idx.reshape(-1)
     mask = nbv.reshape(-1) & (rows != cols)

@@ -1,0 +1,50 @@
+"""Command-line tools — the headless entry points of the framework.
+
+The reference's only non-GUI entry is the legacy `Old/process_cloud.py`
+argparse script (`:221-236`); every other workflow is reachable solely by
+clicking through Tkinter (`server/gui.py`, `multi_point_cloud_process.py`).
+Here every pipeline stage is a first-class CLI, runnable on a headless TPU
+host:
+
+================  ===========================================================
+``process-cloud``  decode+triangulate scan folder(s) → PLY
+                   (`Old/process_cloud.py`, `multi_point_cloud_process.py`)
+``read-calib``     inspect a ``.mat`` calibration (`Old/read_calib.py`)
+``merge-360``      register+merge a folder of PLYs (`server/gui.py:622-641`)
+``scan-360``       full fused pipeline: stacks → merged cloud (new)
+``mesh``           cloud → STL, watertight/surface (`server/gui.py:643-684`)
+``scan``           drive a capture rig, real or virtual (`server/gui.py:686`)
+================  ===========================================================
+
+Invoke via ``python -m structured_light_for_3d_model_replication_tpu.cli <tool> [args]``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_TOOLS = {
+    "process-cloud": "process_cloud",
+    "read-calib": "read_calib",
+    "merge-360": "merge_360",
+    "scan-360": "scan_360",
+    "mesh": "mesh",
+    "scan": "scan",
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("tools:", ", ".join(sorted(_TOOLS)))
+        return 0
+    tool = argv[0]
+    if tool not in _TOOLS:
+        print(f"unknown tool {tool!r}; available: {', '.join(sorted(_TOOLS))}",
+              file=sys.stderr)
+        return 2
+    import importlib
+
+    mod = importlib.import_module(f".{_TOOLS[tool]}", __name__)
+    return mod.main(argv[1:])

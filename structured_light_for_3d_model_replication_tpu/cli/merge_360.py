@@ -1,0 +1,55 @@
+"""``merge-360``: register and merge a folder of per-stop PLYs.
+
+The GUI merge action (`server/gui.py:622-641` → `merge_pro_360`,
+`server/processing.py:115-181`) plus the strictly-better pose-graph variant
+from the legacy scripts (`Old/360Merge.py`, `Old/new360Merge.py`) behind
+``--method``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="merge-360",
+        description="Register+merge a folder of .ply scans (numeric order)")
+    p.add_argument("--input", "-i", required=True,
+                   help="folder of per-stop .ply files")
+    p.add_argument("--output", "-o", required=True, help="merged .ply")
+    p.add_argument("--method", choices=("posegraph", "sequential"),
+                   default="posegraph")
+    p.add_argument("--voxel-size", type=float, default=0.02,
+                   help="registration/cleanup voxel (reference default 0.02, "
+                        "server/processing.py:115)")
+    p.add_argument("--ransac-iterations", type=int, default=100_000)
+    p.add_argument("--icp-iterations", type=int, default=30)
+    p.add_argument("--max-points", type=int, default=16_384,
+                   help="per-scan registration point cap")
+    p.add_argument("--no-loop-closure", action="store_true",
+                   help="pose-graph without the first↔last edge")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..models import merge
+
+    params = merge.MergeParams(
+        voxel_size=args.voxel_size,
+        ransac_iterations=args.ransac_iterations,
+        icp_iterations=args.icp_iterations,
+        max_points=args.max_points,
+        loop_closure=not args.no_loop_closure,
+    )
+    merged = merge.merge_360_files(args.input, args.output, params=params,
+                                   method=args.method)
+    print(f"merged -> {args.output} ({len(merged)} points)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

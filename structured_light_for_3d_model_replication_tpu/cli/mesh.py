@@ -1,0 +1,60 @@
+"""``mesh``: point cloud → STL surface.
+
+The two GUI meshing actions (`server/gui.py:643-684` →
+`ProcessingLogic.mesh_360` / `reconstruct_stl`, `server/processing.py:
+184-310`) as one CLI: watertight screened-Poisson or the surface mode, with
+density-quantile trimming and normal-orientation choice. Optional cleanup
+passes mirror the Process tab (`remove_background` / `remove_outliers`,
+`server/processing.py:24-76`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="mesh",
+                                description="Mesh a .ply into an .stl")
+    p.add_argument("--input", "-i", required=True, help="input .ply")
+    p.add_argument("--output", "-o", required=True, help="output .stl")
+    p.add_argument("--mode", choices=("watertight", "surface"),
+                   default="watertight")
+    p.add_argument("--depth", type=int, default=8,
+                   help="Poisson octree-equivalent depth (grid 2^depth)")
+    p.add_argument("--trim", type=float, default=0.0,
+                   help="density quantile to trim (0.0 = watertight "
+                        "mesh_360 default, 0.02 = reconstruct_stl default)")
+    p.add_argument("--orientation", choices=("radial", "tangent"),
+                   default="radial",
+                   help="normal orientation (server/processing.py:270-289)")
+    p.add_argument("--remove-background", action="store_true",
+                   help="drop the dominant RANSAC plane first")
+    p.add_argument("--remove-outliers", action="store_true",
+                   help="statistical outlier removal first (20, 2.0)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..io import ply as ply_io
+    from ..models import merge, meshing
+
+    cloud = ply_io.read_ply(args.input)
+    if args.remove_background:
+        cloud = merge.remove_background(cloud)
+    if args.remove_outliers:
+        cloud = merge.remove_outliers(cloud)
+    mesh = meshing.reconstruct_stl(
+        cloud, args.output, mode=args.mode, depth=args.depth,
+        quantile_trim=args.trim, orientation_mode=args.orientation)
+    print(f"{args.input}: {len(cloud)} pts -> {args.output} "
+          f"({len(mesh.vertices)} verts, {len(mesh.faces)} faces)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,63 @@
+"""``scan-360``: the fused pipeline — per-stop capture folders → merged PLY.
+
+The whole post-capture path of the reference (per-stop `generate_cloud`
+then the merge tab) as one device-resident run
+(`models/scan360.scan_folders_to_cloud`). Stops are the subfolders of the
+session dir, numerically sorted — the auto-scan layout
+(`server/gui.py:703-740`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="scan-360",
+        description="Decode, triangulate, register and merge a full 360° "
+                    "session in one run")
+    p.add_argument("--input", "-i", required=True,
+                   help="session folder whose subfolders are per-stop scans")
+    p.add_argument("--calib", "-c", required=True, help=".mat calibration")
+    p.add_argument("--output", "-o", required=True, help="merged .ply")
+    p.add_argument("--method", choices=("sequential", "posegraph"),
+                   default="posegraph")
+    p.add_argument("--voxel-size", type=float, default=0.02)
+    p.add_argument("--max-points", type=int, default=16_384)
+    p.add_argument("--stop-chunk", type=int, default=6,
+                   help="stops decoded per device dispatch (HBM bound)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..io.images import numeric_sort
+    from ..models import merge, scan360
+    from .process_cloud import has_frames
+
+    subs = numeric_sort([
+        os.path.join(args.input, s) for s in os.listdir(args.input)
+        if os.path.isdir(os.path.join(args.input, s))])
+    stop_dirs = [s for s in subs if has_frames(s)]
+    if len(stop_dirs) < 2:
+        raise SystemExit(f"{args.input}: need ≥2 per-stop frame folders, "
+                         f"found {len(stop_dirs)}")
+
+    params = scan360.Scan360Params(
+        merge=merge.MergeParams(voxel_size=args.voxel_size,
+                                max_points=args.max_points),
+        method=args.method,
+        stop_chunk=args.stop_chunk)
+    merged, poses = scan360.scan_folders_to_cloud(
+        stop_dirs, args.calib, output_path=args.output, params=params)
+    print(f"{len(stop_dirs)} stops -> {args.output} ({len(merged)} points)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

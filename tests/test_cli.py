@@ -1,0 +1,127 @@
+"""CLI tools: process-cloud, read-calib, merge-360, scan-360, mesh, scan."""
+
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu import cli
+from structured_light_for_3d_model_replication_tpu.io import images as img_io
+from structured_light_for_3d_model_replication_tpu.io import matcal
+from structured_light_for_3d_model_replication_tpu.io import ply as ply_io
+from structured_light_for_3d_model_replication_tpu.models import synthetic
+from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+    make_calibration,
+)
+
+from .conftest import CAM_H, CAM_W, SMALL_PROJ
+
+
+@pytest.fixture(scope="module")
+def session(tmp_path_factory, synth_rig):
+    """Three rendered stops on disk + a .mat calibration."""
+    root = tmp_path_factory.mktemp("cli_session")
+    cam_K, proj_K, R, T = synth_rig
+    scene = synthetic.Scene(wall_z=None, spheres=(
+        synthetic.Sphere((0.0, 10.0, 500.0), 80.0, 0.9),
+        synthetic.Sphere((60.0, -40.0, 460.0), 35.0, 0.7),
+        synthetic.Sphere((-70.0, 40.0, 530.0), 30.0, 0.8)))
+    scans = synthetic.render_turntable_scans(
+        scene, 3, 12.0, cam_K, proj_K, R, T, CAM_H, CAM_W, SMALL_PROJ)
+    for i, (stack, _) in enumerate(scans):
+        d = root / f"{i:02d}"
+        d.mkdir()
+        for f, frame in enumerate(stack):
+            img_io.write_frame(str(d / f"{f + 1:02d}.png"), frame)
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    mat = root / "calib.mat"
+    matcal.save_calibration_mat(str(mat), calib)
+    return root, mat
+
+
+def test_dispatcher_help(capsys):
+    assert cli.main([]) == 0
+    assert "process-cloud" in capsys.readouterr().out
+    assert cli.main(["bogus"]) == 2
+
+
+def test_process_cloud_single(session, tmp_path):
+    root, mat = session
+    out = tmp_path / "single.ply"
+    rc = cli.main(["process-cloud", "-i", str(root / "00"),
+                   "-c", str(mat), "-o", str(out)])
+    assert rc == 0
+    cloud = ply_io.read_ply(str(out))
+    assert len(cloud) > 500 and cloud.colors is not None
+
+
+def test_process_cloud_batch_fixed(session, tmp_path):
+    root, mat = session
+    out = tmp_path / "batch"
+    rc = cli.main(["process-cloud", "-i", str(root), "-c", str(mat),
+                   "-o", str(out), "--thresholds", "fixed"])
+    assert rc == 0
+    plys = sorted(os.listdir(out))
+    assert plys == ["00.ply", "01.ply", "02.ply"]
+
+
+def test_read_calib(session, capsys):
+    _, mat = session
+    assert cli.main(["read-calib", str(mat)]) == 0
+    text = capsys.readouterr().out
+    assert "camera intrinsics" in text
+    assert "projector center" in text
+    assert "wPlaneCol" in text
+
+
+def test_scan_360_cli(session, tmp_path):
+    root, mat = session
+    out = tmp_path / "merged.ply"
+    rc = cli.main(["scan-360", "-i", str(root), "-c", str(mat),
+                   "-o", str(out), "--method", "sequential",
+                   "--voxel-size", "6.0", "--max-points", "2048"])
+    assert rc == 0
+    assert len(ply_io.read_ply(str(out))) > 200
+
+
+def test_merge_and_mesh_cli(session, tmp_path, rng):
+    # Synthetic sphere cloud -> write plys -> merge -> mesh.
+    clouds = tmp_path / "clouds"
+    clouds.mkdir()
+    base = rng.normal(size=(800, 3)).astype(np.float32)
+    base /= np.linalg.norm(base, axis=1, keepdims=True)
+    base *= 50 + 5 * np.sin(4 * base[:, :1])  # bumpy sphere
+    for i in range(3):
+        th = np.radians(8.0 * i)
+        c, s = np.cos(th), np.sin(th)
+        Rz = np.array([[c, -s, 0], [s, c, 0], [0, 0, 1]], np.float32)
+        ply_io.write_ply(str(clouds / f"{i}.ply"),
+                         ply_io.PointCloud(points=base @ Rz.T))
+    merged = tmp_path / "merged.ply"
+    rc = cli.main(["merge-360", "-i", str(clouds), "-o", str(merged),
+                   "--method", "sequential", "--voxel-size", "4.0",
+                   "--ransac-iterations", "1024", "--max-points", "1024"])
+    assert rc == 0
+    stl = tmp_path / "out.stl"
+    rc = cli.main(["mesh", "-i", str(merged), "-o", str(stl),
+                   "--depth", "5"])
+    assert rc == 0
+    assert stl.stat().st_size > 84
+
+
+def test_scan_virtual_auto360(tmp_path):
+    rc = cli.main(["scan", "auto360", "--virtual", "--name", "t",
+                   "--session", str(tmp_path), "--turns", "2",
+                   "--degrees", "30"])
+    assert rc == 0
+    # Stacks landed in the dated session layout.
+    found = []
+    for dirpath, _, files in os.walk(tmp_path):
+        pngs = [f for f in files if f.endswith(".png")]
+        if pngs:
+            found.append((dirpath, len(pngs)))
+    assert len(found) == 2
+    assert all(n == SMALL_PROJ.n_frames for _, n in found) or all(
+        n > 2 for _, n in found)

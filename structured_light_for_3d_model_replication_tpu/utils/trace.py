@@ -1,0 +1,170 @@
+"""Tracing & per-stage wall-clock metrics.
+
+The reference has no profiling at all (SURVEY.md §5) — its closest artifact
+is the auto-scan progress window's elapsed/avg/remaining arithmetic
+(`server/gui.py:727-731`). Here tracing is first-class, because the
+north-star metric of the whole build is scan→mesh wall-clock seconds:
+
+* :class:`Tracer` — nested wall-clock spans with a thread-local stack;
+  thread-safe aggregation; JSON export; human summary. Spans double as
+  ``jax.profiler.TraceAnnotation`` contexts, so host spans line up with
+  device timelines inside TensorBoard/XProf captures.
+* :func:`device_trace` — wraps ``jax.profiler.start_trace/stop_trace``
+  for a one-line XLA/TPU capture around any workflow.
+* module-level :func:`span` / :func:`summary` / :func:`export` on a global
+  default tracer, so pipeline stages can annotate themselves without
+  threading a tracer object through every call.
+
+Spans measure HOST wall-clock: async dispatches that return lazy arrays
+cost ~0 unless the span body blocks. Wrap the section you time with
+``jax.block_until_ready`` (the workflow entry points here do) or read the
+numbers as dispatch time, which is also a real metric.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import threading
+import time
+
+
+@dataclasses.dataclass
+class SpanRecord:
+    name: str          # dotted path including ancestors ("scan360.register")
+    start_s: float     # monotonic, relative to tracer creation
+    duration_s: float
+    thread: str
+    meta: dict | None = None
+
+
+class Tracer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._t0 = time.monotonic()
+        self.records: list[SpanRecord] = []
+
+    # ------------------------------------------------------------------
+
+    def _stack(self) -> list[str]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta):
+        """Context manager timing a (possibly nested) stage."""
+        stack = self._stack()
+        path = ".".join(stack + [name])
+        stack.append(name)
+        annot = _jax_annotation(path)
+        start = time.monotonic()
+        try:
+            if annot is not None:
+                with annot:
+                    yield
+            else:
+                yield
+        finally:
+            dur = time.monotonic() - start
+            stack.pop()
+            with self._lock:
+                self.records.append(SpanRecord(
+                    name=path,
+                    start_s=start - self._t0,
+                    duration_s=dur,
+                    thread=threading.current_thread().name,
+                    meta=meta or None))
+
+    def wrap(self, name: str):
+        """Decorator form of :meth:`span`."""
+        def deco(fn):
+            def inner(*a, **kw):
+                with self.span(name):
+                    return fn(*a, **kw)
+            inner.__name__ = getattr(fn, "__name__", name)
+            return inner
+        return deco
+
+    # ------------------------------------------------------------------
+
+    def totals(self) -> dict[str, dict]:
+        """Aggregate {span path: {count, total_s, mean_s, max_s}}."""
+        agg: dict[str, dict] = {}
+        with self._lock:
+            records = list(self.records)
+        for r in records:
+            a = agg.setdefault(r.name, {"count": 0, "total_s": 0.0,
+                                        "max_s": 0.0})
+            a["count"] += 1
+            a["total_s"] += r.duration_s
+            a["max_s"] = max(a["max_s"], r.duration_s)
+        for a in agg.values():
+            a["mean_s"] = a["total_s"] / a["count"]
+            for k in ("total_s", "mean_s", "max_s"):
+                a[k] = round(a[k], 6)
+        return agg
+
+    def summary(self) -> str:
+        rows = sorted(self.totals().items(),
+                      key=lambda kv: -kv[1]["total_s"])
+        if not rows:
+            return "(no spans recorded)"
+        w = max(len(k) for k, _ in rows)
+        lines = [f"{'span':<{w}}  {'count':>5}  {'total':>9}  "
+                 f"{'mean':>9}  {'max':>9}"]
+        for k, a in rows:
+            lines.append(f"{k:<{w}}  {a['count']:>5}  "
+                         f"{a['total_s']:>8.3f}s  {a['mean_s']:>8.3f}s  "
+                         f"{a['max_s']:>8.3f}s")
+        return "\n".join(lines)
+
+    def export(self, path: str) -> None:
+        """JSON dump: raw spans + aggregates."""
+        with self._lock:
+            records = [dataclasses.asdict(r) for r in self.records]
+        with open(path, "w") as f:
+            json.dump({"spans": records, "totals": self.totals()}, f,
+                      indent=2)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.records.clear()
+            self._t0 = time.monotonic()
+
+
+def _jax_annotation(name: str):
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture an XLA/TPU profiler trace (TensorBoard/XProf format) for the
+    enclosed block: ``with device_trace("/tmp/trace"): run_pipeline()``."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# Global default tracer
+# ---------------------------------------------------------------------------
+
+GLOBAL = Tracer()
+span = GLOBAL.span
+wrap = GLOBAL.wrap
+summary = GLOBAL.summary
+export = GLOBAL.export
+totals = GLOBAL.totals
+reset = GLOBAL.reset

@@ -1,0 +1,79 @@
+"""GUI orchestrator: headless-safe import + worker plumbing.
+
+The Tk widget tree itself needs a display; what is testable headless is the
+module import contract and the worker-thread/result-queue discipline
+(the reference marshals with `root.after`, `server/gui.py:495-498`)."""
+
+import threading
+import time
+
+from structured_light_for_3d_model_replication_tpu import gui
+
+
+class FakeRoot:
+    """Minimal Tk-root stand-in: `after` runs the callback on a timer
+    thread (close enough to the Tk event loop for queue-pump testing)."""
+
+    def __init__(self):
+        self._timers = []
+
+    def after(self, ms, fn):
+        t = threading.Timer(ms / 1000.0, fn)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+
+def test_module_imports_headless():
+    # Importing must not create a Tk root or touch a display.
+    assert hasattr(gui, "ScannerGUI")
+    assert hasattr(gui, "main")
+
+
+def test_worker_mixin_marshals_results():
+    w = gui.WorkerMixin()
+    w._init_worker(FakeRoot())
+    got = []
+    done = threading.Event()
+
+    def work():
+        return 41 + 1
+
+    def on_done(v):
+        got.append(v)
+        done.set()
+
+    w.run_bg("test", work, on_done)
+    assert done.wait(3.0)
+    assert got == [42]
+
+
+def test_worker_mixin_routes_errors():
+    w = gui.WorkerMixin()
+    w._init_worker(FakeRoot())
+    errs = []
+    done = threading.Event()
+
+    def work():
+        raise RuntimeError("boom")
+
+    w.run_bg("test", work, on_done=lambda v: None,
+             on_error=lambda e: (errs.append(str(e)), done.set()))
+    assert done.wait(3.0)
+    assert errs == ["boom"]
+
+
+def test_worker_runs_off_ui_thread():
+    w = gui.WorkerMixin()
+    w._init_worker(FakeRoot())
+    names = []
+    done = threading.Event()
+
+    def work():
+        names.append(threading.current_thread().name)
+        return None
+
+    w.run_bg("bg-name", work, lambda _: done.set())
+    assert done.wait(3.0)
+    time.sleep(0.05)
+    assert names and names[0] == "bg-name"

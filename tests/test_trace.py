@@ -1,0 +1,92 @@
+"""Tracing subsystem: spans, nesting, aggregation, export."""
+
+import json
+import threading
+import time
+
+from structured_light_for_3d_model_replication_tpu.utils import trace
+
+
+def test_nested_spans_and_totals():
+    tr = trace.Tracer()
+    with tr.span("outer"):
+        with tr.span("inner"):
+            time.sleep(0.01)
+        with tr.span("inner"):
+            pass
+    agg = tr.totals()
+    assert set(agg) == {"outer", "outer.inner"}
+    assert agg["outer.inner"]["count"] == 2
+    assert agg["outer"]["total_s"] >= agg["outer.inner"]["max_s"]
+    assert "outer" in tr.summary()
+
+
+def test_span_metadata_and_export(tmp_path):
+    tr = trace.Tracer()
+    with tr.span("decode", stops=24):
+        pass
+    out = tmp_path / "trace.json"
+    tr.export(str(out))
+    data = json.loads(out.read_text())
+    assert data["spans"][0]["meta"] == {"stops": 24}
+    assert "decode" in data["totals"]
+
+
+def test_threaded_spans_isolated_stacks():
+    tr = trace.Tracer()
+
+    def worker(tag):
+        with tr.span(tag):
+            time.sleep(0.005)
+
+    ts = [threading.Thread(target=worker, args=(f"w{i}",)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    agg = tr.totals()
+    # Each thread's span is top-level — no cross-thread nesting leakage.
+    assert set(agg) == {"w0", "w1", "w2", "w3"}
+
+
+def test_wrap_decorator_and_reset():
+    tr = trace.Tracer()
+
+    @tr.wrap("fn")
+    def fn(x):
+        return x + 1
+
+    assert fn(1) == 2
+    assert tr.totals()["fn"]["count"] == 1
+    tr.reset()
+    assert tr.totals() == {}
+
+
+def test_scan360_emits_spans(synth_rig, synth_scan):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from structured_light_for_3d_model_replication_tpu.models import (
+        merge, scan360)
+    from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+        make_calibration)
+    from .conftest import CAM_H, CAM_W, SMALL_PROJ
+
+    trace.reset()
+    cam_K, proj_K, R, T = synth_rig
+    stack, _ = synth_scan
+    stacks = np.stack([stack, stack])  # two identical stops registers fine
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    params = scan360.Scan360Params(merge=merge.MergeParams(
+        voxel_size=6.0, ransac_iterations=512, icp_iterations=5,
+        fpfh_max_nn=16, normals_k=8, max_points=1024))
+    scan360.scan_stacks_to_cloud(jnp.asarray(stacks), calib,
+                                 SMALL_PROJ.col_bits, SMALL_PROJ.row_bits,
+                                 params=params)
+    agg = trace.totals()
+    for name in ("scan360.decode_triangulate", "scan360.subsample",
+                 "scan360.register", "scan360.merge"):
+        assert name in agg, f"missing span {name}"
+    trace.reset()

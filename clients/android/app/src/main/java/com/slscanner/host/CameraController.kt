@@ -1,0 +1,233 @@
+// Camera2 capture engine with full manual control.
+//
+// Structured-light needs REPEATABLE exposure: auto-exposure re-meters every
+// projected stripe pattern (dark frames meter bright, bright frames meter
+// dark), which destroys the decode thresholds. So the host supports AE/AF/AWB
+// fully off with explicit exposure_ns / iso / focus_diopters, applied to a
+// single still-capture request per /capture/jpeg call.
+package com.slscanner.host
+
+import android.content.Context
+import android.graphics.ImageFormat
+import android.hardware.camera2.CameraCaptureSession
+import android.hardware.camera2.CameraCharacteristics
+import android.hardware.camera2.CameraDevice
+import android.hardware.camera2.CameraManager
+import android.hardware.camera2.CaptureRequest
+import android.hardware.camera2.TotalCaptureResult
+import android.media.ImageReader
+import android.os.Handler
+import android.os.HandlerThread
+import android.util.Log
+import android.util.Size
+import java.util.concurrent.CountDownLatch
+import java.util.concurrent.TimeUnit
+
+data class Settings(
+    var aeOn: Boolean = true,
+    var exposureNs: Long? = null,
+    var iso: Int? = null,
+    var afOn: Boolean = true,
+    var focusDiopters: Float? = null,
+    var awbAuto: Boolean = true,
+    var zoom: Float = 1.0f,
+    var stabilization: Boolean = false,
+    var jpegQuality: Int = 95,
+    var targetWidth: Int = 1600,
+)
+
+class CameraController(private val context: Context) {
+    private val tag = "SlCamera"
+    private val thread = HandlerThread("camera").apply { start() }
+    private val handler = Handler(thread.looper)
+
+    val settings = Settings()
+
+    private var device: CameraDevice? = null
+    private var session: CameraCaptureSession? = null
+    private var reader: ImageReader? = null
+    private lateinit var characteristics: CameraCharacteristics
+    private var cameraId: String = "0"
+
+    val isOpen get() = session != null
+
+    @Synchronized
+    fun ensureOpen() {
+        if (session != null) return
+        val manager =
+            context.getSystemService(Context.CAMERA_SERVICE) as CameraManager
+        cameraId = manager.cameraIdList.firstOrNull { id ->
+            manager.getCameraCharacteristics(id)
+                .get(CameraCharacteristics.LENS_FACING) ==
+                CameraCharacteristics.LENS_FACING_BACK
+        } ?: manager.cameraIdList.first()
+        characteristics = manager.getCameraCharacteristics(cameraId)
+
+        val size = pickJpegSize(settings.targetWidth)
+        reader = ImageReader.newInstance(size.width, size.height,
+                                         ImageFormat.JPEG, 2)
+
+        val opened = CountDownLatch(1)
+        var error: Exception? = null
+        manager.openCamera(cameraId, object : CameraDevice.StateCallback() {
+            override fun onOpened(d: CameraDevice) {
+                device = d
+                d.createCaptureSession(
+                    listOf(reader!!.surface),
+                    object : CameraCaptureSession.StateCallback() {
+                        override fun onConfigured(s: CameraCaptureSession) {
+                            session = s
+                            opened.countDown()
+                        }
+
+                        override fun onConfigureFailed(
+                            s: CameraCaptureSession
+                        ) {
+                            error = IllegalStateException("configure failed")
+                            opened.countDown()
+                        }
+                    }, handler)
+            }
+
+            override fun onDisconnected(d: CameraDevice) {
+                d.close(); device = null; session = null
+            }
+
+            override fun onError(d: CameraDevice, code: Int) {
+                error = IllegalStateException("camera error $code")
+                d.close(); device = null
+                opened.countDown()
+            }
+        }, handler)
+
+        if (!opened.await(5, TimeUnit.SECONDS)) {
+            throw IllegalStateException("camera open timeout")
+        }
+        error?.let { throw it }
+        Log.i(tag, "camera $cameraId open at $size")
+    }
+
+    @Synchronized
+    fun close() {
+        session?.close(); session = null
+        device?.close(); device = null
+        reader?.close(); reader = null
+    }
+
+    private fun pickJpegSize(targetWidth: Int): Size {
+        val sizes = characteristics.get(
+            CameraCharacteristics.SCALER_STREAM_CONFIGURATION_MAP
+        )!!.getOutputSizes(ImageFormat.JPEG)
+        // Smallest size with width >= target (~1600 px class keeps upload
+        // latency per stack frame bounded); fall back to the largest.
+        return sizes.filter { it.width >= targetWidth }
+            .minByOrNull { it.width } ?: sizes.maxByOrNull { it.width }!!
+    }
+
+    fun capabilities(): String {
+        val manager =
+            context.getSystemService(Context.CAMERA_SERVICE) as CameraManager
+        val ch = manager.getCameraCharacteristics(
+            manager.cameraIdList.first())
+        val exposure =
+            ch.get(CameraCharacteristics.SENSOR_INFO_EXPOSURE_TIME_RANGE)
+        val iso =
+            ch.get(CameraCharacteristics.SENSOR_INFO_SENSITIVITY_RANGE)
+        val focus = ch.get(
+            CameraCharacteristics.LENS_INFO_MINIMUM_FOCUS_DISTANCE)
+        val zoom = ch.get(
+            CameraCharacteristics.SCALER_AVAILABLE_MAX_DIGITAL_ZOOM)
+        return Json.obj(
+            "exposure_ns_min" to exposure?.lower,
+            "exposure_ns_max" to exposure?.upper,
+            "iso_min" to iso?.lower,
+            "iso_max" to iso?.upper,
+            "focus_diopters_max" to focus,
+            "zoom_max" to zoom,
+        ).toString()
+    }
+
+    /** One still capture; returns JPEG bytes + metadata JSON. */
+    fun captureJpeg(): Pair<ByteArray, String> {
+        ensureOpen()
+        val s = session!!
+        val rdr = reader!!
+        // Drain stale images from an aborted previous capture.
+        while (true) rdr.acquireLatestImage()?.close() ?: break
+
+        val request = device!!.createCaptureRequest(
+            CameraDevice.TEMPLATE_STILL_CAPTURE).apply {
+            addTarget(rdr.surface)
+            set(CaptureRequest.JPEG_QUALITY,
+                settings.jpegQuality.toByte())
+            if (!settings.aeOn) {
+                set(CaptureRequest.CONTROL_AE_MODE,
+                    CaptureRequest.CONTROL_AE_MODE_OFF)
+                settings.exposureNs?.let {
+                    set(CaptureRequest.SENSOR_EXPOSURE_TIME, it)
+                }
+                settings.iso?.let {
+                    set(CaptureRequest.SENSOR_SENSITIVITY, it)
+                }
+            }
+            if (!settings.afOn) {
+                set(CaptureRequest.CONTROL_AF_MODE,
+                    CaptureRequest.CONTROL_AF_MODE_OFF)
+                settings.focusDiopters?.let {
+                    set(CaptureRequest.LENS_FOCUS_DISTANCE, it)
+                }
+            }
+            if (!settings.awbAuto) {
+                set(CaptureRequest.CONTROL_AWB_MODE,
+                    CaptureRequest.CONTROL_AWB_MODE_OFF)
+            }
+            if (settings.stabilization) {
+                set(CaptureRequest.CONTROL_VIDEO_STABILIZATION_MODE,
+                    CaptureRequest.CONTROL_VIDEO_STABILIZATION_MODE_ON)
+            }
+            if (settings.zoom > 1.0f) {
+                val active = characteristics.get(
+                    CameraCharacteristics.SENSOR_INFO_ACTIVE_ARRAY_SIZE)!!
+                val cw = (active.width() / settings.zoom).toInt()
+                val chh = (active.height() / settings.zoom).toInt()
+                val cx = (active.width() - cw) / 2
+                val cy = (active.height() - chh) / 2
+                set(CaptureRequest.SCALER_CROP_REGION,
+                    android.graphics.Rect(cx, cy, cx + cw, cy + chh))
+            }
+        }
+
+        val done = CountDownLatch(1)
+        var meta = "{}"
+        s.capture(request.build(),
+                  object : CameraCaptureSession.CaptureCallback() {
+            override fun onCaptureCompleted(
+                sess: CameraCaptureSession,
+                req: CaptureRequest,
+                result: TotalCaptureResult,
+            ) {
+                meta = Json.obj(
+                    "exposure_ns" to
+                        result.get(TotalCaptureResult.SENSOR_EXPOSURE_TIME),
+                    "iso" to
+                        result.get(TotalCaptureResult.SENSOR_SENSITIVITY),
+                    "focus_diopters" to
+                        result.get(TotalCaptureResult.LENS_FOCUS_DISTANCE),
+                ).toString()
+                done.countDown()
+            }
+        }, handler)
+
+        if (!done.await(10, TimeUnit.SECONDS)) {
+            throw IllegalStateException("capture timeout")
+        }
+        val image = rdr.acquireNextImage()
+            ?: throw IllegalStateException("no image produced")
+        image.use {
+            val buf = it.planes[0].buffer
+            val bytes = ByteArray(buf.remaining())
+            buf.get(bytes)
+            return bytes to meta
+        }
+    }
+}

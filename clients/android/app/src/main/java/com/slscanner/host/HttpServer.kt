@@ -1,0 +1,124 @@
+// Minimal blocking HTTP/1.1 server on a raw ServerSocket — no external
+// dependencies (the reference host pulls in NanoHTTPD; a scanner rig needs
+// exactly four routes, so a ~150-line server is the smaller surface).
+package com.slscanner.host
+
+import android.util.Log
+import java.io.BufferedOutputStream
+import java.io.InputStream
+import java.net.ServerSocket
+import java.net.Socket
+import java.nio.charset.StandardCharsets
+import java.util.concurrent.Executors
+
+data class Request(
+    val method: String,
+    val path: String,
+    val headers: Map<String, String>,
+    val body: ByteArray,
+)
+
+data class Response(
+    val status: Int = 200,
+    val contentType: String = "application/json",
+    val body: ByteArray = ByteArray(0),
+    val extraHeaders: Map<String, String> = emptyMap(),
+) {
+    companion object {
+        fun json(text: String, status: Int = 200) =
+            Response(status, "application/json",
+                     text.toByteArray(StandardCharsets.UTF_8))
+
+        fun error(status: Int, message: String) =
+            json("{\"error\": \"${Json.escape(message)}\"}", status)
+    }
+}
+
+class HttpServer(
+    private val port: Int,
+    private val handler: (Request) -> Response,
+) {
+    private val tag = "SlHttpServer"
+    @Volatile private var socket: ServerSocket? = null
+    private val pool = Executors.newFixedThreadPool(2)
+
+    fun start() {
+        val server = ServerSocket(port)
+        socket = server
+        Thread({
+            Log.i(tag, "listening on :$port")
+            while (!server.isClosed) {
+                try {
+                    val client = server.accept()
+                    pool.execute { serve(client) }
+                } catch (e: Exception) {
+                    if (!server.isClosed) Log.e(tag, "accept failed", e)
+                }
+            }
+        }, "http-accept").apply { isDaemon = true }.start()
+    }
+
+    fun stop() {
+        socket?.close()
+        pool.shutdownNow()
+    }
+
+    private fun serve(client: Socket) {
+        client.use { sock ->
+            sock.soTimeout = 10_000
+            try {
+                val request = parse(sock.getInputStream()) ?: return
+                val response = try {
+                    handler(request)
+                } catch (e: Exception) {
+                    Log.e(tag, "handler failed for ${request.path}", e)
+                    Response.error(500, e.message ?: "internal error")
+                }
+                write(sock, response)
+            } catch (e: Exception) {
+                Log.e(tag, "connection dropped", e)
+            }
+        }
+    }
+
+    private fun parse(input: InputStream): Request? {
+        val line = readLine(input) ?: return null
+        val parts = line.split(" ")
+        if (parts.size < 2) return null
+        val headers = mutableMapOf<String, String>()
+        while (true) {
+            val h = readLine(input) ?: break
+            if (h.isEmpty()) break
+            val idx = h.indexOf(':')
+            if (idx > 0) {
+                headers[h.substring(0, idx).trim().lowercase()] =
+                    h.substring(idx + 1).trim()
+            }
+        }
+        val length = headers["content-length"]?.toIntOrNull() ?: 0
+        val body = if (length > 0) input.readNBytes(length) else ByteArray(0)
+        return Request(parts[0], parts[1], headers, body)
+    }
+
+    private fun readLine(input: InputStream): String? {
+        val sb = StringBuilder()
+        while (true) {
+            val c = input.read()
+            if (c == -1) return if (sb.isEmpty()) null else sb.toString()
+            if (c == '\n'.code) return sb.toString().trimEnd('\r')
+            sb.append(c.toChar())
+        }
+    }
+
+    private fun write(sock: Socket, r: Response) {
+        val out = BufferedOutputStream(sock.getOutputStream())
+        val reason = if (r.status == 200) "OK" else "Error"
+        out.write("HTTP/1.1 ${r.status} $reason\r\n".toByteArray())
+        out.write("Content-Type: ${r.contentType}\r\n".toByteArray())
+        out.write("Content-Length: ${r.body.size}\r\n".toByteArray())
+        for ((k, v) in r.extraHeaders) out.write("$k: $v\r\n".toByteArray())
+        out.write("Connection: close\r\n\r\n".toByteArray())
+        out.write(r.body)
+        out.flush()
+    }
+}

@@ -1,0 +1,21 @@
+// Tiny JSON helpers — enough for the flat settings/status payloads this
+// protocol exchanges (org.json is in the Android SDK; these wrappers keep
+// call sites terse and normalize escaping).
+package com.slscanner.host
+
+import org.json.JSONObject
+
+object Json {
+    fun parse(bytes: ByteArray): JSONObject =
+        if (bytes.isEmpty()) JSONObject() else JSONObject(String(bytes))
+
+    fun obj(vararg pairs: Pair<String, Any?>): JSONObject {
+        val o = JSONObject()
+        for ((k, v) in pairs) o.put(k, v ?: JSONObject.NULL)
+        return o
+    }
+
+    fun escape(s: String): String =
+        s.replace("\\", "\\\\").replace("\"", "\\\"")
+            .replace("\n", "\\n").replace("\r", "")
+}

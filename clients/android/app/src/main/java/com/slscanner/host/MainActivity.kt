@@ -1,0 +1,73 @@
+// Foreground activity: asks for the camera permission, starts the host
+// server, shows the listening address + a capture counter. The PC does the
+// rest over HTTP.
+package com.slscanner.host
+
+import android.Manifest
+import android.app.Activity
+import android.content.pm.PackageManager
+import android.os.Bundle
+import android.view.WindowManager
+import android.widget.TextView
+import java.net.NetworkInterface
+
+class MainActivity : Activity() {
+    private lateinit var camera: CameraController
+    private var server: HttpServer? = null
+    private var captures = 0
+
+    override fun onCreate(savedInstanceState: Bundle?) {
+        super.onCreate(savedInstanceState)
+        window.addFlags(WindowManager.LayoutParams.FLAG_KEEP_SCREEN_ON)
+        setContentView(TextView(this).apply {
+            id = android.R.id.text1
+            textSize = 16f
+            setPadding(32, 64, 32, 32)
+        })
+        camera = CameraController(this)
+        if (checkSelfPermission(Manifest.permission.CAMERA) !=
+            PackageManager.PERMISSION_GRANTED) {
+            requestPermissions(arrayOf(Manifest.permission.CAMERA), 1)
+        } else {
+            startServer()
+        }
+    }
+
+    override fun onRequestPermissionsResult(
+        code: Int, permissions: Array<String>, results: IntArray,
+    ) {
+        if (results.firstOrNull() == PackageManager.PERMISSION_GRANTED) {
+            startServer()
+        } else {
+            status("camera permission denied")
+        }
+    }
+
+    private fun startServer() {
+        val routes = Routes(camera) { captures++; updateStatus() }
+        server = HttpServer(8765, routes::handle).also { it.start() }
+        updateStatus()
+    }
+
+    private fun updateStatus() {
+        val ips = NetworkInterface.getNetworkInterfaces().toList()
+            .flatMap { it.inetAddresses.toList() }
+            .filter { !it.isLoopbackAddress && it.address.size == 4 }
+            .joinToString { it.hostAddress ?: "?" }
+        status("SL capture host on :8765\nLAN: $ips\n" +
+               "USB: adb reverse tcp:8765 tcp:8765\n" +
+               "captures served: $captures")
+    }
+
+    private fun status(text: String) {
+        runOnUiThread {
+            findViewById<TextView>(android.R.id.text1).text = text
+        }
+    }
+
+    override fun onDestroy() {
+        server?.stop()
+        camera.close()
+        super.onDestroy()
+    }
+}

@@ -1,0 +1,74 @@
+// Route table binding the HTTP server to the camera engine — the wire
+// contract the PC's PushCamera speaks (structured_light_for_3d_model_replication_tpu/hw/camera.py).
+package com.slscanner.host
+
+import java.net.URLEncoder
+
+class Routes(
+    private val camera: CameraController,
+    private val onCapture: () -> Unit,
+) {
+    fun handle(req: Request): Response = when {
+        req.path == "/status" && req.method == "GET" -> status()
+        req.path == "/capabilities" && req.method == "GET" ->
+            Response.json(camera.capabilities())
+        req.path == "/settings" && req.method == "POST" -> settings(req)
+        req.path == "/capture/jpeg" && req.method == "POST" -> capture()
+        else -> Response.error(404, "no route ${req.method} ${req.path}")
+    }
+
+    private fun status(): Response = Response.json(
+        Json.obj(
+            "camera" to if (camera.isOpen) "ready" else "closed",
+            "settings" to settingsJson(),
+        ).toString())
+
+    private fun settingsJson() = Json.obj(
+        "ae" to if (camera.settings.aeOn) "on" else "off",
+        "exposure_ns" to camera.settings.exposureNs,
+        "iso" to camera.settings.iso,
+        "af" to if (camera.settings.afOn) "on" else "off",
+        "focus_diopters" to camera.settings.focusDiopters,
+        "awb" to if (camera.settings.awbAuto) "auto" else "off",
+        "zoom" to camera.settings.zoom,
+        "stabilization" to
+            if (camera.settings.stabilization) "on" else "off",
+        "jpeg_quality" to camera.settings.jpegQuality,
+        "target_width" to camera.settings.targetWidth,
+    )
+
+    private fun settings(req: Request): Response {
+        val body = Json.parse(req.body)
+        val s = camera.settings
+        if (body.has("ae")) s.aeOn = body.getString("ae") != "off"
+        if (body.has("exposure_ns"))
+            s.exposureNs = body.getLong("exposure_ns")
+        if (body.has("iso")) s.iso = body.getInt("iso")
+        if (body.has("af")) s.afOn = body.getString("af") != "off"
+        if (body.has("focus_diopters"))
+            s.focusDiopters = body.getDouble("focus_diopters").toFloat()
+        if (body.has("awb")) s.awbAuto = body.getString("awb") != "off"
+        if (body.has("zoom")) s.zoom = body.getDouble("zoom").toFloat()
+        if (body.has("stabilization"))
+            s.stabilization = body.getString("stabilization") == "on"
+        if (body.has("jpeg_quality"))
+            s.jpegQuality = body.getInt("jpeg_quality").coerceIn(1, 100)
+        if (body.has("target_width")) {
+            s.targetWidth = body.getInt("target_width")
+            camera.close()  // re-pick the JPEG stream size on next open
+        }
+        return Response.json(settingsJson().toString())
+    }
+
+    private fun capture(): Response {
+        val (bytes, meta) = camera.captureJpeg()
+        onCapture()
+        return Response(
+            status = 200,
+            contentType = "image/jpeg",
+            body = bytes,
+            extraHeaders = mapOf(
+                "X-Capture-Meta" to URLEncoder.encode(meta, "UTF-8")),
+        )
+    }
+}

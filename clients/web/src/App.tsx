@@ -1,0 +1,260 @@
+// Phone camera client for the structured-light scanner.
+//
+// Protocol (capability-parity with the reference client, frotend/App.tsx,
+// re-implemented from the wire contract):
+//   * GET  {server}/poll_command every POLL_MS; response {command, id}.
+//   * A NEW id with command="capture" → grab a frame, JPEG-encode at max
+//     quality, POST multipart to {server}/upload.
+//   * Ids are deduplicated so one projected pattern yields exactly one
+//     upload even though polling repeats while the PC waits.
+//   * Every poll uses an AbortController timeout; repeated failures flip
+//     the status to disconnected (the PC side has its own 5 s watchdog).
+//
+// "Pro mode" drives manual sensor controls through
+// MediaStreamTrack.applyConstraints (exposureTime, iso, focusDistance,
+// zoom, torch) — structured light wants LOCKED exposure so stripe
+// brightness is comparable across the 46-frame stack.
+
+import React, {
+  useCallback,
+  useEffect,
+  useRef,
+  useState,
+} from "react";
+import {
+  CameraCaps,
+  ConnectionState,
+  DEFAULT_PRO,
+  PollResponse,
+  ProSettings,
+} from "./types";
+
+const POLL_MS = 500; // reference cadence (frotend/App.tsx:5)
+const POLL_TIMEOUT_MS = 2000;
+const JPEG_QUALITY = 1.0;
+const LOG_LINES = 5;
+const TARGET = { width: { ideal: 3840 }, height: { ideal: 2160 } };
+
+function serverBase(): string {
+  const q = new URLSearchParams(window.location.search).get("server");
+  return q ?? `${window.location.protocol}//${window.location.hostname}:5000`;
+}
+
+export default function App() {
+  const videoRef = useRef<HTMLVideoElement>(null);
+  const canvasRef = useRef<HTMLCanvasElement>(null);
+  const trackRef = useRef<MediaStreamTrack | null>(null);
+  const lastIdRef = useRef<string>("");
+  const failuresRef = useRef(0);
+
+  const [status, setStatus] = useState<ConnectionState>("connecting");
+  const [caps, setCaps] = useState<CameraCaps>({});
+  const [pro, setPro] = useState<ProSettings>(DEFAULT_PRO);
+  const [log, setLog] = useState<string[]>([]);
+  const [captures, setCaptures] = useState(0);
+
+  const addLog = useCallback((msg: string) => {
+    setLog((l) => [
+      `${new Date().toLocaleTimeString()} ${msg}`,
+      ...l.slice(0, LOG_LINES - 1),
+    ]);
+  }, []);
+
+  // ---- camera open -------------------------------------------------------
+  useEffect(() => {
+    let stream: MediaStream | null = null;
+    (async () => {
+      try {
+        stream = await navigator.mediaDevices.getUserMedia({
+          video: { facingMode: "environment", ...TARGET },
+          audio: false,
+        });
+        const video = videoRef.current!;
+        video.srcObject = stream;
+        await video.play();
+        const track = stream.getVideoTracks()[0];
+        trackRef.current = track;
+        const c = (track.getCapabilities?.() ?? {}) as CameraCaps;
+        setCaps(c);
+        const s = track.getSettings();
+        addLog(`camera ${s.width}x${s.height}`);
+      } catch (e) {
+        addLog(`camera error: ${e}`);
+      }
+    })();
+    return () => stream?.getTracks().forEach((t) => t.stop());
+  }, [addLog]);
+
+  // ---- capture + upload --------------------------------------------------
+  const handleCapture = useCallback(
+    async (id: string) => {
+      const video = videoRef.current;
+      const canvas = canvasRef.current;
+      if (!video || !canvas || video.videoWidth === 0) {
+        addLog("capture requested before camera ready");
+        return;
+      }
+      setStatus("capturing");
+      canvas.width = video.videoWidth;
+      canvas.height = video.videoHeight;
+      canvas.getContext("2d")!.drawImage(video, 0, 0);
+      const blob: Blob = await new Promise((res) =>
+        canvas.toBlob((b) => res(b!), "image/jpeg", JPEG_QUALITY)
+      );
+      const form = new FormData();
+      form.append("file", blob, `${id}.jpg`);
+      try {
+        const r = await fetch(`${serverBase()}/upload`, {
+          method: "POST",
+          body: form,
+        });
+        if (!r.ok) throw new Error(`HTTP ${r.status}`);
+        setCaptures((n) => n + 1);
+        addLog(`frame uploaded (${(blob.size / 1024).toFixed(0)} kB)`);
+      } catch (e) {
+        addLog(`upload failed: ${e}`);
+      } finally {
+        setStatus("connected");
+      }
+    },
+    [addLog]
+  );
+
+  // ---- poll loop ---------------------------------------------------------
+  useEffect(() => {
+    let live = true;
+    const tick = async () => {
+      if (!live) return;
+      const ctrl = new AbortController();
+      const timer = setTimeout(() => ctrl.abort(), POLL_TIMEOUT_MS);
+      try {
+        const r = await fetch(`${serverBase()}/poll_command`, {
+          signal: ctrl.signal,
+        });
+        const data = (await r.json()) as PollResponse;
+        failuresRef.current = 0;
+        setStatus((s) => (s === "capturing" ? s : "connected"));
+        if (data.command === "capture" && data.id !== lastIdRef.current) {
+          lastIdRef.current = data.id; // dedup BEFORE the async capture
+          void handleCapture(data.id);
+        }
+      } catch {
+        failuresRef.current += 1;
+        if (failuresRef.current >= 3) setStatus("disconnected");
+      } finally {
+        clearTimeout(timer);
+        if (live) setTimeout(tick, POLL_MS);
+      }
+    };
+    void tick();
+    return () => {
+      live = false;
+    };
+  }, [handleCapture]);
+
+  // ---- pro mode ----------------------------------------------------------
+  const applyPro = useCallback(
+    async (next: ProSettings) => {
+      setPro(next);
+      const track = trackRef.current;
+      if (!track) return;
+      const adv: Record<string, unknown> = {};
+      if (next.enabled) {
+        if (next.shutterMs != null)
+          adv.exposureTime = next.shutterMs * 10; // ms → 100µs units
+        if (next.iso != null) adv.iso = next.iso;
+        if (next.focusDistance != null) {
+          adv.focusMode = "manual";
+          adv.focusDistance = next.focusDistance;
+        }
+        if (next.zoom != null) adv.zoom = next.zoom;
+        adv.torch = next.torch;
+        if (adv.exposureTime != null || adv.iso != null)
+          adv.exposureMode = "manual";
+        adv.whiteBalanceMode = "manual";
+      } else {
+        adv.exposureMode = "continuous";
+        adv.focusMode = "continuous";
+        adv.whiteBalanceMode = "continuous";
+        adv.torch = false;
+      }
+      try {
+        await track.applyConstraints({ advanced: [adv] } as never);
+        addLog(next.enabled ? "pro settings applied" : "auto mode");
+      } catch (e) {
+        addLog(`constraint rejected: ${e}`);
+      }
+    },
+    [addLog]
+  );
+
+  const slider = (
+    label: string,
+    key: keyof ProSettings,
+    range?: { min: number; max: number; step?: number }
+  ) =>
+    range && (
+      <label className="slider">
+        {label}
+        <input
+          type="range"
+          min={range.min}
+          max={range.max}
+          step={range.step ?? (range.max - range.min) / 100}
+          value={(pro[key] as number | null) ?? range.min}
+          onChange={(e) =>
+            void applyPro({ ...pro, [key]: Number(e.target.value) })
+          }
+        />
+        <span>{String(pro[key] ?? "auto")}</span>
+      </label>
+    );
+
+  return (
+    <div className="app">
+      <header className={`status ${status}`}>
+        <span>{status}</span>
+        <span>{captures} frames</span>
+      </header>
+      <video ref={videoRef} playsInline muted />
+      <canvas ref={canvasRef} style={{ display: "none" }} />
+      <section className="controls">
+        <label>
+          <input
+            type="checkbox"
+            checked={pro.enabled}
+            onChange={(e) =>
+              void applyPro({ ...pro, enabled: e.target.checked })
+            }
+          />
+          Pro mode (lock exposure for scanning)
+        </label>
+        {pro.enabled && (
+          <>
+            {slider("Shutter (ms)", "shutterMs", { min: 1, max: 100 })}
+            {slider("ISO", "iso", caps.iso)}
+            {slider("Focus", "focusDistance", caps.focusDistance)}
+            {slider("Zoom", "zoom", caps.zoom)}
+            {caps.torch && (
+              <label>
+                <input
+                  type="checkbox"
+                  checked={pro.torch}
+                  onChange={(e) =>
+                    void applyPro({ ...pro, torch: e.target.checked })
+                  }
+                />
+                Torch
+              </label>
+            )}
+          </>
+        )}
+      </section>
+      <ul className="log">
+        {log.map((l, i) => (
+          <li key={i}>{l}</li>
+        ))}
+      </ul>
+    </div>
+  );
+}

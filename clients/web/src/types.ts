@@ -1,0 +1,52 @@
+// Wire types for the PC command server
+// (structured_light_for_3d_model_replication_tpu/hw/command_server.py; same shape as the
+// reference protocol, server/server.py:27-78).
+
+export interface PollResponse {
+  command: "idle" | "capture";
+  id: string;
+}
+
+export type ConnectionState =
+  | "connecting"
+  | "connected"
+  | "capturing"
+  | "disconnected";
+
+/** Manual camera controls ("pro mode"). All optional — a capability the
+ * device lacks stays at auto. */
+export interface ProSettings {
+  enabled: boolean;
+  /** Exposure time in milliseconds (mapped to exposureTime in 100µs units
+   * where the implementation expects them). */
+  shutterMs: number | null;
+  iso: number | null;
+  /** 0 = infinity focus; device-specific diopter scale. */
+  focusDistance: number | null;
+  zoom: number | null;
+  torch: boolean;
+}
+
+export const DEFAULT_PRO: ProSettings = {
+  enabled: false,
+  shutterMs: null,
+  iso: null,
+  focusDistance: null,
+  zoom: null,
+  torch: false,
+};
+
+/** Capability ranges discovered from MediaStreamTrack.getCapabilities(). */
+export interface CapRange {
+  min: number;
+  max: number;
+  step?: number;
+}
+
+export interface CameraCaps {
+  exposureTime?: CapRange;
+  iso?: CapRange;
+  focusDistance?: CapRange;
+  zoom?: CapRange;
+  torch?: boolean;
+}

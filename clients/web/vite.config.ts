@@ -1,0 +1,9 @@
+import { defineConfig } from "vite";
+import react from "@vitejs/plugin-react";
+
+// The dev server must be reachable from the phone on the LAN; camera access
+// needs a secure context, so use HTTPS or a localhost tunnel (adb reverse).
+export default defineConfig({
+  plugins: [react()],
+  server: { host: true, port: 5173 },
+});

@@ -1,0 +1,71 @@
+// ESP32 turntable firmware — NEMA 17 bipolar stepper on an A4988 driver.
+//
+// Same serial protocol as the ULN2003 variant (and as the PC driver in
+// structured_light_for_3d_model_replication_tpu/hw/turntable.py expects; reference counterpart
+// Old/arduino_turntable.txt): "<degrees>\n" → blocking move → "DONE\n".
+//
+// DIR/STEP/EN wiring with 1/16 microstepping strapped on MS1..MS3:
+// 200 full steps × 16 = 3200 microsteps per revolution.
+
+static const int PIN_DIR = 4;
+static const int PIN_STEP = 5;
+static const int PIN_EN = 18;  // active low
+
+static const long MICROSTEPS_PER_REV = 3200;
+static const uint32_t STEP_HIGH_US = 4;
+static const uint32_t STEP_INTERVAL_US = 600;  // ~31 RPM
+
+// Trapezoidal-ish ramp: start slow, shave the interval down, mirror at the
+// end — a direct constant-speed drive skips steps under platter inertia.
+static const uint32_t RAMP_START_US = 1400;
+static const long RAMP_STEPS = 200;
+
+static void step_pulse(uint32_t interval_us) {
+  digitalWrite(PIN_STEP, HIGH);
+  delayMicroseconds(STEP_HIGH_US);
+  digitalWrite(PIN_STEP, LOW);
+  delayMicroseconds(interval_us - STEP_HIGH_US);
+}
+
+static uint32_t interval_at(long i, long total) {
+  long from_edge = min(i, total - 1 - i);
+  if (from_edge >= RAMP_STEPS) return STEP_INTERVAL_US;
+  // Linear interpolation from RAMP_START_US down to cruise.
+  return RAMP_START_US -
+         (uint32_t)((RAMP_START_US - STEP_INTERVAL_US) * (float)from_edge /
+                    (float)RAMP_STEPS);
+}
+
+static void rotate_degrees(float deg) {
+  long steps = lroundf(fabsf(deg) / 360.0f * (float)MICROSTEPS_PER_REV);
+  if (steps == 0) return;
+  digitalWrite(PIN_DIR, deg >= 0 ? HIGH : LOW);
+  digitalWrite(PIN_EN, LOW);  // energize
+  delayMicroseconds(50);
+  for (long i = 0; i < steps; i++) step_pulse(interval_at(i, steps));
+  digitalWrite(PIN_EN, HIGH);  // release: silent + cool between scans
+}
+
+void setup() {
+  pinMode(PIN_DIR, OUTPUT);
+  pinMode(PIN_STEP, OUTPUT);
+  pinMode(PIN_EN, OUTPUT);
+  digitalWrite(PIN_EN, HIGH);
+  Serial.begin(115200);
+}
+
+void loop() {
+  if (!Serial.available()) return;
+  String line = Serial.readStringUntil('\n');
+  line.trim();
+  if (line.length() == 0) return;
+
+  char *end = nullptr;
+  float deg = strtof(line.c_str(), &end);
+  if (end == line.c_str()) {
+    Serial.println("ERR");
+    return;
+  }
+  rotate_degrees(deg);
+  Serial.println("DONE");
+}

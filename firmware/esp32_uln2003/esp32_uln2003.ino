@@ -1,0 +1,87 @@
+// ESP32 turntable firmware — 28BYJ-48 geared stepper on a ULN2003 driver.
+//
+// Serial protocol (PC side: structured_light_for_3d_model_replication_tpu/hw/turntable.py,
+// reference counterpart ESP_code.ino): the host sends a signed decimal
+// degree value terminated by '\n'; the firmware executes the full move
+// blocking, then prints "DONE\n". Unparseable lines answer "ERR\n".
+//
+// The 28BYJ-48 has 32 steps/rev on the rotor and a ~63.68396:1 gearbox —
+// nominally 2037.9 half-steps/rev; gear lash and load make the effective
+// ratio rig-specific, so STEPS_PER_REV is meant to be calibrated (command
+// "C<steps>\n" persists a new value to NVS).
+
+#include <Preferences.h>
+
+// ULN2003 IN1..IN4.
+static const int COIL_PINS[4] = {19, 5, 18, 17};
+
+// Half-step sequence: smoother and stronger than wave drive.
+static const uint8_t HALFSTEP[8][4] = {
+    {1, 0, 0, 0}, {1, 1, 0, 0}, {0, 1, 0, 0}, {0, 1, 1, 0},
+    {0, 0, 1, 0}, {0, 0, 1, 1}, {0, 0, 0, 1}, {1, 0, 0, 1},
+};
+
+static const uint32_t STEP_INTERVAL_US = 900;  // ~10 RPM with margin
+static long steps_per_rev = 4076;              // half-steps; calibratable
+
+Preferences prefs;
+static int phase = 0;
+
+static void write_phase(int p) {
+  for (int i = 0; i < 4; i++) {
+    digitalWrite(COIL_PINS[i], HALFSTEP[p][i] ? HIGH : LOW);
+  }
+}
+
+static void coils_off() {
+  for (int i = 0; i < 4; i++) digitalWrite(COIL_PINS[i], LOW);
+}
+
+static void step_n(long n) {
+  int dir = n >= 0 ? 1 : -1;
+  long todo = labs(n);
+  for (long s = 0; s < todo; s++) {
+    phase = (phase + dir + 8) % 8;
+    write_phase(phase);
+    delayMicroseconds(STEP_INTERVAL_US);
+  }
+  // De-energize between moves: the gearbox self-holds and the coils run hot.
+  coils_off();
+}
+
+void setup() {
+  for (int i = 0; i < 4; i++) pinMode(COIL_PINS[i], OUTPUT);
+  coils_off();
+  Serial.begin(115200);
+  prefs.begin("turntable", false);
+  steps_per_rev = prefs.getLong("spr", steps_per_rev);
+}
+
+void loop() {
+  if (!Serial.available()) return;
+  String line = Serial.readStringUntil('\n');
+  line.trim();
+  if (line.length() == 0) return;
+
+  if (line[0] == 'C' || line[0] == 'c') {  // calibration: C<steps_per_rev>
+    long v = line.substring(1).toInt();
+    if (v > 0) {
+      steps_per_rev = v;
+      prefs.putLong("spr", v);
+      Serial.println("DONE");
+    } else {
+      Serial.println("ERR");
+    }
+    return;
+  }
+
+  char *end = nullptr;
+  float deg = strtof(line.c_str(), &end);
+  if (end == line.c_str()) {
+    Serial.println("ERR");
+    return;
+  }
+  long steps = lroundf(deg / 360.0f * (float)steps_per_rev);
+  step_n(steps);
+  Serial.println("DONE");
+}

@@ -1,0 +1,171 @@
+"""Morton-blocked KNN — the gather-free large-N neighborhood engine.
+
+The spatial-grid KNN (ops/gridknn.py) is algorithmically right but
+bandwidth-wrong on TPU: per-query candidate collection is a huge RANDOM
+gather ((N, 27, C) indices), and random gathers are the one memory pattern
+a TPU does poorly. This module restructures the same idea so that ALL bulk
+data movement is contiguous:
+
+1. sort points once by 30-bit Morton code (10 bits/axis, interleaved) —
+   the space-filling curve puts spatial neighbors next to each other in
+   memory;
+2. reshape the sorted cloud into blocks of B points; the candidate set of
+   every query in block b is blocks b−1, b, b+1 — THREE CONTIGUOUS SLICES,
+   materialized with two rolls and a concat, no gather;
+3. distances are one batched (B × 3B) matmul per block; top-k via the
+   TPU's PartialReduce (`approx_min_k`) + a tiny exact sort of k.
+
+Approximate by construction: a true neighbor further than one block away
+along the curve is missed. Morton locality makes that rare at B ≥ ~128
+for surface-scan data (measured recall ≈ 0.97–0.99 at k = 20–30), and the
+consumers this engine serves — SOR statistics, PCA normals, FPFH
+histograms — are insensitive to a few percent of substituted
+near-neighbors. Exactness, when needed, lives in ops/knn.py.
+
+O(N·3B) FLOPs, fully dense, one sort. The reference's KDTree
+(`server/processing.py:64,87`) does fewer FLOPs and loses by orders of
+magnitude on a vector machine.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BITS = 10
+_GRID_MAX = (1 << _BITS) - 1
+
+
+def _spread_bits(v: jnp.ndarray) -> jnp.ndarray:
+    """10-bit int → bits spread to every 3rd position (Morton interleave)."""
+    v = (v | (v << 16)) & 0x030000FF
+    v = (v | (v << 8)) & 0x0300F00F
+    v = (v | (v << 4)) & 0x030C30C3
+    v = (v | (v << 2)) & 0x09249249
+    return v
+
+
+def morton_code(cell: jnp.ndarray) -> jnp.ndarray:
+    """(N, 3) int32 grid coords in [0, 1023] → (N,) 30-bit Morton code."""
+    return (_spread_bits(cell[:, 0])
+            | (_spread_bits(cell[:, 1]) << 1)
+            | (_spread_bits(cell[:, 2]) << 2))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _morton_knn_impl(points, valid, k, block, chunk_blocks, exclude_self):
+    n = points.shape[0]
+
+    # Quantize to the Morton grid: finest cells that keep 10 bits/axis.
+    mins = jnp.min(jnp.where(valid[:, None], points, jnp.inf), axis=0)
+    maxs = jnp.max(jnp.where(valid[:, None], points, -jnp.inf), axis=0)
+    h = jnp.maximum(jnp.max(maxs - mins) / _GRID_MAX, 1e-12)
+    cell = jnp.clip(((points - mins) / h).astype(jnp.int32), 0, _GRID_MAX)
+    code = morton_code(cell)
+    # Invalid points sort to the end (and never match as neighbors).
+    sort_key = jnp.where(valid, code, jnp.int32(2**31 - 1))
+
+    order = jnp.argsort(sort_key)
+    pts_s = points[order]
+    val_s = valid[order]
+    orig_s = order.astype(jnp.int32)
+
+    # Pad to a whole number of blocks.
+    pad = (-n) % block
+    if pad:
+        pts_s = jnp.concatenate(
+            [pts_s, jnp.zeros((pad, 3), pts_s.dtype)])
+        val_s = jnp.concatenate([val_s, jnp.zeros(pad, bool)])
+        orig_s = jnp.concatenate(
+            [orig_s, jnp.zeros(pad, jnp.int32)])
+    nb = pts_s.shape[0] // block
+    bp = pts_s.reshape(nb, block, 3)
+    bv = val_s.reshape(nb, block)
+    bi = orig_s.reshape(nb, block)
+
+    # Candidates of block b = blocks b−1, b, b+1 (rolled: the two edge
+    # blocks see a wrapped far-away block — eliminated by distance).
+    def with_neighbors(x):
+        return jnp.concatenate(
+            [jnp.roll(x, 1, axis=0), x, jnp.roll(x, -1, axis=0)], axis=1)
+
+    cp = with_neighbors(bp)   # (nb, 3B, 3)
+    cv = with_neighbors(bv)   # (nb, 3B)
+    ci = with_neighbors(bi)   # (nb, 3B)
+
+    hi = jax.lax.Precision.HIGHEST
+
+    def per_chunk(args):
+        q, qv, qi, kp, kv, ki = args
+        # (C, B, 3B) squared distances via the matmul expansion.
+        q2 = jnp.sum(q * q, axis=-1)                      # (C, B)
+        p2 = jnp.sum(kp * kp, axis=-1)                    # (C, 3B)
+        cross = jnp.einsum("cbd,cnd->cbn", q, kp, precision=hi)
+        d2 = q2[..., :, None] + p2[..., None, :] - 2.0 * cross
+        bad = ~kv[..., None, :]
+        if exclude_self:
+            bad = bad | (qi[..., :, None] == ki[..., None, :])
+        d2 = jnp.where(bad, jnp.inf, d2)
+        flat = d2.reshape(-1, d2.shape[-1])               # (C*B, 3B)
+        cd, carg = jax.lax.approx_min_k(flat, k)
+        cidx = jnp.take_along_axis(
+            jnp.repeat(ki, block, axis=0).reshape(flat.shape[0], -1),
+            carg, axis=1)
+        neg, arg = jax.lax.top_k(-cd, k)                  # ascending order
+        idx = jnp.take_along_axis(cidx, arg, axis=1)
+        dd = -neg
+        okq = qv.reshape(-1)[:, None]
+        nb_ok = jnp.isfinite(dd) & okq
+        return jnp.where(jnp.isfinite(dd), dd, 0.0), idx, nb_ok
+
+    cb = chunk_blocks
+    nb_pad = (-nb) % cb
+    if nb_pad:
+        def padb(x):
+            return jnp.concatenate(
+                [x, jnp.zeros((nb_pad,) + x.shape[1:], x.dtype)])
+        bp, bv, bi, cp, cv, ci = map(padb, (bp, bv, bi, cp, cv, ci))
+    groups = bp.shape[0] // cb
+
+    def g(x):
+        return x.reshape((groups, cb) + x.shape[1:])
+
+    d, i, v = jax.lax.map(per_chunk, (g(bp), g(bv), g(bi),
+                                      g(cp), g(cv), g(ci)))
+    d = d.reshape(-1, k)[: nb * block]
+    i = i.reshape(-1, k)[: nb * block]
+    v = v.reshape(-1, k)[: nb * block]
+
+    # Un-sort: sorted row r belongs to original index orig_s[r]; sorted
+    # rows ≥ n are block padding and scatter to a dump row. (Invalid INPUT
+    # points occupy genuine sorted rows < n; their nb_ok is already False.)
+    pos = jnp.where(jnp.arange(nb * block) < n, orig_s, n)
+    out_d = jnp.zeros((n + 1, k), jnp.float32).at[pos].set(d)[:n]
+    out_i = jnp.zeros((n + 1, k), jnp.int32).at[pos].set(i)[:n]
+    out_v = jnp.zeros((n + 1, k), bool).at[pos].set(v)[:n]
+    return out_d, out_i, out_v
+
+
+def morton_knn(
+    points: jnp.ndarray,
+    k: int,
+    points_valid: jnp.ndarray | None = None,
+    exclude_self: bool = False,
+    block: int = 256,
+    chunk_blocks: int = 64,
+):
+    """Self-query approximate KNN over the Morton curve (module docstring).
+
+    Same contract as ``knn``: (sq_dists (N,k), indices (N,k),
+    neighbor_valid (N,k)), distances ascending.
+    """
+    points = jnp.asarray(points, jnp.float32)
+    n = points.shape[0]
+    if points_valid is None:
+        points_valid = jnp.ones(n, dtype=bool)
+    if 3 * block < k + (1 if exclude_self else 0):
+        raise ValueError(f"block {block} too small for k={k}")
+    return _morton_knn_impl(points, points_valid, k, block,
+                            chunk_blocks, exclude_self)

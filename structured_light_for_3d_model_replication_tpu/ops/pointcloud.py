@@ -27,7 +27,36 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .gridknn import grid_knn
 from .knn import knn
+from .mortonknn import morton_knn
+
+# Above this many points, self-query neighborhoods route to the
+# Morton-blocked engine (O(N·3B), gather-free) instead of the dense tiled
+# matmul (O(N²)) — at 1M points that is ~0.8 s vs tens of seconds.
+APPROX_KNN_THRESHOLD = 131_072
+
+
+def _self_knn(points, k, valid, exclude_self, method="auto"):
+    """Self-query KNN dispatch.
+
+    ``dense``  — exact tiled matmul (ops/knn.py), O(N²);
+    ``morton`` — Morton-blocked approximate (ops/mortonknn.py), the
+                 large-N default: gather-free, ~0.97+ kth-distance accuracy;
+    ``grid``   — 27-cell spatial grid (ops/gridknn.py), higher recall than
+                 morton but random-gather-bound on TPU.
+    """
+    n = points.shape[0]
+    if method == "auto":
+        method = "morton" if n >= APPROX_KNN_THRESHOLD else "dense"
+    if method == "morton":
+        return morton_knn(points, k, points_valid=valid,
+                          exclude_self=exclude_self)
+    if method == "grid":
+        return grid_knn(points, k, points_valid=valid,
+                        exclude_self=exclude_self)
+    return knn(points, k, points_valid=valid, exclude_self=exclude_self)
+
 
 # ---------------------------------------------------------------------------
 # Voxel downsample
@@ -93,12 +122,13 @@ def voxel_downsample(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("nb_neighbors",))
+@functools.partial(jax.jit, static_argnames=("nb_neighbors", "neighbor_method"))
 def statistical_outlier_removal(
     points: jnp.ndarray,
     valid: jnp.ndarray | None = None,
     nb_neighbors: int = 20,
     std_ratio: float = 2.0,
+    neighbor_method: str = "auto",
 ):
     """Open3D ``remove_statistical_outlier`` semantics
     (`server/processing.py:64`: nb=20, ratio=2.0): per point, mean distance
@@ -107,8 +137,8 @@ def statistical_outlier_removal(
     n = points.shape[0]
     if valid is None:
         valid = jnp.ones(n, dtype=bool)
-    d2, _, nbv = knn(points, nb_neighbors, points_valid=valid,
-                     exclude_self=True)
+    d2, _, nbv = _self_knn(points, nb_neighbors, valid, True,
+                           neighbor_method)
     d = jnp.sqrt(d2)
     cnt = jnp.maximum(jnp.sum(nbv, axis=1), 1)
     mean_d = jnp.sum(jnp.where(nbv, d, 0.0), axis=1) / cnt
@@ -121,12 +151,14 @@ def statistical_outlier_removal(
     return valid & (mean_d <= thresh)
 
 
-@functools.partial(jax.jit, static_argnames=("min_neighbors",))
+@functools.partial(jax.jit, static_argnames=("min_neighbors",
+                                             "neighbor_method"))
 def radius_outlier_removal(
     points: jnp.ndarray,
     radius: float,
     min_neighbors: int = 5,
     valid: jnp.ndarray | None = None,
+    neighbor_method: str = "auto",
 ):
     """Open3D ``remove_radius_outlier`` semantics
     (`Old/StatisticalOutlierRemoval.py:86`: nb=5, r=15): keep points with at
@@ -136,8 +168,8 @@ def radius_outlier_removal(
     if valid is None:
         valid = jnp.ones(n, dtype=bool)
     # Having ≥ m neighbors within r  ⇔  the m-th nearest (excl. self) is ≤ r.
-    d2, _, nbv = knn(points, min_neighbors, points_valid=valid,
-                     exclude_self=True)
+    d2, _, nbv = _self_knn(points, min_neighbors, valid, True,
+                           neighbor_method)
     kth_ok = nbv[:, -1] & (d2[:, -1] <= radius * radius)
     return valid & kth_ok
 
@@ -273,11 +305,12 @@ def smallest_eigenvector_sym3(A: jnp.ndarray):
     return v
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "neighbor_method",))
 def estimate_normals(
     points: jnp.ndarray,
     valid: jnp.ndarray | None = None,
     k: int = 30,
+    neighbor_method: str = "auto",
 ):
     """Per-point unit normals from the k-NN covariance (PCA), the standard
     Open3D ``estimate_normals`` method (`server/processing.py:87,178`) —
@@ -290,7 +323,7 @@ def estimate_normals(
     if valid is None:
         valid = jnp.ones(n, dtype=bool)
     pts = jnp.asarray(points, jnp.float32)
-    _, idx, nbv = knn(pts, k, points_valid=valid)  # self included
+    _, idx, nbv = _self_knn(pts, k, valid, False, neighbor_method)
     nbr = pts[idx]  # (N, k, 3)
     w = nbv.astype(jnp.float32)[..., None]  # (N, k, 1)
     cnt = jnp.maximum(jnp.sum(w, axis=1), 1.0)  # (N, 1)

@@ -91,7 +91,8 @@ def fixed_mask(
 
 
 @functools.partial(
-    jax.jit, static_argnums=(1, 2), static_argnames=("cfg", "downsample")
+    jax.jit, static_argnums=(1, 2),
+    static_argnames=("cfg", "downsample", "backend")
 )
 def decode_stack(
     stack: jnp.ndarray,
@@ -99,16 +100,35 @@ def decode_stack(
     row_bits: int,
     cfg: DecodeConfig = DecodeConfig(),
     downsample: int = 1,
+    backend: str = "auto",
 ):
     """Full decode: (n_frames, H, W) stack -> (col_map, row_map, mask).
 
     col_map/row_map are int32 projector PIXEL coordinates per camera pixel
     (coarse codes are rescaled to stripe centers when downsample > 1); mask is
     the per-pixel validity. Dense over all pixels (masking instead of gather).
+
+    ``backend``: "xla" (fused jnp ops), "pallas" (one VMEM-resident TPU
+    kernel, ops/decode_pallas.py), or "auto" (pallas on TPU backends).
     """
-    white, black, col_pairs, row_pairs = split_stack(stack, col_bits, row_bits)
-    col_map = decode_bits(col_pairs) * downsample + (downsample - 1) // 2
-    row_map = decode_bits(row_pairs) * downsample + (downsample - 1) // 2
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() not in ("cpu",) else "xla"
+    expect = 2 + 2 * col_bits + 2 * row_bits
+    if stack.shape[0] != expect:
+        raise ValueError(f"stack has {stack.shape[0]} frames, "
+                         f"expected {expect}")
+    white, black = stack[0], stack[1]
+    if backend == "pallas":
+        from .decode_pallas import decode_maps_pallas
+
+        col_map, row_map = decode_maps_pallas(stack, col_bits, row_bits,
+                                              downsample=downsample)
+    elif backend == "xla":
+        _, _, col_pairs, row_pairs = split_stack(stack, col_bits, row_bits)
+        col_map = decode_bits(col_pairs) * downsample + (downsample - 1) // 2
+        row_map = decode_bits(row_pairs) * downsample + (downsample - 1) // 2
+    else:
+        raise ValueError(f"unknown decode backend {backend!r}")
     if cfg.mode == "adaptive":
         mask = adaptive_mask(
             white, black, cfg.white_factor, cfg.black_percentile, cfg.contrast_frac

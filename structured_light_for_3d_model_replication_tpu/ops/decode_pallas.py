@@ -1,0 +1,89 @@
+"""Pallas TPU kernel for the Gray-code stack decode.
+
+The decode is the per-pixel hot loop of the whole scanner
+(`server/sl_system.py:544-572`: 22 full-frame passes + an XOR cascade). The
+XLA path (ops/decode.py) fuses it well; this kernel goes one step further
+and keeps the ENTIRE per-tile working set in VMEM for one pass over HBM: a
+(F, bh, W) uint8 tile streams in, the pattern/inverse compares, the
+MSB-first bit-pack and the doubling-XOR Gray→binary all run on the VPU
+without ever materializing an (F, H, W) intermediate, and two (bh, W)
+int32 tiles stream out.
+
+The validity mask is NOT in the kernel: its thresholds are data-dependent
+scalars in adaptive mode (global percentile/max reductions), scalar
+operands batch awkwardly under ``vmap`` of a ``pallas_call``, and the mask
+itself is two fused element-wise compares over the reference frames — XLA
+territory. The kernel owns the 22-frame reduction, which is ~95% of the
+decode's memory traffic.
+
+Grid: (H/bh) full-width row bands (every supported capture width is a
+lane multiple; rows pad to the sublane multiple and slice back).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ROW_BLOCK = 64   # sublane-aligned for uint8 (32) with headroom
+_LANE = 128
+
+
+def _decode_kernel(stack_ref, col_ref, row_ref,
+                   *, col_bits: int, row_bits: int, downsample: int):
+    def unpack(base: int, n_bits: int):
+        gray = jnp.zeros(col_ref.shape, jnp.int32)
+        for b in range(n_bits):  # unrolled: n_bits is a compile-time const
+            # Mosaic has no direct uint8 compare/float cast; hop via int32.
+            bit = (stack_ref[base + 2 * b].astype(jnp.int32)
+                   > stack_ref[base + 2 * b + 1].astype(jnp.int32))
+            gray = gray | (bit.astype(jnp.int32) << (n_bits - 1 - b))
+        # Gray → binary: doubling XOR cascade (prefix XOR over bits).
+        shift = 1
+        while shift < n_bits:
+            gray = gray ^ (gray >> shift)
+            shift <<= 1
+        return gray * downsample + (downsample - 1) // 2
+
+    col_ref[:] = unpack(2, col_bits)
+    row_ref[:] = unpack(2 + 2 * col_bits, row_bits)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2),
+                   static_argnames=("downsample", "interpret"))
+def decode_maps_pallas(
+    stack: jnp.ndarray,
+    col_bits: int,
+    row_bits: int,
+    downsample: int = 1,
+    interpret: bool = False,
+):
+    """(F, H, W) uint8 → (col_map i32, row_map i32) — the bit-unpack half
+    of ``decode.decode_stack`` as one VMEM-resident kernel."""
+    f, h, w = stack.shape
+    if w % _LANE:
+        stack = jnp.pad(stack, ((0, 0), (0, 0), (0, (-w) % _LANE)))
+    if h % _ROW_BLOCK:
+        stack = jnp.pad(stack, ((0, 0), (0, (-h) % _ROW_BLOCK), (0, 0)))
+    hp, wp = stack.shape[1], stack.shape[2]
+
+    kernel = functools.partial(_decode_kernel, col_bits=col_bits,
+                               row_bits=row_bits, downsample=downsample)
+    grid = (hp // _ROW_BLOCK,)
+    out_shape = [
+        jax.ShapeDtypeStruct((hp, wp), jnp.int32),
+        jax.ShapeDtypeStruct((hp, wp), jnp.int32),
+    ]
+    tile = lambda: pl.BlockSpec((_ROW_BLOCK, wp), lambda i: (i, 0))
+    col_map, row_map = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((f, _ROW_BLOCK, wp), lambda i: (0, i, 0))],
+        out_specs=[tile(), tile()],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(stack)
+    return col_map[:h, :w], row_map[:h, :w]

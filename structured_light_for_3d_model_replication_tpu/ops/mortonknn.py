@@ -115,7 +115,9 @@ def _morton_knn_impl(points, valid, k, block, chunk_blocks, exclude_self):
             carg, axis=1)
         neg, arg = jax.lax.top_k(-cd, k)                  # ascending order
         idx = jnp.take_along_axis(cidx, arg, axis=1)
-        dd = -neg
+        # Clamp epsilon-negative fp32 matmul-expansion distances: a NaN out
+        # of a later sqrt would poison SOR's global statistics.
+        dd = jnp.maximum(-neg, 0.0)
         okq = qv.reshape(-1)[:, None]
         nb_ok = jnp.isfinite(dd) & okq
         return jnp.where(jnp.isfinite(dd), dd, 0.0), idx, nb_ok

@@ -29,6 +29,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-points", type=int, default=16_384)
     p.add_argument("--stop-chunk", type=int, default=6,
                    help="stops decoded per device dispatch (HBM bound)")
+    p.add_argument("--stl", default=None,
+                   help="also mesh the merged cloud to this STL (watertight "
+                        "screened Poisson; the full scan→print path in one "
+                        "command)")
+    p.add_argument("--mesh-depth", type=int, default=8)
     return p
 
 
@@ -56,6 +61,12 @@ def main(argv=None) -> int:
         stop_dirs, args.calib, output_path=args.output, params=params)
     print(f"{len(stop_dirs)} stops -> {args.output} ({len(merged)} points)",
           file=sys.stderr)
+    if args.stl:
+        from ..models import meshing
+
+        mesh = meshing.mesh_360(merged, args.stl, depth=args.mesh_depth)
+        print(f"meshed -> {args.stl} ({len(mesh.faces)} faces)",
+              file=sys.stderr)
     return 0
 
 

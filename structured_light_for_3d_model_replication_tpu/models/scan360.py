@@ -62,6 +62,10 @@ class Scan360Params:
     # fusion temporaries — more than a v5e has). Chunking bounds memory at
     # chunk × per-stop while keeping dispatch overhead amortized.
     stop_chunk: int = 6
+    # Stops per dispatch in the per-view MERGE reduction — far lighter than
+    # decode (no per-pixel fusion temporaries), so it can run bigger chunks
+    # to cut launch count (each launch is a round trip on remote TPUs).
+    reduce_chunk: int = 6
 
 
 @functools.lru_cache(maxsize=None)
@@ -180,23 +184,27 @@ def scan_stacks_to_cloud(
     reduce_views = _reduce_views_fn(view_cap)
     poses_f = jnp.asarray(poses, jnp.float32)
     with trace.span("scan360.merge", view_cap=view_cap):
-        # Same chunk-shape discipline as stage 1: pad the stop axis with
-        # zeroed stops (all-False valid masks — they contribute nothing),
-        # slice after.
+        # Same chunk-shape discipline as stage 1 (pad the stop axis with
+        # zeroed stops — all-False valid masks contribute nothing — slice
+        # after), but with its own, larger chunk: the reduction holds no
+        # per-pixel fusion temporaries.
+        rchunk = max(1, min(params.reduce_chunk, n))
+        rn_pad = ((n + rchunk - 1) // rchunk) * rchunk
+
         def pad_stops(a):
-            if n_pad == n:
+            if rn_pad == n:
                 return a
-            zeros = jnp.zeros((n_pad - n,) + a.shape[1:], a.dtype)
+            zeros = jnp.zeros((rn_pad - n,) + a.shape[1:], a.dtype)
             return jnp.concatenate([a, zeros])
 
         rp, rc, rv = (pad_stops(res.points), pad_stops(res.colors),
                       pad_stops(res.valid))
         pp = jnp.concatenate(
-            [poses_f, jnp.broadcast_to(jnp.eye(4), (n_pad - n, 4, 4))]
-        ) if n_pad != n else poses_f
+            [poses_f, jnp.broadcast_to(jnp.eye(4), (rn_pad - n, 4, 4))]
+        ) if rn_pad != n else poses_f
         vparts = []
-        for s in range(0, n_pad, chunk):
-            e = s + chunk
+        for s in range(0, rn_pad, rchunk):
+            e = s + rchunk
             vparts.append(reduce_views(pp[s:e], rp[s:e], rc[s:e], rv[s:e]))
         vpts = jnp.concatenate([p for p, _, _ in vparts])[:n]
         vcol = jnp.concatenate([c for _, c, _ in vparts])[:n]

@@ -28,15 +28,20 @@ from .patterns import gray_to_binary
 from ..config import DecodeConfig
 
 
+def _check_frames(stack: jnp.ndarray, col_bits: int, row_bits: int) -> int:
+    n = 2 + 2 * col_bits + 2 * row_bits
+    if stack.shape[0] != n:
+        raise ValueError(f"stack has {stack.shape[0]} frames, expected {n}")
+    return n
+
+
 def split_stack(stack: jnp.ndarray, col_bits: int, row_bits: int):
     """Split a protocol-ordered stack into (white, black, col_pairs, row_pairs).
 
     col_pairs/row_pairs have shape (n_bits, 2, H, W) with [:,0]=pattern,
     [:,1]=inverse.
     """
-    n = 2 + 2 * col_bits + 2 * row_bits
-    if stack.shape[0] != n:
-        raise ValueError(f"stack has {stack.shape[0]} frames, expected {n}")
+    _check_frames(stack, col_bits, row_bits)
     white = stack[0]
     black = stack[1]
     col = stack[2 : 2 + 2 * col_bits].reshape(col_bits, 2, *stack.shape[1:])
@@ -112,11 +117,12 @@ def decode_stack(
     kernel, ops/decode_pallas.py), or "auto" (pallas on TPU backends).
     """
     if backend == "auto":
-        backend = "pallas" if jax.default_backend() not in ("cpu",) else "xla"
-    expect = 2 + 2 * col_bits + 2 * row_bits
-    if stack.shape[0] != expect:
-        raise ValueError(f"stack has {stack.shape[0]} frames, "
-                         f"expected {expect}")
+        # Mosaic kernels are TPU-only; 'axon' is the tunneled-TPU platform
+        # name in the dev environment. Anything else (cpu, gpu, ...) takes
+        # the portable XLA path.
+        backend = ("pallas" if jax.default_backend() in ("tpu", "axon")
+                   else "xla")
+    _check_frames(stack, col_bits, row_bits)
     white, black = stack[0], stack[1]
     if backend == "pallas":
         from .decode_pallas import decode_maps_pallas

@@ -160,7 +160,9 @@ def _knn_padded(
 
 
 def _default_method() -> str:
-    return "approx" if jax.default_backend() not in ("cpu",) else "exact"
+    # Accelerators (incl. the tunneled-TPU "axon" platform) take the
+    # PartialReduce path; CPU keeps the exact oracle default.
+    return "approx" if jax.default_backend() != "cpu" else "exact"
 
 
 def knn(

@@ -265,3 +265,80 @@ def test_pattern_protocol_order(tmp_path):
     want = np.asarray(pattern_stack_for(TINY))
     for got, exp in zip(frames, want):
         np.testing.assert_array_equal(got, exp)
+
+
+def test_stale_upload_cannot_satisfy_next_capture(tmp_path):
+    """A slow upload from a TIMED-OUT capture must not signal the NEXT
+    armed capture (whose file was never written) — regression test for the
+    command-id guard in CommandChannel.accept_upload."""
+    import threading
+    import time as _time
+
+    from structured_light_for_3d_model_replication_tpu.hw.command_server import CommandChannel
+
+    ch = CommandChannel()
+    path_a = str(tmp_path / "a.jpg")
+    path_b = str(tmp_path / "b.jpg")
+
+    # Arm capture A and let it time out with an upload still "in flight":
+    # the uploader snapshots the armed state, then stalls past the timeout.
+    entered = threading.Event()
+    release = threading.Event()
+    real_open = open
+
+    results = {}
+
+    def slow_upload():
+        # Re-implement accept_upload's timing window: grab the armed path
+        # pre-timeout, write post-re-arm. Easiest faithful approximation:
+        # call accept_upload only after capture B re-armed, but with the
+        # OLD command snapshot — achieved by invoking it while A is armed
+        # and blocking the file write via a monkeypatched open.
+        try:
+            results["path"] = ch.accept_upload(b"stale-bytes")
+        except RuntimeError as e:
+            results["err"] = str(e)
+
+    import builtins
+
+    def blocking_open(f, mode="r", *a, **k):
+        if f == path_a and "w" in mode:
+            entered.set()
+            release.wait(5)
+        return real_open(f, mode, *a, **k)
+
+    t_a = threading.Thread(
+        target=lambda: results.setdefault("a_ok",
+                                          ch.trigger_capture(path_a, 1.5)),
+        daemon=True)
+    t_a.start()
+    _time.sleep(0.05)
+    builtins.open = blocking_open
+    try:
+        up = threading.Thread(target=slow_upload, daemon=True)
+        up.start()
+        # Gate on the uploader having passed the armed check BEFORE A's
+        # timeout can lapse — no scheduling race under load.
+        assert entered.wait(5), "upload never reached the file write"
+        t_a.join(3)
+        assert results.get("a_ok") is False  # capture A timed out
+
+        # Re-arm capture B, then let the stale upload finish writing A.
+        done_b = {}
+
+        def capture_b():
+            done_b["ok"] = ch.trigger_capture(path_b, 0.6)
+
+        t_b = threading.Thread(target=capture_b, daemon=True)
+        t_b.start()
+        _time.sleep(0.05)
+        release.set()
+        up.join(2)
+        t_b.join(2)
+    finally:
+        builtins.open = real_open
+        release.set()
+
+    # The stale upload must NOT have satisfied capture B.
+    assert done_b.get("ok") is False, \
+        "stale upload from capture A satisfied capture B"

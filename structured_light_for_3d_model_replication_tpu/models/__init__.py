@@ -11,7 +11,7 @@ from . import oracle, synthetic  # noqa: F401
 
 
 def __getattr__(name):
-    if name in ("pipeline", "meshing", "merge"):
+    if name in ("pipeline", "meshing", "merge", "scan360"):
         # import_module (not `from . import`) so an in-progress circular
         # import resolves from sys.modules instead of recursing into this
         # __getattr__ via the package attribute lookup.

@@ -3,9 +3,12 @@
 from . import (  # noqa: F401
     cluster,
     decode,
+    decode_pallas,
     features,
+    gridknn,
     knn,
     marching,
+    mortonknn,
     orientation,
     patterns,
     pointcloud,

@@ -70,18 +70,32 @@ def decode_maps_pallas(
         stack = jnp.pad(stack, ((0, 0), (0, (-h) % _ROW_BLOCK), (0, 0)))
     hp, wp = stack.shape[1], stack.shape[2]
 
+    # Width blocking: keep the uint8 input tile + two int32 output tiles
+    # within a conservative VMEM budget (a full-width 4K band overflows the
+    # ~16 MB VMEM and crashes the Mosaic compile).
+    bw = wp
+    while bw > _LANE and (f * _ROW_BLOCK * bw            # uint8 input tile
+                          + 8 * _ROW_BLOCK * bw) > 8_000_000:
+        bw //= 2
+    bw = max(bw - bw % _LANE, _LANE)
+    if wp % bw:
+        extra = bw - (wp % bw)
+        stack = jnp.pad(stack, ((0, 0), (0, 0), (0, extra)))
+        wp = stack.shape[2]
+
     kernel = functools.partial(_decode_kernel, col_bits=col_bits,
                                row_bits=row_bits, downsample=downsample)
-    grid = (hp // _ROW_BLOCK,)
+    grid = (hp // _ROW_BLOCK, wp // bw)
     out_shape = [
         jax.ShapeDtypeStruct((hp, wp), jnp.int32),
         jax.ShapeDtypeStruct((hp, wp), jnp.int32),
     ]
-    tile = lambda: pl.BlockSpec((_ROW_BLOCK, wp), lambda i: (i, 0))
+    tile = lambda: pl.BlockSpec((_ROW_BLOCK, bw), lambda i, j: (i, j))
     col_map, row_map = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((f, _ROW_BLOCK, wp), lambda i: (0, i, 0))],
+        in_specs=[pl.BlockSpec((f, _ROW_BLOCK, bw),
+                               lambda i, j: (0, i, j))],
         out_specs=[tile(), tile()],
         out_shape=out_shape,
         interpret=interpret,

@@ -55,6 +55,11 @@ class Scan360Params:
     # or "scan" (whole ring in one launch — lowest latency on remote TPUs,
     # but a much heavier cold compile; see merge.register_sequence).
     ring_strategy: str = "loop"
+    # Decode dispatch: "loop" launches one program per chunk; "scan" runs
+    # ONE lax.scan over the chunks (single launch; requires device-resident
+    # stacks — host arrays fall back to the loop so per-chunk staging still
+    # overlaps compute).
+    decode_strategy: str = "loop"
     view_cap: int = 131_072
     # Stops decoded/triangulated per device dispatch. The dense per-pixel
     # intermediates of ONE 1080p stop already saturate the chip; vmapping
@@ -66,6 +71,26 @@ class Scan360Params:
     # decode (no per-pixel fusion temporaries), so it can run bigger chunks
     # to cut launch count (each launch is a round trip on remote TPUs).
     reduce_chunk: int = 6
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_scan_fn(col_bits: int, row_bits: int, decode_cfg, tri_cfg,
+                    chunk: int):
+    """All decode chunks as ONE lax.scan launch (the chunk program is the
+    scan body, compiled once). Memory contract is unchanged: one chunk of
+    dense fusion temporaries lives at a time."""
+
+    def body(carry, chunk_stacks):
+        r = pipeline_mod.reconstruct_batch_fn(
+            col_bits, row_bits, decode_cfg, tri_cfg)(chunk_stacks, carry)
+        return carry, (r.points, r.colors, r.valid)
+
+    @jax.jit
+    def run(chunked_stacks, calib):
+        _, ys = jax.lax.scan(body, calib, chunked_stacks)
+        return ys
+
+    return run
 
 
 @functools.lru_cache(maxsize=None)
@@ -114,6 +139,9 @@ def scan_stacks_to_cloud(
     if params.method not in ("sequential", "posegraph"):
         raise ValueError(f"method must be 'sequential' or 'posegraph', "
                          f"got {params.method!r}")
+    if params.decode_strategy not in ("loop", "scan"):
+        raise ValueError(f"decode_strategy must be 'loop' or 'scan', "
+                         f"got {params.decode_strategy!r}")
     if key is None:
         key = jax.random.PRNGKey(0)
     n = stacks.shape[0]
@@ -139,19 +167,32 @@ def scan_stacks_to_cloud(
             else jnp.concatenate
         stacks = cat([stacks] + pad)
     with trace.span("scan360.decode_triangulate", stops=n, chunk=chunk):
-        pts_p, col_p, val_p = [], [], []
-        for s in range(0, n_pad, chunk):
-            part = stacks[s:s + chunk]
-            if isinstance(part, np.ndarray):
-                part = jax.device_put(jnp.asarray(part))
-            r = recon(part, calib)
-            pts_p.append(r.points)
-            col_p.append(r.colors)
-            val_p.append(r.valid)
-        res = pipeline_mod.CloudResult(
-            jnp.concatenate(pts_p)[:n], jnp.concatenate(col_p)[:n],
-            jnp.concatenate(val_p)[:n], None, None)
-        del pts_p, col_p, val_p
+        use_scan = (params.decode_strategy == "scan"
+                    and not isinstance(stacks, np.ndarray))
+        if use_scan:
+            dec = _decode_scan_fn(col_bits, row_bits, decode_cfg, tri_cfg,
+                                  chunk)
+            pts, cols, vals = dec(
+                stacks.reshape((n_pad // chunk, chunk) + stacks.shape[1:]),
+                calib)
+            res = pipeline_mod.CloudResult(
+                pts.reshape((n_pad, -1, 3))[:n],
+                cols.reshape((n_pad, -1, 3))[:n],
+                vals.reshape((n_pad, -1))[:n], None, None)
+        else:
+            pts_p, col_p, val_p = [], [], []
+            for s in range(0, n_pad, chunk):
+                part = stacks[s:s + chunk]
+                if isinstance(part, np.ndarray):
+                    part = jax.device_put(jnp.asarray(part))
+                r = recon(part, calib)
+                pts_p.append(r.points)
+                col_p.append(r.colors)
+                val_p.append(r.valid)
+            res = pipeline_mod.CloudResult(
+                jnp.concatenate(pts_p)[:n], jnp.concatenate(col_p)[:n],
+                jnp.concatenate(val_p)[:n], None, None)
+            del pts_p, col_p, val_p
 
     # 2. Fixed-size registration view of each stop (device-side). Clamped to
     # the slot count: a small camera may have fewer pixels than the cap

@@ -136,3 +136,20 @@ def test_scan_stacks_method_validation(turntable_stacks):
             jnp.asarray(stacks), calib, SMALL_PROJ.col_bits,
             SMALL_PROJ.row_bits,
             params=scan360.Scan360Params(method="nope"))
+
+
+def test_decode_strategy_scan_matches_loop(turntable_stacks):
+    stacks, (cam_K, proj_K, R, T) = turntable_stacks
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    base = dict(merge=FAST.merge, method="sequential", view_cap=FAST.view_cap,
+                stop_chunk=2)
+    m_loop, p_loop = scan360.scan_stacks_to_cloud(
+        jnp.asarray(stacks), calib, SMALL_PROJ.col_bits, SMALL_PROJ.row_bits,
+        params=scan360.Scan360Params(**base, decode_strategy="loop"))
+    m_scan, p_scan = scan360.scan_stacks_to_cloud(
+        jnp.asarray(stacks), calib, SMALL_PROJ.col_bits, SMALL_PROJ.row_bits,
+        params=scan360.Scan360Params(**base, decode_strategy="scan"))
+    np.testing.assert_allclose(p_scan, p_loop, atol=1e-4)
+    assert abs(len(m_scan) - len(m_loop)) <= 2

@@ -207,6 +207,114 @@ def stereo_calibrate(
     return StereoResult(K1, D1, K2, D2, R, T.reshape(3), float(rms))
 
 
+def refine_stereo_jax(
+    data: CalibData,
+    stereo: StereoResult,
+    iterations: int = 30,
+) -> StereoResult:
+    """JAX Levenberg–Marquardt refinement of the stereo solve.
+
+    Re-derives the optimization inside ``cv2.stereoCalibrate`` (SURVEY §7's
+    "optionally re-derive the LM optimization in JAX"): joint LM over the
+    stereo extrinsics (ω, t) and the per-pose board extrinsics (ωᵢ, tᵢ),
+    intrinsics FIXED (the CALIB_FIX_INTRINSIC semantics the reference uses,
+    `server/sl_system.py:341-343`), minimizing the combined camera +
+    projector reprojection error. Distortion is treated as zero — matching
+    how the precomputed rays/planes consume the result
+    (`ops/triangulate.py` works in ideal pinhole coordinates).
+
+    The problem is tiny and dense (6 + 6·P parameters, ~4·P·N residuals):
+    one ``jacfwd`` Jacobian + a damped normal-equations solve per step, all
+    jitted. Initialized from the OpenCV solution; returns a StereoResult
+    with the refined R/T and the refined RMS (pixels).
+    """
+    import cv2
+    import jax
+    import jax.numpy as jnp
+
+    n_poses = len(data.obj_pts)
+    n_pts = min(len(o) for o in data.obj_pts)
+    obj = jnp.asarray(np.stack([o[:n_pts] for o in data.obj_pts]),
+                      jnp.float32)                      # (P, N, 3)
+    cam = jnp.asarray(np.stack(
+        [c[:n_pts].reshape(-1, 2) for c in data.cam_pts]), jnp.float32)
+    prj = jnp.asarray(np.stack(
+        [q[:n_pts].reshape(-1, 2) for q in data.proj_pts]), jnp.float32)
+    cam_K = jnp.asarray(stereo.cam_K, jnp.float32)
+    proj_K = jnp.asarray(stereo.proj_K, jnp.float32)
+
+    # Init: stereo from OpenCV; per-pose extrinsics from solvePnP.
+    rvec0, _ = cv2.Rodrigues(np.asarray(stereo.R, np.float64))
+    x0 = [np.asarray(rvec0, np.float32).reshape(3),
+          np.asarray(stereo.T, np.float32).reshape(3)]
+    for i in range(n_poses):
+        ok, rv, tv = cv2.solvePnP(
+            np.asarray(data.obj_pts[i][:n_pts], np.float64),
+            np.asarray(data.cam_pts[i][:n_pts], np.float64),
+            np.asarray(stereo.cam_K, np.float64), None)
+        if not ok:
+            raise RuntimeError(f"solvePnP failed for pose {i}")
+        x0.append(np.asarray(rv, np.float32).reshape(3))
+        x0.append(np.asarray(tv, np.float32).reshape(3))
+    x0 = jnp.concatenate([jnp.asarray(v) for v in x0])
+
+    from .ops.registration import exp_so3 as rodrigues
+
+    def project(K, X):
+        uvw = X @ K.T
+        return uvw[..., :2] / jnp.maximum(uvw[..., 2:3], 1e-9)
+
+    hi = jax.lax.Precision.HIGHEST
+
+    def residuals(x):
+        R_st = rodrigues(x[0:3])
+        t_st = x[3:6]
+        res = []
+        for i in range(n_poses):
+            o = 6 + 6 * i
+            R_i = rodrigues(x[o:o + 3])
+            t_i = x[o + 3:o + 6]
+            Xc = jnp.einsum("ij,nj->ni", R_i, obj[i], precision=hi) + t_i
+            Xp = jnp.einsum("ij,nj->ni", R_st, Xc, precision=hi) + t_st
+            res.append((project(cam_K, Xc) - cam[i]).reshape(-1))
+            res.append((project(proj_K, Xp) - prj[i]).reshape(-1))
+        return jnp.concatenate(res)
+
+    @jax.jit
+    def lm(x0):
+        def step(carry, _):
+            x, lam = carry
+            r = residuals(x)
+            J = jax.jacfwd(residuals)(x)
+            H = J.T @ J
+            g = J.T @ r
+            dx = jnp.linalg.solve(
+                H + lam * jnp.eye(H.shape[0], dtype=H.dtype), g)
+            x_new = x - dx
+            better = jnp.sum(residuals(x_new) ** 2) < jnp.sum(r ** 2)
+            x = jnp.where(better, x_new, x)
+            lam = jnp.where(better, lam * 0.5, lam * 4.0)
+            return (x, lam), None
+
+        (x, _), _ = jax.lax.scan(step, (x0, jnp.float32(1e-3)), None,
+                                 length=iterations)
+        r = residuals(x).reshape(-1, 2)
+        # cv2.stereoCalibrate convention: RMS over point-OBSERVATIONS of
+        # the 2-D reprojection error magnitude (not over scalar
+        # components, which would read sqrt(2) lower).
+        rms = jnp.sqrt(jnp.mean(jnp.sum(r ** 2, axis=1)))
+        return x, rms
+
+    # Sub-pixel refinement needs true fp32 everywhere — TPU matmuls
+    # (projection X @ Kᵀ, JᵀJ, JᵀR, the solve) default to bf16 otherwise.
+    with jax.default_matmul_precision("highest"):
+        x, rms = lm(x0)
+    R = np.asarray(rodrigues(x[0:3]))
+    T = np.asarray(x[3:6])
+    return StereoResult(stereo.cam_K, stereo.cam_dist, stereo.proj_K,
+                        stereo.proj_dist, R, T, float(rms))
+
+
 def calibrate_final(
     pose_dirs: list[str],
     output_mat: str | None = None,

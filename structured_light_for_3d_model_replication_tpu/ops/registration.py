@@ -50,18 +50,22 @@ def skew(v: jnp.ndarray) -> jnp.ndarray:
     ], axis=-2)
 
 
-def exp_se3(omega: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
-    """Rotation-vector + translation -> 4×4 (rotation via Rodrigues; the
-    translation is applied directly, matching the ICP small-step update)."""
+def exp_so3(omega: jnp.ndarray) -> jnp.ndarray:
+    """Rotation vector -> 3×3 rotation (Rodrigues, small-angle-safe)."""
     th = jnp.linalg.norm(omega)
     safe = jnp.where(th > 1e-12, th, 1.0)
     k = omega / safe
     K = skew(k)
     I = jnp.eye(3, dtype=omega.dtype)
     R = I + jnp.sin(th) * K + (1.0 - jnp.cos(th)) * (K @ K)
-    R = jnp.where(th > 1e-12, R, I)
+    return jnp.where(th > 1e-12, R, I)
+
+
+def exp_se3(omega: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
+    """Rotation-vector + translation -> 4×4 (rotation via Rodrigues; the
+    translation is applied directly, matching the ICP small-step update)."""
     T = jnp.eye(4, dtype=omega.dtype)
-    T = T.at[:3, :3].set(R)
+    T = T.at[:3, :3].set(exp_so3(omega))
     T = T.at[:3, 3].set(t)
     return T
 

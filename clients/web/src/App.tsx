@@ -134,7 +134,11 @@ export default function App() {
         const data = (await r.json()) as PollResponse;
         failuresRef.current = 0;
         setStatus((s) => (s === "capturing" ? s : "connected"));
-        if (data.command === "capture" && data.id !== lastIdRef.current) {
+        // Reference servers send the verb as `action` (server/server.py:44),
+        // this framework's server sends BOTH `action` and `command` — accept
+        // either so the client drives both.
+        const verb = data.action ?? data.command;
+        if (verb === "capture" && data.id !== lastIdRef.current) {
           lastIdRef.current = data.id; // dedup BEFORE the async capture
           void handleCapture(data.id);
         }

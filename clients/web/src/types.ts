@@ -3,7 +3,10 @@
 // reference protocol, server/server.py:27-78).
 
 export interface PollResponse {
-  command: "idle" | "capture";
+  /** Reference servers (server/server.py:44) send the verb as `action`;
+   * this framework's server sends both keys. Either may be present. */
+  action?: "idle" | "capture";
+  command?: "idle" | "capture";
   id: string;
 }
 

@@ -99,6 +99,15 @@ int32_t sl_dbscan_labels(int32_t n, int32_t k, const int32_t* nbr_idx,
 // orient_normals_consistent_tangent_plane). Graph edges come from the
 // (n, k) KNN table; the tree is built per connected component with Prim's
 // algorithm and flips follow sign(n_parent . n_child).
+//
+// The traversal works on the SYMMETRIZED graph: KNN lists are directed
+// (i's list holding j does not put i in j's list), and Prim over the raw
+// directed lists can strand a point that appears in nobody else's list as
+// its own root with an arbitrary sign — while the undirected union-find in
+// sl_connected_components gives it the surrounding patch's label, so the
+// per-component majority vote could leave it flipped relative to its
+// patch. A reverse-edge CSR makes Prim's reachability identical to
+// union-find's.
 //   normals (n*3) float32, modified IN PLACE
 //   seed_dir (3)  float32 — roots are flipped to agree with this direction
 //                 (camera/outward hint); pass zeros to keep root signs.
@@ -112,12 +121,47 @@ int32_t sl_mst_orient_normals(int32_t n, int32_t k, const float* /*points*/,
     bool operator<(const Edge& o) const { return w > o.w; }  // min-heap
   };
 
+  // Reverse-edge CSR: rev_idx[rev_off[v] .. rev_off[v+1]) = every u whose
+  // KNN list contains v.
+  std::vector<int32_t> rev_off(n + 1, 0);
+  for (int32_t i = 0; i < n; i++) {
+    for (int32_t j = 0; j < k; j++) {
+      if (nbr_ok[i * k + j]) rev_off[nbr_idx[i * k + j] + 1]++;
+    }
+  }
+  for (int32_t v = 0; v < n; v++) rev_off[v + 1] += rev_off[v];
+  std::vector<int32_t> rev_idx(rev_off[n]);
+  {
+    std::vector<int32_t> cursor(rev_off.begin(), rev_off.end() - 1);
+    for (int32_t i = 0; i < n; i++) {
+      for (int32_t j = 0; j < k; j++) {
+        if (nbr_ok[i * k + j]) rev_idx[cursor[nbr_idx[i * k + j]]++] = i;
+      }
+    }
+  }
+
   std::vector<uint8_t> visited(n, 0);
   std::priority_queue<Edge> heap;
   int32_t components = 0;
 
   auto dot3 = [&](const float* a, const float* b) {
     return a[0] * b[0] + a[1] * b[1] + a[2] * b[2];
+  };
+
+  auto push_edges = [&](int32_t v) {
+    for (int32_t j = 0; j < k; j++) {  // forward: v's own KNN list
+      if (!nbr_ok[v * k + j]) continue;
+      int32_t nb = nbr_idx[v * k + j];
+      if (visited[nb]) continue;
+      heap.push({1.0f - std::abs(dot3(&normals[3 * v], &normals[3 * nb])),
+                 v, nb});
+    }
+    for (int32_t e = rev_off[v]; e < rev_off[v + 1]; e++) {  // reverse
+      int32_t nb = rev_idx[e];
+      if (visited[nb]) continue;
+      heap.push({1.0f - std::abs(dot3(&normals[3 * v], &normals[3 * nb])),
+                 v, nb});
+    }
   };
 
   for (int32_t s = 0; s < n; s++) {
@@ -129,12 +173,7 @@ int32_t sl_mst_orient_normals(int32_t n, int32_t k, const float* /*points*/,
     if (sd < 0.0f) {
       for (int d = 0; d < 3; d++) normals[3 * s + d] = -normals[3 * s + d];
     }
-    for (int32_t j = 0; j < k; j++) {
-      if (!nbr_ok[s * k + j]) continue;
-      int32_t nb = nbr_idx[s * k + j];
-      float w = 1.0f - std::abs(dot3(&normals[3 * s], &normals[3 * nb]));
-      heap.push({w, s, nb});
-    }
+    push_edges(s);
     while (!heap.empty()) {
       Edge e = heap.top();
       heap.pop();
@@ -145,14 +184,7 @@ int32_t sl_mst_orient_normals(int32_t n, int32_t k, const float* /*points*/,
         for (int d = 0; d < 3; d++)
           normals[3 * e.to + d] = -normals[3 * e.to + d];
       }
-      for (int32_t j = 0; j < k; j++) {
-        if (!nbr_ok[e.to * k + j]) continue;
-        int32_t nb = nbr_idx[e.to * k + j];
-        if (visited[nb]) continue;
-        float w =
-            1.0f - std::abs(dot3(&normals[3 * e.to], &normals[3 * nb]));
-        heap.push({w, e.to, nb});
-      }
+      push_edges(e.to);
     }
   }
   return components;

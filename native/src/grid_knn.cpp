@@ -35,22 +35,42 @@ extern "C" {
 void sl_grid_knn(int32_t n, const float* points, int32_t m,
                  const float* queries, int32_t k, float cell_size,
                  int32_t exclude_self, int32_t* out_idx, float* out_d2) {
-  G g;
-  if (cell_size <= 0) {
-    float lo[3] = {1e30f, 1e30f, 1e30f}, hi[3] = {-1e30f, -1e30f, -1e30f};
-    for (int32_t i = 0; i < n; i++) {
-      for (int d = 0; d < 3; d++) {
-        lo[d] = std::min(lo[d], points[3 * i + d]);
-        hi[d] = std::max(hi[d], points[3 * i + d]);
-      }
+  if (n <= 0) {  // no points: every query gets the -1/inf padding
+    for (int32_t j = 0; j < m * k; j++) {
+      out_d2[j] = INFINITY;
+      out_idx[j] = -1;
     }
-    float vol = std::max(1e-12f, (hi[0] - lo[0]) * (hi[1] - lo[1]) *
-                                     (hi[2] - lo[2]));
-    // ~4 points per cell on average (volume heuristic; rings expand if the
-    // data is surface-like and cells are emptier than that).
+    return;
+  }
+  G g;
+  float lo[3] = {1e30f, 1e30f, 1e30f}, hi[3] = {-1e30f, -1e30f, -1e30f};
+  for (int32_t i = 0; i < n; i++) {
+    for (int d = 0; d < 3; d++) {
+      lo[d] = std::min(lo[d], points[3 * i + d]);
+      hi[d] = std::max(hi[d], points[3 * i + d]);
+    }
+  }
+  if (cell_size <= 0) {
+    // ~4 points per cell on average. Degenerate (planar/collinear) clouds
+    // have a near-zero extent on some axis; taking the raw volume would
+    // collapse the cell size by orders of magnitude and make the ring
+    // search below iterate millions of empty shells, so each axis extent
+    // is floored at 1/64 of the largest one.
+    float maxext = 1e-9f;
+    for (int d = 0; d < 3; d++) maxext = std::max(maxext, hi[d] - lo[d]);
+    float vol = 1.0f;
+    for (int d = 0; d < 3; d++) {
+      vol *= std::max(hi[d] - lo[d], maxext / 64.0f);
+    }
     cell_size = std::cbrt(vol * 4.0f / std::max(1, n));
   }
   g.cell = std::max(cell_size, 1e-9f);
+
+  int64_t cell_lo[3], cell_hi[3];
+  for (int d = 0; d < 3; d++) {  // occupied-cell bounding box
+    cell_lo[d] = (int64_t)std::floor(lo[d] / g.cell);
+    cell_hi[d] = (int64_t)std::floor(hi[d] / g.cell);
+  }
 
   for (int32_t i = 0; i < n; i++) {
     g.cells[G::key((int64_t)std::floor(points[3 * i] / g.cell),
@@ -68,8 +88,17 @@ void sl_grid_knn(int32_t n, const float* points, int32_t m,
     cand.clear();
     // Expand rings until we hold >= k candidates whose k-th distance is
     // certified: ring R guarantees coverage radius (R)·cell, so stop once
-    // kth_d2 <= (R·cell)².
-    for (int64_t R = 0; R < (1 << 20); R++) {
+    // kth_d2 <= (R·cell)². No occupied cell lies beyond the occupied-cell
+    // bbox, so rings past the query's Chebyshev distance to its far
+    // corners cannot add candidates.
+    int64_t max_R = 0;
+    for (int d = 0; d < 3; d++) {
+      int64_t c = d == 0 ? cx : (d == 1 ? cy : cz);
+      max_R = std::max(max_R,
+                       std::max(std::abs(c - cell_lo[d]),
+                                std::abs(cell_hi[d] - c)));
+    }
+    for (int64_t R = 0; R <= max_R; R++) {
       // Cells on the shell of radius R (all cells when R == 0).
       for (int64_t x = cx - R; x <= cx + R; x++) {
         for (int64_t y = cy - R; y <= cy + R; y++) {

@@ -219,9 +219,14 @@ def refine_stereo_jax(
     stereo extrinsics (ω, t) and the per-pose board extrinsics (ωᵢ, tᵢ),
     intrinsics FIXED (the CALIB_FIX_INTRINSIC semantics the reference uses,
     `server/sl_system.py:341-343`), minimizing the combined camera +
-    projector reprojection error. Distortion is treated as zero — matching
-    how the precomputed rays/planes consume the result
-    (`ops/triangulate.py` works in ideal pinhole coordinates).
+    projector reprojection error. The residual model is an ideal pinhole —
+    matching how the precomputed rays/planes consume the result
+    (`ops/triangulate.py`) — so the OBSERVATIONS are first undistorted
+    (``cv2.undistortPoints`` with ``P=K``) using the lens models OpenCV
+    estimated jointly with the intrinsics: raw corner detections on a real
+    lens do not satisfy the pinhole projection, and LM against them would
+    drift R/T away from the cv2 solution while reporting an RMS that is not
+    comparable to ``stereo.rms``.
 
     The problem is tiny and dense (6 + 6·P parameters, ~4·P·N residuals):
     one ``jacfwd`` Jacobian + a damped normal-equations solve per step, all
@@ -236,10 +241,24 @@ def refine_stereo_jax(
     n_pts = min(len(o) for o in data.obj_pts)
     obj = jnp.asarray(np.stack([o[:n_pts] for o in data.obj_pts]),
                       jnp.float32)                      # (P, N, 3)
-    cam = jnp.asarray(np.stack(
-        [c[:n_pts].reshape(-1, 2) for c in data.cam_pts]), jnp.float32)
+
+    def _undistort(pts, K, D):
+        # Ideal-pinhole observations re-projected through K (P=K). A zero/
+        # absent distortion model is the identity here (synthetic rigs).
+        if D is None or not np.any(np.abs(np.asarray(D)) > 0):
+            return pts.reshape(-1, 2)
+        und = cv2.undistortPoints(
+            np.asarray(pts, np.float64).reshape(-1, 1, 2),
+            np.asarray(K, np.float64), np.asarray(D, np.float64),
+            P=np.asarray(K, np.float64))
+        return und.reshape(-1, 2).astype(np.float32)
+
+    cam_np = np.stack([_undistort(c[:n_pts], stereo.cam_K, stereo.cam_dist)
+                       for c in data.cam_pts])
+    cam = jnp.asarray(cam_np, jnp.float32)
     prj = jnp.asarray(np.stack(
-        [q[:n_pts].reshape(-1, 2) for q in data.proj_pts]), jnp.float32)
+        [_undistort(q[:n_pts], stereo.proj_K, stereo.proj_dist)
+         for q in data.proj_pts]), jnp.float32)
     cam_K = jnp.asarray(stereo.cam_K, jnp.float32)
     proj_K = jnp.asarray(stereo.proj_K, jnp.float32)
 
@@ -250,7 +269,7 @@ def refine_stereo_jax(
     for i in range(n_poses):
         ok, rv, tv = cv2.solvePnP(
             np.asarray(data.obj_pts[i][:n_pts], np.float64),
-            np.asarray(data.cam_pts[i][:n_pts], np.float64),
+            np.asarray(cam_np[i], np.float64),  # undistorted, dist = None
             np.asarray(stereo.cam_K, np.float64), None)
         if not ok:
             raise RuntimeError(f"solvePnP failed for pose {i}")

@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--push-host", default=None,
                    help="push-mode Android host base URL instead of the "
                         "pull-mode server (e.g. http://127.0.0.1:8765)")
+    p.add_argument("--local-cam", type=int, default=None, metavar="ID",
+                   help="local webcam device id (cv2.VideoCapture) instead "
+                        "of a phone — the reference's no-phone capture rig "
+                        "(Old/sl_calib_capture.py)")
+    p.add_argument("--cam-size", default="1920x1080", metavar="WxH",
+                   help="requested local-camera frame size")
     return p
 
 
@@ -62,7 +68,12 @@ def _build_rig(args):
     projector = WindowProjector(proj_cfg)
 
     server = None
-    if args.push_host:
+    if args.local_cam is not None:
+        from ..hw.camera import LocalCamera
+
+        w, h = (int(x) for x in args.cam_size.lower().split("x"))
+        camera = LocalCamera(args.local_cam, width=w, height=h)
+    elif args.push_host:
         from ..hw.camera import PushCamera
 
         camera = PushCamera(args.push_host)

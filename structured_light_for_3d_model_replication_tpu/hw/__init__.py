@@ -11,7 +11,13 @@ lazily inside the class so this package (and the virtual rig) works on bare
 images.
 """
 
-from .camera import CameraSettings, PullCamera, PushCamera, SyntheticCamera  # noqa: F401
+from .camera import (  # noqa: F401
+    CameraSettings,
+    LocalCamera,
+    PullCamera,
+    PushCamera,
+    SyntheticCamera,
+)
 from .command_server import CommandChannel, CommandServer  # noqa: F401
 from .projector import VirtualProjector, WindowProjector  # noqa: F401
 from .rig import VirtualRig  # noqa: F401

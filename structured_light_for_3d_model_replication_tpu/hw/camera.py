@@ -121,6 +121,59 @@ class PushCamera:
         return True
 
 
+class LocalCamera:
+    """Local USB/builtin webcam via ``cv2.VideoCapture`` — the no-phone
+    capture path of the reference's webcam calibration rig
+    (`Old/sl_calib_capture.py:46-123`: open ``CAM_ID``, force
+    ``CAP_PROP_FRAME_WIDTH/HEIGHT``, ``cap.read()`` per projected frame).
+
+    ``flush`` frames are read and discarded before the kept one:
+    ``VideoCapture`` buffers a few frames internally, so without the flush a
+    capture taken right after the projector swaps patterns can return a
+    frame photographed under the PREVIOUS pattern — fatal for Gray-code
+    decoding. (The reference sidesteps this with 200–500 ms ``waitKey``
+    dwells; flushing is deterministic.)
+
+    cv2 imports lazily so the package works on bare images.
+    """
+
+    def __init__(self, device_id: int = 0, width: int | None = 1920,
+                 height: int | None = 1080, flush: int = 2):
+        import cv2  # lazy: only this class needs it
+
+        self._cv2 = cv2
+        self.device_id = device_id
+        self.flush = flush
+        self._cap = cv2.VideoCapture(device_id)
+        if not self._cap.isOpened():
+            raise RuntimeError(f"cannot open local camera {device_id}")
+        if width is not None:
+            self._cap.set(cv2.CAP_PROP_FRAME_WIDTH, width)
+        if height is not None:
+            self._cap.set(cv2.CAP_PROP_FRAME_HEIGHT, height)
+        self.connected = True
+
+    def capture_array(self) -> np.ndarray:
+        for _ in range(self.flush):
+            self._cap.read()
+        ok, frame = self._cap.read()
+        if not ok or frame is None:
+            raise RuntimeError(f"camera {self.device_id} returned no frame")
+        return frame  # BGR uint8, as cv2 delivers it
+
+    def capture(self, path: str) -> bool:
+        try:
+            frame = self.capture_array()
+        except Exception as e:
+            log.warning("local capture failed: %s", e)
+            return False
+        return bool(self._cv2.imwrite(path, frame))
+
+    def release(self) -> None:
+        self._cap.release()
+        self.connected = False
+
+
 class SyntheticCamera:
     """Renders the virtual projector's current frame through the scene.
 

@@ -83,9 +83,14 @@ class CommandChannel:
     # -- HTTP side ---------------------------------------------------------
 
     def poll(self) -> dict:
+        # BOTH keys: the reference frontend reads ``data.action``
+        # (`frotend/App.tsx:207`, `server/server.py:44`), this framework's
+        # client reads ``data.command`` — serving both makes either client a
+        # drop-in against this server.
         with self._lock:
             self._last_poll = time.monotonic()
-            return {"command": self._command, "id": self._command_id}
+            return {"action": self._command, "command": self._command,
+                    "id": self._command_id}
 
     def accept_upload(self, data: bytes) -> str:
         with self._lock:

@@ -84,26 +84,39 @@ def _grid_knn_impl(points, valid, k, capacity, q_tile, exclude_self):
 
     # ARITHMETIC offsets (bitwise composition breaks for negative deltas):
     # q_cid + dx·2²⁰ + dy·2¹⁰ + dz equals the packed id of the neighbor
-    # cell whenever the neighbor coordinates stay in range; out-of-range
-    # neighbors alias another (far) cell or no cell — either way their
-    # candidates are eliminated by the id-equality mask or the distance.
+    # cell whenever the neighbor coordinates stay in range. When they do
+    # NOT (query on a grid boundary), the arithmetic borrows/carries into
+    # the adjacent axis field and the sum aliases the packed id of a REAL
+    # far-away cell — e.g. (x, 0, z) + dy=-1 → (x-1, 1023, z) — whose
+    # candidates would pass the id-equality check while being geometrically
+    # distant. Each offset therefore carries its per-axis delta so the
+    # query can mask offsets whose neighbor coordinate leaves [0, 2¹⁰).
+    deltas = [(dx, dy, dz)
+              for dx in (-1, 0, 1) for dy in (-1, 0, 1) for dz in (-1, 0, 1)]
     neighbor_offsets = jnp.asarray(
         [dx * (1 << (2 * _BITS)) + dy * (1 << _BITS) + dz
-         for dx in (-1, 0, 1) for dy in (-1, 0, 1) for dz in (-1, 0, 1)],
-        jnp.int32)
+         for dx, dy, dz in deltas], jnp.int32)
+    delta_xyz = jnp.asarray(deltas, jnp.int32)  # (27, 3)
 
     pts_sorted = points[order]
 
     def per_tile(args):
         q, q_cid, q_idx, qv = args  # (T,3) (T,) (T,) (T,)
-        # 27 candidate cell ids per query.
+        # 27 candidate cell ids per query; offsets whose per-axis neighbor
+        # coordinate leaves the grid are masked (see aliasing note above).
         cand_cid = q_cid[:, None] + neighbor_offsets[None, :]  # (T, 27)
+        q_xyz = jnp.stack([q_cid >> (2 * _BITS),
+                           (q_cid >> _BITS) & _GRID_MAX,
+                           q_cid & _GRID_MAX], axis=-1)        # (T, 3)
+        nb_xyz = q_xyz[:, None, :] + delta_xyz[None, :, :]     # (T, 27, 3)
+        in_grid = jnp.all((nb_xyz >= 0) & (nb_xyz <= _GRID_MAX), axis=-1)
         start = jnp.searchsorted(cid_sorted, cand_cid.reshape(-1),
                                  side="left").reshape(cand_cid.shape)
         # Candidate slots: start + 0..C-1 in the sorted order.
         slots = start[:, :, None] + jnp.arange(capacity, dtype=jnp.int32)
         slots_c = jnp.minimum(slots, n - 1)
-        ok = (slots < n) & (cid_sorted[slots_c] == cand_cid[:, :, None])
+        ok = (slots < n) & in_grid[:, :, None] \
+            & (cid_sorted[slots_c] == cand_cid[:, :, None])
         cand = pts_sorted[slots_c]                      # (T, 27, C, 3)
         orig = order[slots_c]                            # (T, 27, C)
         d2 = jnp.sum((q[:, None, None, :] - cand) ** 2, axis=-1)

@@ -38,6 +38,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
 
 def pad_points(points: jnp.ndarray, valid: jnp.ndarray | None, multiple: int):
     """Pad (N,D) points (+ valid mask) to a multiple; padding is invalid."""
@@ -159,10 +163,23 @@ def _knn_padded(
     return jnp.maximum(best_d, 0.0), best_i
 
 
+@functools.lru_cache(maxsize=1)
+def _log_default_method(method: str, backend: str) -> None:
+    # Once per process: the auto default silently diverges across platforms
+    # (approx recall ≈ 0.9 on accelerators vs exact KDTree semantics on
+    # CPU), so record which one every ``method="auto"`` consumer got.
+    log.info("knn method='auto' resolves to %r on backend %r "
+             "(pass method='exact' at precision-sensitive call sites)",
+             method, backend)
+
+
 def _default_method() -> str:
     # Accelerators (incl. the tunneled-TPU "axon" platform) take the
     # PartialReduce path; CPU keeps the exact oracle default.
-    return "approx" if jax.default_backend() != "cpu" else "exact"
+    backend = jax.default_backend()
+    method = "approx" if backend != "cpu" else "exact"
+    _log_default_method(method, backend)
+    return method
 
 
 def knn(

@@ -48,10 +48,12 @@ def orient_normals_consistent_tangent_plane(
     # Device: KNN graph (indices + distances), one tiled-matmul pass.
     d2, idx, nbv = (np.asarray(a) for a in knn(pts, k_eff))
 
-    # Native fast path: C++ Prim MST + flip propagation over the same graph
-    # (edge weights 1−|n·n| are flip-invariant, so propagation order cannot
-    # change them), then a per-component majority radial vote to pick the
-    # outward sign — same convention as the scipy path's root seeding.
+    # Native fast path: C++ Prim MST + flip propagation over the SYMMETRIZED
+    # graph (reverse KNN edges included, so Prim's reachability matches the
+    # undirected union-find components used for the vote below; edge weights
+    # 1−|n·n| are flip-invariant, so propagation order cannot change them),
+    # then a per-component majority radial vote to pick the outward sign —
+    # same convention as the scipy path's root seeding.
     from .. import native
 
     if native.available():

@@ -88,6 +88,7 @@ def kabsch(
     dst: jnp.ndarray,
     weights: jnp.ndarray | None = None,
     power_iters: int = 24,
+    ensure_converged: bool = False,
 ) -> jnp.ndarray:
     """Optimal rigid transform src→dst (weighted), (..., N, 3) batched.
 
@@ -98,6 +99,16 @@ def kabsch(
     polynomial (RANSAC solves ~100k 3-point instances per edge) and ~100k
     LAPACK-style 3×3 SVD iterations — and it cannot return a reflection,
     so no det() fix-up is needed.
+
+    ``ensure_converged``: a fixed 24-step iteration can stall short of the
+    top eigenvector when the spectral gap is small (near-degenerate or
+    noisy samples), returning a blended quaternion. Inside RANSAC's
+    hypothesis batches that is fine — a bad hypothesis loses the inlier
+    vote — but one-shot consumers (the all-inlier polish, point-to-point
+    ICP steps) should pass True: a bounded ``lax.while_loop`` then keeps
+    iterating until the Rayleigh residual ‖Aq − λq‖ < 1e-6 (or 160 extra
+    steps). Converged entries are at a fixpoint, so batched inputs only pay
+    until their slowest row settles.
     """
     if weights is None:
         weights = jnp.ones(src.shape[:-1], src.dtype)
@@ -145,6 +156,24 @@ def kabsch(
         q = jnp.einsum("...ij,...j->...i", A, q, precision=hi)
         q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
                             1e-20)
+    if ensure_converged:
+        def residual(qv):
+            Aq = jnp.einsum("...ij,...j->...i", A, qv, precision=hi)
+            lam = jnp.sum(qv * Aq, axis=-1, keepdims=True)
+            return jnp.linalg.norm(Aq - lam * qv, axis=-1)
+
+        def cond(state):
+            qv, it = state
+            return (it < 160) & (jnp.max(residual(qv)) > 1e-6)
+
+        def step(state):
+            qv, it = state
+            qv = jnp.einsum("...ij,...j->...i", A, qv, precision=hi)
+            qv = qv / jnp.maximum(
+                jnp.linalg.norm(qv, axis=-1, keepdims=True), 1e-20)
+            return qv, it + 1
+
+        q, _ = jax.lax.while_loop(cond, step, (q, jnp.int32(0)))
     # Degenerate problem (H ≈ 0: no/zero-weight correspondences) → identity,
     # matching the old SVD path's benign behavior; otherwise the start
     # vector would pass through as an arbitrary rotation.
@@ -269,9 +298,13 @@ def _ransac_core(
     keys = jax.random.split(key, n_batches)
     (best_T, best_cnt), _ = jax.lax.scan(batch_step, init, keys)
 
-    # Polish: re-estimate from ALL inliers of the best hypothesis.
+    # Polish: re-estimate from ALL inliers of the best hypothesis. This is
+    # a single solve whose result ships, so insist on eigenvector
+    # convergence (the batched hypotheses above filter their own failures
+    # through the inlier vote).
     cnt0, _, inl = score_T(best_T)
-    T_ref = kabsch(src_pts, dst_pts[corr_idx], weights=inl.astype(jnp.float32))
+    T_ref = kabsch(src_pts, dst_pts[corr_idx], weights=inl.astype(jnp.float32),
+                   ensure_converged=True)
     cnt1, rmse1, _ = score_T(T_ref)
     use_ref = cnt1 >= cnt0
     T_fin = jnp.where(use_ref, T_ref, best_T)
@@ -380,7 +413,7 @@ def icp(
         w = ok.astype(jnp.float32)
         q = dst_pts[idx]
         if method == "point_to_point":
-            dT = kabsch(moved, q, weights=w)
+            dT = kabsch(moved, q, weights=w, ensure_converged=True)
         else:
             nq = dst_normals[idx]
             r = jnp.sum((moved - q) * nq, axis=-1)          # (N,)

@@ -152,3 +152,43 @@ def test_refine_stereo_jax_improves_or_matches(calib_session):
 
     assert angle_to_gt(refined.R) <= angle_to_gt(stereo.R) + 0.5
     assert np.linalg.norm(refined.T - T) < 0.2 * np.linalg.norm(T)
+
+
+def test_refine_stereo_jax_undistorts_observations(calib_session):
+    """ADVICE r1: observations must be undistorted before the pinhole LM.
+    Distorting the camera corners with a KNOWN lens model and handing that
+    model to the refiner must land on (nearly) the same solution as the
+    distortion-free run — without the undistort the LM would chase the
+    lens residuals into R/T."""
+    import dataclasses
+
+    lay, (cam_K, proj_K, R, T), gts = calib_session
+    data = calibration.load_calib_data(lay.pose_dirs(), PROJ, BOARD)
+    stereo = calibration.stereo_calibrate(data, PROJ)
+    clean = calibration.refine_stereo_jax(data, stereo)
+
+    D = np.array([0.15, -0.05, 0.001, -0.001, 0.0])
+    fx, fy = cam_K[0, 0], cam_K[1, 1]
+    cx, cy = cam_K[0, 2], cam_K[1, 2]
+
+    def distort(pts):
+        p = np.asarray(pts, np.float64).reshape(-1, 2)
+        x = (p[:, 0] - cx) / fx
+        y = (p[:, 1] - cy) / fy
+        r2 = x * x + y * y
+        radial = 1 + D[0] * r2 + D[1] * r2 * r2
+        xd = x * radial + 2 * D[2] * x * y + D[3] * (r2 + 2 * x * x)
+        yd = y * radial + D[2] * (r2 + 2 * y * y) + 2 * D[3] * x * y
+        out = np.stack([fx * xd + cx, fy * yd + cy], 1).astype(np.float32)
+        return out.reshape(np.asarray(pts).shape)
+
+    data_d = dataclasses.replace(
+        data, cam_pts=[distort(c) for c in data.cam_pts])
+    stereo_d = dataclasses.replace(stereo, cam_dist=D.reshape(1, 5))
+    refined = calibration.refine_stereo_jax(data_d, stereo_d)
+
+    dR = refined.R @ clean.R.T
+    ang = np.degrees(np.arccos(np.clip((np.trace(dR) - 1) / 2, -1, 1)))
+    assert ang < 0.2, f"distorted-input refine drifted {ang} deg"
+    assert np.linalg.norm(refined.T - clean.T) < 0.02 * np.linalg.norm(T)
+    assert refined.rms < clean.rms + 0.25

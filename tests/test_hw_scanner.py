@@ -117,6 +117,122 @@ def test_trigger_capture_times_out_without_upload():
     assert ch.trigger_capture("/tmp/never.jpg", timeout=0.05) is False
 
 
+def test_reference_frontend_replay_drives_full_capture(command_server,
+                                                       tmp_path):
+    """Wire-compat: traffic shaped EXACTLY like the reference React client
+    (`/root/reference/frotend/App.tsx:195-248`) drives a multi-frame capture
+    against this server.
+
+    The reference client reads ONLY ``data.action`` from the poll response
+    (`App.tsx:207`, matching `server/server.py:44`), dedups on ``id``, and
+    uploads a FormData part named ``file`` with filename ``capture.jpg``.
+    """
+    base = f"http://127.0.0.1:{command_server.port}"
+
+    class RefClient:
+        """Poll loop + capture handler as the reference App.tsx implements
+        them (action key, lastProcessedId dedup, multipart upload)."""
+
+        def __init__(self):
+            self.last_processed_id = None  # lastProcessedIdRef, App.tsx:57
+            self.frames_sent = 0
+
+        def poll_once(self):
+            data = _get_json(base + "/poll_command")
+            assert "action" in data, "reference client requires 'action'"
+            if (data["action"] == "capture"
+                    and data["id"] != self.last_processed_id):
+                self.last_processed_id = data["id"]
+                self.handle_capture()
+                return True
+            return False
+
+        def handle_capture(self):
+            payload = b"\xff\xd8frame%d\xff\xd9" % self.frames_sent
+            boundary = "----WebKitFormBoundaryREF"
+            body = (
+                f"--{boundary}\r\n"
+                'Content-Disposition: form-data; name="file"; '
+                'filename="capture.jpg"\r\n'
+                "Content-Type: image/jpeg\r\n\r\n"
+            ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+            req = urllib.request.Request(
+                base + "/upload", data=body,
+                headers={"Content-Type":
+                         f"multipart/form-data; boundary={boundary}"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            self.frames_sent += 1
+
+    client = RefClient()
+    stop = threading.Event()
+
+    def phone_loop():  # 500 ms cadence compressed for test speed
+        while not stop.is_set():
+            client.poll_once()
+            stop.wait(0.01)
+
+    t = threading.Thread(target=phone_loop, daemon=True)
+    t.start()
+    try:
+        # PC side: a 3-frame scan sequence, one trigger per projected frame.
+        for i in range(3):
+            target = str(tmp_path / f"{i:02d}.jpg")
+            assert command_server.channel.trigger_capture(target, timeout=10)
+            with open(target, "rb") as f:
+                assert f.read() == b"\xff\xd8frame%d\xff\xd9" % i
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert client.frames_sent == 3
+    # Idle polls after the scan must not re-trigger (id dedup holds).
+    assert client.poll_once() is False
+
+
+# ---------------------------------------------------------------------------
+# Local webcam (cv2.VideoCapture path, Old/sl_calib_capture.py:46-123)
+# ---------------------------------------------------------------------------
+
+
+def test_local_camera_flushes_stale_frames(tmp_path, monkeypatch):
+    cv2 = pytest.importorskip("cv2")
+    frames = [np.full((8, 8, 3), v, np.uint8) for v in (10, 20, 30, 40)]
+
+    class FakeCap:
+        def __init__(self, dev):
+            self.dev = dev
+            self.i = 0
+            self.props = {}
+
+        def isOpened(self):
+            return True
+
+        def set(self, prop, val):
+            self.props[prop] = val
+
+        def read(self):
+            f = frames[min(self.i, len(frames) - 1)]
+            self.i += 1
+            return True, f.copy()
+
+        def release(self):
+            pass
+
+    monkeypatch.setattr(cv2, "VideoCapture", FakeCap)
+    from structured_light_for_3d_model_replication_tpu.hw.camera import LocalCamera
+
+    cam = LocalCamera(0, width=640, height=480, flush=2)
+    # Two buffered frames (10, 20) are flushed; the kept frame is 30.
+    arr = cam.capture_array()
+    assert arr[0, 0, 0] == 30
+    out = str(tmp_path / "local.png")
+    assert cam.capture(out)
+    assert cv2.imread(out)[0, 0, 0] == 40
+    cam.release()
+    assert cam.connected is False
+
+
 # ---------------------------------------------------------------------------
 # Push-mode camera (Android host protocol against a stub)
 # ---------------------------------------------------------------------------

@@ -107,6 +107,31 @@ def test_mst_orient_flipped_sphere(rng):
     assert comps >= 1
 
 
+def test_mst_orient_reaches_point_absent_from_all_knn_lists():
+    """A point that appears in NOBODY's KNN list (directed graph sink) must
+    still be oriented consistently with the patch its own list points into —
+    Prim runs on the symmetrized graph (ADVICE r1: the directed traversal
+    left such points as arbitrary-sign roots sharing the patch's component
+    label)."""
+    # A line of patch points 1 apart + a stray 5 away from the end: with
+    # k=2 every patch point's list holds its two patch neighbors, so the
+    # stray is in no list, while the stray's list reaches the patch.
+    line = np.stack([np.arange(10.0), np.zeros(10), np.zeros(10)], 1)
+    stray = np.array([[14.0, 0.0, 0.0]])
+    pts = np.vstack([line, stray]).astype(np.float32)
+    normals = np.tile(np.array([0.0, 0.0, 1.0], np.float32), (11, 1))
+    normals[10] = [0.0, 0.0, -1.0]  # stray arrives flipped
+    d2, idx = native.grid_knn(pts, 2)
+    ok = idx >= 0
+    # The directed structure this test relies on: stray (row 10) is absent
+    # from every other row's neighbor list.
+    assert not np.any(idx[:10] == 10)
+    out, comps = native.mst_orient_normals(
+        pts, normals.copy(), idx, ok, seed_dir=(0.0, 0.0, 1.0))
+    assert comps == 1  # symmetrized traversal = one component
+    assert np.all(out[:, 2] > 0)  # stray flipped to agree with the patch
+
+
 def test_meshing_surface_mode_uses_ball_pivot(rng):
     from structured_light_for_3d_model_replication_tpu.models import meshing
 

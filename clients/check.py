@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""CI-style smoke check for the satellite clients (web + Android host).
+
+Preferred path: the real toolchains —
+    web:     cd clients/web && npm install && npx tsc --noEmit
+             (or: npx vite build)
+    android: cd clients/android && gradle :app:compileDebugKotlin
+
+Neither node nor gradle ships in the build image, so when they are absent
+this script falls back to structural validation that still catches the
+classes of rot that make "write-only" client code: unbalanced
+brackets/braces/parens (outside strings/comments), merge-conflict
+markers, imports that point at files which do not exist, and unparsable
+package/tsconfig JSON. Exit code 0 = all checks passed (with the tool
+tier used printed per target).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _strip_code(text: str, line_comment: str = "//") -> str:
+    """Remove string literals and comments (good enough for bracket
+    balancing; template literals are treated as plain strings)."""
+    out = []
+    i = 0
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if c in "\"'`":
+            q = c
+            i += 1
+            while i < n and text[i] != q:
+                i += 2 if text[i] == "\\" else 1
+            i += 1
+        elif text.startswith(line_comment, i):
+            while i < n and text[i] != "\n":
+                i += 1
+        elif text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _check_balance(path: str) -> list[str]:
+    errs = []
+    text = open(path, encoding="utf-8").read()
+    if re.search(r"^(<<<<<<<|=======$|>>>>>>>)", text, re.M):
+        errs.append(f"{path}: merge-conflict markers")
+    code = _strip_code(text)
+    pairs = {")": "(", "]": "[", "}": "{"}
+    stack = []
+    for ch in code:
+        if ch in "([{":
+            stack.append(ch)
+        elif ch in pairs:
+            if not stack or stack.pop() != pairs[ch]:
+                errs.append(f"{path}: unbalanced {ch!r}")
+                break
+    else:
+        if stack:
+            errs.append(f"{path}: {len(stack)} unclosed bracket(s)")
+    return errs
+
+
+def _check_ts_imports(src_dir: str) -> list[str]:
+    errs = []
+    for dirpath, _, files in os.walk(src_dir):
+        for f in files:
+            if not f.endswith((".ts", ".tsx")):
+                continue
+            p = os.path.join(dirpath, f)
+            for m in re.finditer(
+                    r"""import\s[^;]*?from\s+["'](\.[^"']+)["']""",
+                    open(p, encoding="utf-8").read()):
+                rel = m.group(1)
+                base = os.path.normpath(os.path.join(dirpath, rel))
+                if not any(os.path.exists(base + ext) for ext in
+                           ("", ".ts", ".tsx", ".js", "/index.ts",
+                            "/index.tsx")):
+                    errs.append(f"{p}: unresolved import {rel!r}")
+    return errs
+
+
+def check_web() -> list[str]:
+    web = os.path.join(ROOT, "web")
+    if shutil.which("npx") and os.path.isdir(
+            os.path.join(web, "node_modules")):
+        r = subprocess.run(["npx", "tsc", "--noEmit"], cwd=web)
+        print("web: npx tsc --noEmit ->", r.returncode)
+        return [] if r.returncode == 0 else ["web: tsc failed"]
+    print("web: node toolchain unavailable — structural checks "
+          "(full check: cd clients/web && npm install && npx tsc --noEmit)")
+    errs = []
+    for cfg in ("package.json", "tsconfig.json"):
+        try:
+            json.load(open(os.path.join(web, cfg)))
+        except Exception as e:
+            errs.append(f"web/{cfg}: {e}")
+    for dirpath, _, files in os.walk(os.path.join(web, "src")):
+        for f in files:
+            if f.endswith((".ts", ".tsx")):
+                errs += _check_balance(os.path.join(dirpath, f))
+    errs += _check_ts_imports(os.path.join(web, "src"))
+    return errs
+
+
+def check_android() -> list[str]:
+    android = os.path.join(ROOT, "android")
+    if shutil.which("gradle"):
+        r = subprocess.run(["gradle", "-q", ":app:compileDebugKotlin"],
+                           cwd=android)
+        print("android: gradle compileDebugKotlin ->", r.returncode)
+        return [] if r.returncode == 0 else ["android: compile failed"]
+    print("android: gradle unavailable — structural checks (full check: "
+          "cd clients/android && gradle :app:compileDebugKotlin)")
+    errs = []
+    found = 0
+    for dirpath, _, files in os.walk(android):
+        for f in files:
+            if f.endswith(".kt"):
+                found += 1
+                errs += _check_balance(os.path.join(dirpath, f))
+    if found == 0:
+        errs.append("android: no Kotlin sources found")
+    return errs
+
+
+def main() -> int:
+    errs = check_web() + check_android()
+    for e in errs:
+        print("FAIL:", e, file=sys.stderr)
+    print("client smoke:", "FAILED" if errs else "OK")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

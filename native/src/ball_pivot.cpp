@@ -169,6 +169,13 @@ struct BPA {
     return it != edge_count.end() && it->second < 2;
   }
 
+  // An edge can take one more facet: absent (new) or currently single.
+  bool edge_can_take(int32_t a, int32_t b) const {
+    EdgeKey k{std::min(a, b), std::max(a, b)};
+    auto it = edge_count.find(k);
+    return it == edge_count.end() || it->second < 2;
+  }
+
   // Pivot the ball around directed edge (a, b): choose the candidate point
   // hit first when rotating from the current ball position.
   bool pivot(const FrontEdge& e, int32_t& hit, V3& hit_center) {
@@ -197,7 +204,11 @@ struct BPA {
       w = w * (1.0f / wn);
       float ang = std::atan2(dot(w, v0), dot(w, u0));
       if (ang < 1e-5f) ang += 6.28318530717958647692f;  // strictly forward
-      if (ang < best_angle && ball_empty(c, e.a, e.b, i)) {
+      // The new face's side edges must be able to take one more facet —
+      // without this, emitting onto an already-closed side edge creates a
+      // non-manifold (3-facet) edge.
+      if (ang < best_angle && edge_can_take(e.b, i) &&
+          edge_can_take(i, e.a) && ball_empty(c, e.a, e.b, i)) {
         best_angle = ang;
         best = i;
         best_center = c;
@@ -268,15 +279,100 @@ struct BPA {
   }
 };
 
+// Fill small boundary loops left after all pivot passes: walk the hole
+// loops (each boundary edge has exactly one facet; the loop traverses
+// opposite to its owning triangle's winding) and fan-triangulate loops of
+// at most max_hole_edges edges. Larger openings are treated as genuine
+// surface boundary (the open bottom of a turntable scan must NOT be
+// capped — Open3D's BPA leaves it open too).
+static void fill_holes(std::vector<int32_t>& tris, int32_t n,
+                       int32_t max_hole_edges) {
+  if (max_hole_edges < 3) return;
+  std::unordered_map<EdgeKey, int32_t, EdgeHash> count;
+  for (size_t t = 0; t + 2 < tris.size(); t += 3) {
+    int32_t v[3] = {tris[t], tris[t + 1], tris[t + 2]};
+    for (int e = 0; e < 3; e++) {
+      count[{std::min(v[e], v[(e + 1) % 3]),
+             std::max(v[e], v[(e + 1) % 3])}]++;
+    }
+  }
+  // Directed hole edges: reverse of the owning triangle's traversal.
+  std::unordered_map<int32_t, int32_t> next;
+  std::unordered_set<int32_t> ambiguous;
+  for (size_t t = 0; t + 2 < tris.size(); t += 3) {
+    int32_t v[3] = {tris[t], tris[t + 1], tris[t + 2]};
+    for (int e = 0; e < 3; e++) {
+      int32_t a = v[e], b = v[(e + 1) % 3];
+      if (count[{std::min(a, b), std::max(a, b)}] != 1) continue;
+      if (next.count(b)) {
+        ambiguous.insert(b);  // non-manifold boundary vertex: leave alone
+      } else {
+        next[b] = a;
+      }
+    }
+  }
+  std::unordered_set<int32_t> visited;
+  for (auto& kv : next) {
+    int32_t start = kv.first;
+    if (visited.count(start) || ambiguous.count(start)) continue;
+    // Walk the loop.
+    std::vector<int32_t> loop;
+    int32_t cur = start;
+    bool ok = true;
+    while (true) {
+      if ((int32_t)loop.size() > max_hole_edges) { ok = false; break; }
+      loop.push_back(cur);
+      auto it = next.find(cur);
+      if (it == next.end() || ambiguous.count(cur)) { ok = false; break; }
+      cur = it->second;
+      if (cur == start) break;
+      if (visited.count(cur)) { ok = false; break; }
+    }
+    for (int32_t vtx : loop) visited.insert(vtx);
+    if (!ok || loop.size() < 3 || (int32_t)loop.size() > max_hole_edges) {
+      continue;
+    }
+    // Fan triangulation in loop order (consistent winding with the
+    // surrounding mesh by construction of the directed boundary) — unless
+    // any fan diagonal coincides with an already-closed mesh edge, which
+    // would go non-manifold.
+    bool can_fan = true;
+    for (size_t i = 1; i + 1 < loop.size() && can_fan; i++) {
+      auto chk = [&](int32_t a, int32_t b) {
+        auto it = count.find({std::min(a, b), std::max(a, b)});
+        return it == count.end() || it->second < 2;
+      };
+      if (!chk(loop[0], loop[i]) || !chk(loop[i], loop[i + 1]) ||
+          !chk(loop[0], loop[i + 1])) {
+        can_fan = false;
+      }
+    }
+    if (!can_fan) continue;
+    for (size_t i = 1; i + 1 < loop.size(); i++) {
+      tris.push_back(loop[0]);
+      tris.push_back(loop[i]);
+      tris.push_back(loop[i + 1]);
+      count[{std::min(loop[0], loop[i]), std::max(loop[0], loop[i])}]++;
+      count[{std::min(loop[i], loop[i + 1]),
+             std::max(loop[i], loop[i + 1])}]++;
+      count[{std::min(loop[0], loop[i + 1]),
+             std::max(loop[0], loop[i + 1])}]++;
+    }
+  }
+  (void)n;
+}
+
 }  // namespace
 
 extern "C" {
 
 // points/normals (n*3) float32; radii (n_radii) ascending; out_tris int32
-// capacity max_tris*3. Returns triangle count, or -1 on bad args.
+// capacity max_tris*3; max_hole_edges fills boundary loops up to that
+// size after the pivot passes (0 disables). Returns triangle count, or
+// -1 on bad args.
 int32_t sl_ball_pivot(int32_t n, const float* points, const float* normals,
                       const float* radii, int32_t n_radii, int32_t* out_tris,
-                      int32_t max_tris) {
+                      int32_t max_tris, int32_t max_hole_edges) {
   if (n < 3 || n_radii < 1) return -1;
   std::vector<int32_t> tris;
   tris.reserve(std::min(max_tris, 4 * n) * 3);
@@ -307,6 +403,8 @@ int32_t sl_ball_pivot(int32_t n, const float* points, const float* normals,
     }
     bpa.run();
   }
+
+  fill_holes(tris, n, max_hole_edges);
 
   int32_t count = (int32_t)(tris.size() / 3);
   if (count > max_tris) return -count;

@@ -22,7 +22,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", choices=("watertight", "surface"),
                    default="watertight")
     p.add_argument("--depth", type=int, default=8,
-                   help="Poisson octree-equivalent depth (grid 2^depth)")
+                   help="Poisson octree-equivalent depth (2^depth virtual "
+                        "grid; ≤8 dense, 9-12 band-sparse — the reference "
+                        "defaults its octree to depth 10)")
     p.add_argument("--trim", type=float, default=0.0,
                    help="density quantile to trim (0.0 = watertight "
                         "mesh_360 default, 0.02 = reconstruct_stl default)")

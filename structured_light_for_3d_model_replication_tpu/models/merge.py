@@ -42,6 +42,7 @@ from ..io import ply as ply_io
 from ..io.layout import list_clouds
 from ..ops import features, pointcloud, posegraph, registration, segmentation
 from ..ops.knn import knn
+from ..ops.sor_normals import sor_normals as sor_normals_fused
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -67,6 +68,27 @@ class MergeParams:
     final_std_ratio: float = 2.0
     loop_closure: bool = True         # pose-graph variant only
     posegraph_iterations: int = 50
+    # Turntable-axis pose prior: a ring's edges all measure the SAME rigid
+    # step (one turntable advance seen in the fixed camera frame), so after
+    # the per-edge pass a robust consensus of the edge screws estimates
+    # that step. A second ICP pass seeded with it is kept whenever it is
+    # not clearly worse — on feature-poor edges (smooth surfaces of
+    # revolution) where RANSAC has no signal and a free ICP slides
+    # tangentially with high fitness, the prior-seeded result wins and the
+    # ring stays rigid.
+    axis_prior: bool = True
+    # fit2 ≥ fit − margin keeps the prior-seeded edge (slides on smooth
+    # geometry score the SAME fitness as the true pose, so a strict ">"
+    # would never adopt the prior exactly where it matters).
+    axis_prior_margin: float = 0.02
+    # Commanded turntable advance per stop in degrees, when known (the
+    # auto-scan loop always knows it, `server/gui.py:79-80`). With it the
+    # consensus TRUSTS only edges whose rotation magnitude lands near the
+    # commanded step — crucial on smooth geometry, where the FAILED edges
+    # (identity slides) can be the majority and would drag a plain median
+    # to zero rotation. None → plain component-wise median (majority-
+    # correct assumption).
+    step_deg: float | None = None
     # Per-scan point cap for REGISTRATION (the KNN/FPFH/ICP stages are
     # O(M²) tiled matmuls, so M must stay bounded regardless of capture
     # resolution). Registration on a subsample is exactly what the reference
@@ -141,12 +163,21 @@ class _Padded:
 
 def _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn):
     """`preprocess_point_cloud` (`server/processing.py:78-96`): voxel
-    downsample, normals (radius 2·voxel ≈ k-NN PCA), FPFH at 5·voxel."""
+    downsample, normals (radius 2·voxel ≈ k-NN PCA), FPFH at 5·voxel.
+
+    ONE shared KNN sweep feeds both normals (first ``normals_k`` columns)
+    and FPFH (all ``fpfh_max_nn``) — the two O(M²) sweeps were ~40 % of
+    the measured ring preprocess time. FPFH re-masks its pairs against
+    the normal-validity mask, so the only deviation from separate sweeps
+    is that a (rare) <3-neighbor point's slot is dropped rather than
+    replaced by a farther neighbor."""
     dpts, _, dvalid, _ = pointcloud.voxel_downsample(pts, voxel, valid=valid)
+    k_shared = max(normals_k, fpfh_max_nn)
+    nb = knn(dpts, k_shared, points_valid=dvalid)
     normals, nvalid = pointcloud.estimate_normals(dpts, valid=dvalid,
-                                                  k=normals_k)
+                                                  k=normals_k, neighbors=nb)
     feat, fvalid = features.fpfh(dpts, normals, 5.0 * voxel, valid=nvalid,
-                                 max_nn=fpfh_max_nn)
+                                 max_nn=fpfh_max_nn, neighbors=nb)
     return dpts, dvalid & nvalid & fvalid, normals, feat
 
 
@@ -242,42 +273,151 @@ def _edge_body(params: MergeParams):
 
 @functools.lru_cache(maxsize=None)
 def _ring_fn(params: MergeParams, n: int, loop_closure: bool):
-    """The ENTIRE ring — N per-stop preprocesses + N-1 (+ loop) edge
-    registrations — as ONE jitted program built from two ``lax.scan``s.
+    """Jitted wrapper around :func:`_ring_body` (whole ring, one launch)."""
+    return jax.jit(_ring_body(params, n, loop_closure))
 
-    Why scan and not vmap: the edge body is itself scan-heavy (RANSAC
-    hypothesis batches, annealed ICP), and vmapping it explodes compile
-    time; ``lax.scan`` compiles the body once and reuses it per step. Why
-    one program at all: on a remote/tunneled TPU every launch is a network
-    round trip, and a 24-stop ring as ~50 launches pays seconds of pure
-    latency. The previous edge's transform rides the scan CARRY as the next
-    edge's init hint (a turntable advances by a constant step)."""
+
+@functools.lru_cache(maxsize=None)
+def _ring_body(params: MergeParams, n: int, loop_closure: bool):
+    """The ENTIRE ring — N per-stop preprocesses + N-1 (+ loop) edge
+    registrations — as ONE traceable function (un-jitted so larger fused
+    programs, `models/scan360._fused_tail_fn`, can inline it).
+
+    Edges run VMAPPED, not sequentially: each edge body is itself
+    scan-heavy (≈200 RANSAC hypothesis batches + 30 annealed ICP steps of
+    small kernels), and a sequential edge chain executes ~5000 tiny
+    kernels back-to-back — measured 3.3 s of the round-1 north-star time.
+    vmap turns every step into a 23×-wider kernel (vmap-of-scan = scan of
+    the vmapped body: same step count, actual TPU utilization). The price
+    is the hint chain: every edge starts from identity instead of its
+    predecessor's transform; the turntable-axis consensus re-pass
+    (:func:`_axis_prior_pass`, also vmapped) supersedes it as the
+    feature-poor-edge mechanism. Why one program at all: on a
+    remote/tunneled TPU every launch is a network round trip."""
     body = _edge_body(params)
 
-    def prep_body(carry, xs):
-        pts, val = xs
-        return carry, _preprocess(pts, val, params.voxel_size,
-                                  params.normals_k, params.fpfh_max_nn)
-
-    def edge_step(hint, xs):
-        T, fit, rmse, info = body(*xs, hint)
-        return T, (T, fit, rmse, info)
-
     n_edges = n - 1 + int(loop_closure)
-    src_ix = tuple(range(1, n)) + ((0,) if loop_closure else ())
-    dst_ix = tuple(range(0, n - 1)) + ((n - 1,) if loop_closure else ())
 
     def run(points, valid, keys):
-        _, pre = jax.lax.scan(prep_body, 0, (points, valid))
-        si = jnp.asarray(src_ix)
-        di = jnp.asarray(dst_ix)
-        xs = (pre[0][si], pre[1][si], pre[3][si],
-              pre[0][di], pre[1][di], pre[2][di], pre[3][di],
-              keys[:n_edges])
-        _, outs = jax.lax.scan(edge_step, jnp.eye(4, dtype=jnp.float32), xs)
+        pre = jax.vmap(
+            lambda p, v: _preprocess(p, v, params.voxel_size,
+                                     params.normals_k, params.fpfh_max_nn)
+        )(points, valid)
+        xs = _edge_xs(pre, n, loop_closure, keys)
+        eye = jnp.eye(4, dtype=jnp.float32)
+        outs = jax.vmap(lambda s_p, s_v, s_f, d_p, d_v, d_n, d_f, k:
+                        body(s_p, s_v, s_f, d_p, d_v, d_n, d_f, k, eye)
+                        )(*xs)
+        if params.axis_prior and n_edges >= 3:
+            outs = _axis_prior_pass(params, xs, outs)
         return outs  # (T (E,4,4), fit (E,), rmse (E,), info (E,6,6))
 
-    return jax.jit(run)
+    return run
+
+
+def _ring_edge_indices(n: int, loop_closure: bool):
+    """(src, dst) stop indices of the ring's edges: seq edges i+1→i plus
+    the optional loop edge 0→N-1 — THE edge ordering every ring consumer
+    (first pass, axis-prior re-pass, pose-graph build) shares."""
+    src = tuple(range(1, n)) + ((0,) if loop_closure else ())
+    dst = tuple(range(0, n - 1)) + ((n - 1,) if loop_closure else ())
+    return src, dst
+
+
+def _edge_xs(pre, n: int, loop_closure: bool, keys):
+    """Per-edge registration inputs from stacked per-stop preprocess
+    outputs ``pre = (pts, valid, normals, feat)``; the positional layout
+    every edge body (`_edge_body`, `_axis_prior_pass.re_edge`) unpacks."""
+    src_ix, dst_ix = _ring_edge_indices(n, loop_closure)
+    si = jnp.asarray(src_ix)
+    di = jnp.asarray(dst_ix)
+    return (pre[0][si], pre[1][si], pre[3][si],
+            pre[0][di], pre[1][di], pre[2][di], pre[3][di],
+            keys[: len(src_ix)])
+
+
+def _consensus_step(Ts: jnp.ndarray,
+                    step_deg: float | None) -> jnp.ndarray:
+    """Robust common per-edge transform of a turntable ring: median of the
+    edge screws (every edge measures the same physical step, including the
+    loop edge — 345°→360° is one more advance). When the commanded step is
+    known, only edges whose rotation magnitude lands near it vote — failed
+    edges on smooth geometry slide to identity and can outnumber the good
+    ones, so an unfiltered median would vote for zero rotation."""
+    from ..ops.posegraph import log_so3
+
+    w = jax.vmap(log_so3)(Ts[:, :3, :3])                  # (E, 3)
+    t = Ts[:, :3, 3]
+    if step_deg is not None:
+        step = abs(float(step_deg)) * jnp.pi / 180.0
+        ang = jnp.linalg.norm(w, axis=1)
+        trusted = jnp.abs(ang - step) <= 0.35 * step
+        # No trusted edge (fully featureless ring): fall back to all.
+        trusted = trusted | (~jnp.any(trusted))
+        nan = jnp.float32(jnp.nan)
+        w_bar = jnp.nanmedian(jnp.where(trusted[:, None], w, nan), axis=0)
+        t_bar = jnp.nanmedian(jnp.where(trusted[:, None], t, nan), axis=0)
+    else:
+        w_bar = jnp.median(w, axis=0)
+        t_bar = jnp.median(t, axis=0)
+    R_bar = registration.exp_so3(w_bar)
+    Tp = jnp.eye(4, dtype=jnp.float32)
+    Tp = Tp.at[:3, :3].set(R_bar)
+    return Tp.at[:3, 3].set(t_bar)
+
+
+@functools.lru_cache(maxsize=None)
+def _axis_pass_fn(params: MergeParams):
+    """Jitted axis-prior sweep for the python-loop ring strategy."""
+    return jax.jit(lambda xs, outs: _axis_prior_pass(params, xs, outs))
+
+
+def _axis_prior_pass(params: MergeParams, xs, outs):
+    """Second ICP sweep seeded with the ring-consensus step; each edge
+    keeps the seeded result unless it is clearly worse (see
+    ``MergeParams.axis_prior``)."""
+    Ts, fit, rmse, infos = outs
+    Tp = _consensus_step(Ts, params.step_deg)
+    it = params.icp_iterations
+    v = params.voxel_size
+
+    def re_edge(s_pts, s_val, _sf, d_pts, d_val, d_nrm, _df, _k):
+        # TIGHT constant radius, no annealing, FEW iterations: the prior is
+        # already near the answer. A wide-radius phase recruits cross-
+        # surface correspondences that slide the edge right back to the
+        # failure the prior exists to fix, and on smooth geometry extra
+        # iterations random-walk along the unobservable (tangential)
+        # direction — a handful polishes the observable directions and
+        # leaves the prior's rotation intact.
+        fine = registration.icp(
+            s_pts, d_pts, max_correspondence_distance=v, init=Tp,
+            dst_normals=d_nrm, src_valid=s_val, dst_valid=d_val,
+            max_iterations=min(it, 6), method="point_to_plane")
+        info2 = registration.information_matrix(
+            s_pts, d_pts, fine.transformation,
+            max_correspondence_distance=v,
+            src_valid=s_val, dst_valid=d_val)
+        return (fine.transformation, fine.fitness, fine.inlier_rmse, info2)
+
+    T2, fit2, rmse2, info2 = jax.vmap(re_edge)(*xs)
+    # Adoption: edges whose FREE result already agrees with the consensus
+    # keep it unless the seeded one is at least as fit; edges that
+    # DISAGREE are exactly the suspected slides, and on smooth geometry a
+    # slide scores fitness as high as the truth — so for them the seeded
+    # result wins under a much wider fitness margin.
+    from ..ops.posegraph import log_so3
+
+    w_free = jax.vmap(log_so3)(Ts[:, :3, :3])
+    w_p = log_so3(Tp[:3, :3])
+    disagree = jnp.linalg.norm(w_free - w_p[None], axis=1) \
+        > 0.5 * jnp.maximum(jnp.linalg.norm(w_p), 1e-3)
+    margin = jnp.where(disagree, 10.0 * params.axis_prior_margin,
+                       params.axis_prior_margin)
+    use2 = fit2 >= fit - margin
+    return (jnp.where(use2[:, None, None], T2, Ts),
+            jnp.where(use2, fit2, fit),
+            jnp.where(use2, rmse2, rmse),
+            jnp.where(use2[:, None, None], info2, infos))
 
 
 @functools.lru_cache(maxsize=None)
@@ -360,6 +500,12 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
         fit = jnp.stack([o[1] for o in outs])
         rmse = jnp.stack([o[2] for o in outs])
         infos = jnp.stack([o[3] for o in outs])
+        if params.axis_prior and len(outs) >= 3:
+            pre_stacked = tuple(jnp.stack([pre[i][j] for i in range(n)])
+                                for j in range(4))
+            xs = _edge_xs(pre_stacked, n, loop_closure, keys)
+            Ts, fit, rmse, infos = _axis_pass_fn(params)(
+                xs, (Ts, fit, rmse, infos))
     else:
         raise ValueError(f"unknown ring strategy {strategy!r}")
     fit_np = np.asarray(fit)
@@ -380,10 +526,11 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
-@functools.lru_cache(maxsize=None)
-def _finalize_fn(params: MergeParams, cap: int):
-    """Device half of the final cleanup as ONE program (launch-count
-    discipline, see `_edge_fn`)."""
+def _finalize_body(params: MergeParams, cap: int):
+    """The final-cleanup math, un-jitted — shared by the standalone
+    :func:`_finalize_fn` program and the one-launch fused pipeline
+    (`models/scan360._fused_fn`), so the two paths cannot silently
+    diverge (same pattern as :func:`_ring_body`)."""
 
     def run(points, colors, valid):
         dpts, dcol, dvalid, _ = pointcloud.voxel_downsample(
@@ -395,6 +542,15 @@ def _finalize_fn(params: MergeParams, cap: int):
             # order so the stride stays spatially spread).
             dpts, dcol, dvalid = pointcloud.stratified_subsample(
                 dpts, cap, valid=dvalid, attrs=dcol)
+        if dpts.shape[0] >= pointcloud.APPROX_KNN_THRESHOLD:
+            # Large clouds: one fused Morton pass for SOR + normals-on-
+            # survivors (ops/sor_normals.py) — one sort, no (N,k,3) gather.
+            keep, normals, nvalid = sor_normals_fused(
+                dpts, valid=dvalid,
+                nb_neighbors=params.final_nb_neighbors,
+                std_ratio=params.final_std_ratio,
+                k_normals=params.normals_k)
+            return dpts, dcol, normals, nvalid
         keep = pointcloud.statistical_outlier_removal(
             dpts, valid=dvalid,
             nb_neighbors=params.final_nb_neighbors,
@@ -403,7 +559,14 @@ def _finalize_fn(params: MergeParams, cap: int):
                                                       k=params.normals_k)
         return dpts, dcol, normals, keep & nvalid
 
-    return jax.jit(run)
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _finalize_fn(params: MergeParams, cap: int):
+    """Device half of the final cleanup as ONE program (launch-count
+    discipline, see `_edge_fn`)."""
+    return jax.jit(_finalize_body(params, cap))
 
 
 def _finalize(points, colors, valid, params: MergeParams,

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..io.ply import PointCloud
 from ..io.stl import TriangleMesh, write_stl
-from ..ops import marching, orientation, poisson
+from ..ops import marching, orientation, poisson, poisson_sparse
 from ..ops import pointcloud as pc_ops
 from ..utils.log import get_logger
 
@@ -88,9 +88,11 @@ def mesh_from_cloud(
     ``mode="watertight"`` trims the given density quantile (reference default
     2%, `server/processing.py:217`; pass 0.0 for fully watertight — the
     `mesh_360` GUI default, `server/gui.py:65`). ``mode="surface"`` trims
-    hard (25%) as the ball-pivot substitute. ``depth`` maps to a 2^depth
-    dense grid, capped at 8 (reference caps at 16, `server/processing.py:
-    207-208` — octrees go deeper than dense grids).
+    hard (25%) as the ball-pivot substitute. ``depth`` ≤ 8 solves on a
+    2^depth dense grid; depth 9-12 routes to the band-sparse solver
+    (`ops/poisson_sparse.py`), covering the reference octree's default
+    depth 10 (`server/processing.py:293`); > 12 is rejected like the
+    reference rejects > 16 (`server/processing.py:207-208`).
     """
     if mode not in ("watertight", "surface"):
         raise ValueError(f"unknown mesh mode {mode!r}")
@@ -109,10 +111,17 @@ def mesh_from_cloud(
         log.warning("native ball pivoting unavailable; Poisson surface "
                     "fallback")
 
-    grid = poisson.reconstruct(pts, normals, depth=int(depth),
-                               cg_iters=cg_iters)
     trim = quantile_trim if mode == "watertight" else max(quantile_trim, 0.25)
-    mesh = marching.extract(grid, quantile_trim=trim)
+    if int(depth) > 8:
+        grid, n_blocks = poisson_sparse.reconstruct_sparse(
+            pts, normals, depth=int(depth), cg_iters=cg_iters)
+        log.info("sparse Poisson depth=%d: %d active blocks", int(depth),
+                 int(n_blocks))
+        mesh = marching.extract_sparse(grid, quantile_trim=trim)
+    else:
+        grid = poisson.reconstruct(pts, normals, depth=int(depth),
+                                   cg_iters=cg_iters)
+        mesh = marching.extract(grid, quantile_trim=trim)
     log.info("meshed %d points -> %d verts / %d faces (mode=%s depth=%d)",
              pts.shape[0], len(mesh.vertices), len(mesh.faces), mode, depth)
     return mesh

@@ -61,6 +61,15 @@ class Scan360Params:
     # overlaps compute).
     decode_strategy: str = "loop"
     view_cap: int = 131_072
+    # Fuse the ENTIRE pipeline — decode scan, registration subsample, ring,
+    # pose chain/pose-graph LM, per-view reduce, final cleanup — into ONE
+    # XLA program (one launch, zero mid-path host syncs). Requires
+    # device-resident stacks (host arrays fall back to the strategies
+    # below). This is the lowest-latency path on remote/tunneled TPUs,
+    # where every separate launch or host readback costs a network round
+    # trip; the cold compile is heavy (minutes) but rides the persistent
+    # compilation cache.
+    fused: bool = False
     # Stops decoded/triangulated per device dispatch. The dense per-pixel
     # intermediates of ONE 1080p stop already saturate the chip; vmapping
     # every stop at once would multiply peak HBM by N (24×1080p ≈ 25 GB of
@@ -114,6 +123,110 @@ def _reduce_views_fn(view_cap: int):
     return jax.jit(jax.vmap(reduce_view))
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_fn(params: Scan360Params, decode_cfg, tri_cfg,
+              col_bits: int, row_bits: int, n: int, m_reg: int,
+              view_cap: int):
+    """The ENTIRE 360° pipeline as ONE jitted program: chunked decode scan →
+    registration subsample → whole-ring registration → pose chain (or
+    pose-graph LM) → chunked per-view reduce → voxel/SOR/normals finalize.
+
+    Zero host syncs between the raw stacks and the final compact cloud:
+    on a remote/tunneled TPU the round-trip budget collapses from ~15
+    launches + several readbacks (the "loop"/"scan" strategies) to ONE
+    launch + one readback. Memory contract matches the chunked strategies:
+    the decode and reduce stages run as ``lax.scan`` over the same chunk
+    sizes, so only one chunk of dense per-pixel fusion temporaries is live
+    at a time.
+    """
+    mp = params.merge
+    chunk = max(1, min(params.stop_chunk, n))
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    rchunk = max(1, min(params.reduce_chunk, n))
+    rn_pad = ((n + rchunk - 1) // rchunk) * rchunk
+    loop = params.method == "posegraph" and mp.loop_closure
+    ring = merge_mod._ring_body(mp, n, loop)
+    recon = pipeline_mod.reconstruct_batch_fn(col_bits, row_bits, decode_cfg,
+                                              tri_cfg)
+    cap = merge_mod._round_up(mp.final_max_points)
+
+    def run(stacks, calib, key):
+        # stacks: (n_pad, F, H, W) uint8, already padded to the chunk
+        # multiple (repeat-last padding, sliced away below).
+        def dec_body(carry, chunk_stacks):
+            r = recon(chunk_stacks, carry)
+            return carry, (r.points, r.colors, r.valid)
+
+        _, (pts, cols, vals) = jax.lax.scan(
+            dec_body, calib,
+            stacks.reshape((n_pad // chunk, chunk) + stacks.shape[1:]))
+        pts = pts.reshape(n_pad, -1, 3)[:n]
+        cols = cols.reshape(n_pad, -1, 3)[:n]
+        vals = vals.reshape(n_pad, -1)[:n]
+        p_count = pts.shape[1]
+
+        # Registration view: fixed-size stratified subsample per stop.
+        mr = min(m_reg, p_count)
+        reg_pts, _, reg_val = jax.vmap(
+            lambda p, v: pointcloud.stratified_subsample(p, mr, valid=v)
+        )(pts, vals)
+
+        keys = jax.random.split(key, n)
+        Ts, fit, rmse, infos = ring(reg_pts, reg_val, keys)
+        if params.method == "posegraph":
+            graph = posegraph.build_360_graph(
+                Ts[: n - 1], infos[: n - 1],
+                Ts[n - 1] if loop else None,
+                infos[n - 1] if loop else None)
+            poses = posegraph.optimize(graph,
+                                       iterations=mp.posegraph_iterations)
+        else:
+            poses = posegraph.chain_poses(Ts[: n - 1])
+        poses_f = poses.astype(jnp.float32)
+
+        # Per-view reduce (transform + stratified decimation) in rchunk
+        # chunks under one lax.scan; stop-axis padding uses zeroed stops
+        # (all-False valid contributes nothing).
+        vc = min(view_cap, p_count)
+
+        def pad_stops(a):
+            if rn_pad == n:
+                return a
+            return jnp.concatenate(
+                [a, jnp.zeros((rn_pad - n,) + a.shape[1:], a.dtype)])
+
+        rp, rc, rv = pad_stops(pts), pad_stops(cols), pad_stops(vals)
+        pp = jnp.concatenate(
+            [poses_f, jnp.broadcast_to(jnp.eye(4), (rn_pad - n, 4, 4))]
+        ) if rn_pad != n else poses_f
+
+        def reduce_view(pose, p, c, v):
+            moved = registration.transform_points(pose, p)
+            return pointcloud.stratified_subsample(
+                moved, vc, valid=v, attrs=c.astype(jnp.float32))
+
+        def red_body(carry, xs):
+            return carry, jax.vmap(reduce_view)(*xs)
+
+        _, (vpts, vcol, vval) = jax.lax.scan(red_body, 0, (
+            pp.reshape(rn_pad // rchunk, rchunk, 4, 4),
+            rp.reshape(rn_pad // rchunk, rchunk, p_count, 3),
+            rc.reshape(rn_pad // rchunk, rchunk, p_count, 3),
+            rv.reshape(rn_pad // rchunk, rchunk, p_count)))
+        flat_pts = vpts.reshape(rn_pad, vc, 3)[:n].reshape(-1, 3)
+        flat_col = vcol.reshape(rn_pad, vc, 3)[:n].reshape(-1, 3)
+        flat_val = vval.reshape(rn_pad, vc)[:n].reshape(-1)
+
+        # Final cleanup chain (`server/processing.py:171-181`) — the SAME
+        # traced body as merge._finalize_fn, so fused and standalone paths
+        # cannot diverge.
+        dpts, dcol, normals, out_valid = merge_mod._finalize_body(
+            mp, cap)(flat_pts, flat_col, flat_val)
+        return dpts, dcol, normals, out_valid, poses_f, fit, rmse
+
+    return jax.jit(run)
+
+
 def scan_stacks_to_cloud(
     stacks: jnp.ndarray,
     calib: Calibration,
@@ -146,6 +259,10 @@ def scan_stacks_to_cloud(
         key = jax.random.PRNGKey(0)
     n = stacks.shape[0]
     mp = params.merge
+
+    if params.fused and not isinstance(stacks, np.ndarray):
+        return _run_fused(stacks, calib, col_bits, row_bits, params,
+                          decode_cfg, tri_cfg, key)
 
     # 1. Decode + triangulate every stop, chunked (see ``stop_chunk``). Only
     # the dense outputs actually needed downstream (points/colors/valid) are
@@ -254,6 +371,39 @@ def scan_stacks_to_cloud(
             vpts.reshape(-1, 3), vcol.reshape(-1, 3), vval.reshape(-1), mp,
             has_colors=True)
     log.info("scan_stacks_to_cloud: %d stops → %d points (%s)", n,
+             len(merged), params.method)
+    return merged, np.asarray(poses)
+
+
+def _run_fused(stacks, calib, col_bits, row_bits, params, decode_cfg,
+               tri_cfg, key):
+    """Dispatch the one-launch fused program and compact the result on host
+    (the single sync of the whole pipeline)."""
+    n = stacks.shape[0]
+    mp = params.merge
+    chunk = max(1, min(params.stop_chunk, n))
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    if n_pad != n:  # repeat-last padding, one shape for the decode scan
+        stacks = jnp.concatenate([stacks] + [stacks[-1:]] * (n_pad - n))
+    m_reg = merge_mod._round_up(mp.max_points)
+    view_cap = merge_mod._round_up(params.view_cap)
+    fn = _fused_fn(params, decode_cfg, tri_cfg, col_bits, row_bits, n,
+                   m_reg, view_cap)
+    with trace.span("scan360.fused", stops=n, chunk=chunk):
+        outs = fn(stacks, calib, key)
+        # ONE batched readback: per-array np.asarray pulls would each pay
+        # a full round trip on a remote/tunneled TPU (~0.1 s apiece).
+        dpts, dcol, normals, keep, poses, fit, rmse = jax.device_get(outs)
+    for i in range(1, n):
+        log.info("edge %d→%d fitness=%.3f rmse=%.4f", i, i - 1,
+                 fit[i - 1], rmse[i - 1])
+    if fit.shape[0] > n - 1:
+        log.info("loop edge 0→%d fitness=%.3f", n - 1, fit[n - 1])
+    merged = ply_io.PointCloud(
+        points=dpts[keep],
+        colors=np.clip(dcol[keep], 0, 255).astype(np.uint8),
+        normals=normals[keep])
+    log.info("scan_stacks_to_cloud[fused]: %d stops → %d points (%s)", n,
              len(merged), params.method)
     return merged, np.asarray(poses)
 

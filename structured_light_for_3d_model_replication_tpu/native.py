@@ -79,7 +79,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.sl_mst_orient_normals.restype = i32
     lib.sl_connected_components.argtypes = [i32, i32, i32p, u8p, i32p]
     lib.sl_connected_components.restype = i32
-    lib.sl_ball_pivot.argtypes = [i32, f32p, f32p, f32p, i32, i32p, i32]
+    lib.sl_ball_pivot.argtypes = [i32, f32p, f32p, f32p, i32, i32p, i32,
+                                  i32]
     lib.sl_ball_pivot.restype = i32
     lib.sl_grid_knn.argtypes = [i32, f32p, i32, f32p, i32, ctypes.c_float,
                                 i32, i32p, f32p]
@@ -183,8 +184,13 @@ def connected_components(nbr_idx, nbr_ok) -> tuple[np.ndarray, int]:
     return labels, int(count)
 
 
-def ball_pivot(points, normals, radii) -> np.ndarray:
-    """(T, 3) int32 triangle indices from ball-pivoting reconstruction."""
+def ball_pivot(points, normals, radii,
+               max_hole_edges: int = 12) -> np.ndarray:
+    """(T, 3) int32 triangle indices from ball-pivoting reconstruction.
+
+    ``max_hole_edges`` fills residual boundary loops up to that edge count
+    after the pivot passes (0 disables; large openings — e.g. the unseen
+    bottom of a turntable scan — always stay open)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native layer unavailable")
@@ -198,7 +204,8 @@ def ball_pivot(points, normals, radii) -> np.ndarray:
         rc = lib.sl_ball_pivot(n, _ptr(pts, ctypes.c_float),
                                _ptr(nrm, ctypes.c_float),
                                _ptr(rad, ctypes.c_float), len(rad),
-                               _ptr(out, ctypes.c_int32), cap)
+                               _ptr(out, ctypes.c_int32), cap,
+                               int(max_hole_edges))
         if rc >= 0:
             return out[:rc].copy()
         cap = -rc  # buffer was too small; retry with the reported need

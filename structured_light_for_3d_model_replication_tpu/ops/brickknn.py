@@ -1,0 +1,218 @@
+"""Brick-grid KNN — high-recall large-N engine (dispatch ``"rescue"``).
+
+The Morton-blocked engine (ops/mortonknn.py) misses ~7 % of true
+neighbors at k=20 (window-limited: a neighbor more than one block away
+along the curve is invisible), and the 27-cell grid engine
+(ops/gridknn.py) fixes that but pays a per-query RANDOM gather of
+27×capacity candidate rows — measured 14× slower than Morton at 1M on
+TPU. This module keeps the grid's 27-cell exactness and the Morton
+engine's dense memory behavior:
+
+1. estimate a cell size from a sampled k-th-NN distance (the grid
+   engine's estimator, scaled up so a query's true neighbor ball fits its
+   3³ cell neighborhood);
+2. sort by packed cell id ONCE; **brick** every occupied cell into S
+   static slots — a (M, S) dense layout built with one scatter (cells
+   with more than S points drop the overflow: bounded, documented
+   approximation, sized so p99 occupancy fits);
+3. each cell's candidates are its 27 neighbor BRICKS — a gather of whole
+   (S, 3) bricks (contiguous rows), not of scattered points;
+4. distances are one (S × 27S) matmul expansion per cell (chunked
+   ``lax.map``), reduced with ``approx_min_k`` + a tiny exact sort.
+
+Exact whenever the k-th neighbor lies within one cell radius and no
+involved cell overflows S — by construction of the cell-size estimate
+that holds for the overwhelming majority of queries: measured recall
+≥ 0.99 at 1M/k=20 (tests/test_spatial_knn.py) vs 0.93 for the Morton
+engine, at comparable wall clock (no random gathers anywhere).
+
+Same (sq_dists, indices, neighbor_valid) contract as :func:`..ops.knn.knn`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .gridknn import _estimate_cell_size
+
+_BITS = 10
+_GRID_MAX = (1 << _BITS) - 1
+# Plain Python int, NOT jnp.int32: a module-level jax value would
+# initialize the XLA backend at import time, which breaks
+# jax.distributed.initialize for multi-host users importing the package.
+_BIG = 1 << 30
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+def _brick_knn_impl(points, valid, k, slots, chunk_cells, exclude_self,
+                    cell_scale_x100, max_cells):
+    n = points.shape[0]
+    S = slots
+    # Static cell budget: cells are sized to hold ~O(k) points (the cell
+    # edge tracks the k-th-NN distance), so the occupied count is far
+    # below N; cells ranked past the budget are dropped (their points
+    # report no neighbors — degenerate inputs only).
+    m_cells = max_cells
+
+    # Cell size: the sampled k-th-NN estimate, scaled so ball(q, r_k) fits
+    # the 3³ neighborhood of q's cell for the typical query.
+    h = _estimate_cell_size(points, valid, k) * (cell_scale_x100 / 100.0)
+    mins = jnp.min(jnp.where(valid[:, None], points, jnp.inf), axis=0)
+    maxs = jnp.max(jnp.where(valid[:, None], points, -jnp.inf), axis=0)
+    extent = jnp.max(maxs - mins)
+    h = jnp.maximum(h, extent / (_GRID_MAX - 2) + 1e-12)
+    cell = jnp.clip(((points - mins) / h).astype(jnp.int32), 0, _GRID_MAX)
+    cid = (cell[:, 0] << (2 * _BITS)) | (cell[:, 1] << _BITS) | cell[:, 2]
+    cid = jnp.where(valid, cid, _BIG)
+
+    order = jnp.argsort(cid)
+    cid_s = cid[order]
+    pts_s = points[order]
+    val_s = valid[order] & (cid_s < _BIG)
+    orig_s = order.astype(jnp.int32)
+
+    # Segment structure of the sorted cells.
+    first = jnp.concatenate([jnp.ones(1, bool), cid_s[1:] != cid_s[:-1]])
+    first = first & val_s
+    cell_rank = jnp.cumsum(first.astype(jnp.int32)) - 1       # (N,)
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, jnp.arange(n, dtype=jnp.int32), 0))
+    within = jnp.arange(n, dtype=jnp.int32) - seg_start
+
+    # Brick scatter: (M*S) slots; overflow (within ≥ S or cell budget
+    # exceeded) → dump row.
+    ok = val_s & (within < S) & (cell_rank < m_cells)
+    dest = jnp.where(ok, cell_rank * S + within, m_cells * S)
+    bp = jnp.zeros((m_cells * S + 1, 3), jnp.float32).at[dest].set(pts_s)
+    bo = jnp.full((m_cells * S + 1,), -1, jnp.int32).at[dest].set(orig_s)
+    bv = jnp.zeros((m_cells * S + 1,), bool).at[dest].set(ok)
+    bp = bp[:-1].reshape(m_cells, S, 3)
+    bo = bo[:-1].reshape(m_cells, S)
+    bv = bv[:-1].reshape(m_cells, S)
+
+    # Sorted unique cell ids (ascending) for neighbor lookup.
+    ucid = jnp.full((m_cells + 1,), _BIG, jnp.int32).at[
+        jnp.where(first & (cell_rank < m_cells), cell_rank, m_cells)].set(
+        jnp.where(first, cid_s, _BIG))[:-1]
+
+    # 27 neighbor cell ranks per cell (boundary-masked per axis — packed-id
+    # arithmetic aliases across axis borrows otherwise, see ops/gridknn.py).
+    ux = ucid >> (2 * _BITS)
+    uy = (ucid >> _BITS) & _GRID_MAX
+    uz = ucid & _GRID_MAX
+    deltas = jnp.asarray([(dx, dy, dz) for dx in (-1, 0, 1)
+                          for dy in (-1, 0, 1) for dz in (-1, 0, 1)],
+                         jnp.int32)
+    nxyz = jnp.stack([ux, uy, uz], -1)[:, None, :] + deltas[None]
+    in_grid = jnp.all((nxyz >= 0) & (nxyz <= _GRID_MAX), axis=-1) \
+        & (ucid < _BIG)[:, None]
+    ncid = (nxyz[..., 0] << (2 * _BITS)) | (nxyz[..., 1] << _BITS) \
+        | nxyz[..., 2]
+    pos = jnp.searchsorted(ucid, jnp.where(in_grid, ncid, _BIG)
+                           ).astype(jnp.int32)
+    pos_c = jnp.minimum(pos, m_cells - 1)
+    nbr = jnp.where(in_grid & (ucid[pos_c] == ncid), pos_c, m_cells)
+    # (M, 27); m_cells = "absent" sentinel
+
+    bppad = jnp.concatenate([bp, jnp.zeros((1, S, 3), jnp.float32)])
+    bopad = jnp.concatenate([bo, jnp.full((1, S), -1, jnp.int32)])
+    bvpad = jnp.concatenate([bv, jnp.zeros((1, S), bool)])
+
+    hi = jax.lax.Precision.HIGHEST
+
+    def per_chunk(args):
+        q, qv, qo, nb = args              # (C,S,3) (C,S) (C,S) (C,27)
+        c = q.shape[0]
+        kp = bppad[nb].reshape(c, 27 * S, 3)
+        kv = bvpad[nb].reshape(c, 27 * S)
+        ko = bopad[nb].reshape(c, 27 * S)
+        q2 = jnp.sum(q * q, axis=-1)                      # (C, S)
+        p2 = jnp.sum(kp * kp, axis=-1)                    # (C, 27S)
+        cross = jnp.einsum("csd,cnd->csn", q, kp, precision=hi)
+        d2 = q2[..., :, None] + p2[..., None, :] - 2.0 * cross
+        bad = ~kv[..., None, :]
+        if exclude_self:
+            bad = bad | (qo[..., :, None] == ko[..., None, :])
+        d2 = jnp.where(bad, jnp.inf, d2)
+        flat = d2.reshape(-1, 27 * S)
+        cd, carg = jax.lax.approx_min_k(flat, k, recall_target=0.99)
+        # Row r of `flat` is query slot r%S of cell r//S → its candidate
+        # index row is that cell's ko row.
+        ci = jnp.take_along_axis(
+            jnp.repeat(ko, S, axis=0).reshape(flat.shape[0], -1),
+            carg, axis=1)
+        neg, arg = jax.lax.top_k(-cd, k)
+        idx = jnp.take_along_axis(ci, arg, axis=1)
+        dd = jnp.maximum(-neg, 0.0)
+        nb_ok = jnp.isfinite(dd) & qv.reshape(-1)[:, None]
+        return jnp.where(jnp.isfinite(dd), dd, 0.0), idx, nb_ok
+
+    cb = chunk_cells
+    pad_c = (-m_cells) % cb
+    if pad_c:
+        def padc(x, fill):
+            return jnp.concatenate(
+                [x, jnp.full((pad_c,) + x.shape[1:], fill, x.dtype)])
+        bpq = padc(bp, 0)
+        bvq = padc(bv, False)
+        boq = padc(bo, -1)
+        nbq = padc(nbr, m_cells)
+    else:
+        bpq, bvq, boq, nbq = bp, bv, bo, nbr
+    groups = bpq.shape[0] // cb
+
+    def g(x):
+        return x.reshape((groups, cb) + x.shape[1:])
+
+    d, i, v = jax.lax.map(per_chunk, (g(bpq), g(bvq), g(boq), g(nbq)))
+    d = d.reshape(-1, k)[: m_cells * S]
+    i = i.reshape(-1, k)[: m_cells * S]
+    v = v.reshape(-1, k)[: m_cells * S]
+
+    # Scatter back to original rows (dropped-overflow points keep no
+    # neighbors — they scatter from nowhere; fill via dump defaults).
+    qo_flat = bo.reshape(-1)
+    rows = jnp.where(qo_flat >= 0, qo_flat, n)
+    out_d = jnp.zeros((n + 1, k), jnp.float32).at[rows].set(d)[:n]
+    out_i = jnp.zeros((n + 1, k), jnp.int32).at[rows].set(i)[:n]
+    out_v = jnp.zeros((n + 1, k), bool).at[rows].set(v)[:n]
+    return out_d, out_i, out_v
+
+
+def brick_knn(
+    points: jnp.ndarray,
+    k: int,
+    points_valid: jnp.ndarray | None = None,
+    exclude_self: bool = False,
+    slots: int = 32,
+    chunk_cells: int = 2048,
+    cell_scale: float = 1.4,
+    max_cells: int | None = None,
+):
+    """High-recall brick-grid self-query KNN (module docstring).
+
+    Same contract as ``knn(points, k, exclude_self=...)``. ``slots`` is
+    the static per-cell capacity (overflow points lose their neighbor
+    rows — sized for p99 occupancy at the estimated cell size);
+    ``cell_scale`` widens cells beyond the sampled k-th-NN distance so
+    the 3³ neighborhood covers the true neighbor ball. ``max_cells``
+    bounds the static occupied-cell budget (default n/8 + 1024 — cells
+    hold ~O(k) points by construction, so real clouds occupy far fewer).
+    """
+    points = jnp.asarray(points, jnp.float32)
+    n = points.shape[0]
+    if points_valid is None:
+        points_valid = jnp.ones(n, dtype=bool)
+    if 27 * slots < k + (1 if exclude_self else 0):
+        raise ValueError(f"slots {slots} too small for k={k}")
+    if max_cells is None:
+        max_cells = n // 8 + 1024
+    cc = min(chunk_cells, max(256, max_cells))
+    if max_cells % cc:  # static chunking needs a divisor-friendly budget
+        max_cells = ((max_cells + cc - 1) // cc) * cc
+    return _brick_knn_impl(points, points_valid, k, slots, cc,
+                           exclude_self, int(round(cell_scale * 100)),
+                           max_cells)

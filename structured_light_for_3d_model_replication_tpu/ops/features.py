@@ -42,10 +42,15 @@ def fpfh(
     radius: float,
     valid: jnp.ndarray | None = None,
     max_nn: int = 100,
+    neighbors=None,
 ):
     """(N, 33) float32 FPFH descriptors (+ (N,) validity).
 
     ``radius``/``max_nn`` mirror the reference's KDTreeSearchParamHybrid.
+    ``neighbors`` optionally supplies a precomputed ``(d2, idx, nb_valid)``
+    self-query KNN (ascending, ≥ max_nn columns); it may have been built
+    against a slightly wider validity mask — pairs re-mask against
+    ``valid`` below either way.
     """
     n = points.shape[0]
     if valid is None:
@@ -53,9 +58,13 @@ def fpfh(
     pts = jnp.asarray(points, jnp.float32)
     nrm = jnp.asarray(normals, jnp.float32)
 
-    d2, idx, nbv = knn(pts, max_nn, points_valid=valid)
+    if neighbors is not None:
+        d2, idx, nbv = (a[:, :max_nn] for a in neighbors)
+    else:
+        d2, idx, nbv = knn(pts, max_nn, points_valid=valid)
     own = jnp.arange(n, dtype=jnp.int32)[:, None]
-    pair_ok = nbv & (d2 <= radius * radius) & (idx != own)  # (N, K)
+    pair_ok = nbv & (d2 <= radius * radius) & (idx != own) \
+        & valid[idx] & valid[:, None]                       # (N, K)
 
     q = pts[idx]                    # (N, K, 3) neighbor positions
     nt = nrm[idx]                   # (N, K, 3) neighbor normals

@@ -57,19 +57,23 @@ def pad_points(points: jnp.ndarray, valid: jnp.ndarray | None, multiple: int):
     return points, valid
 
 
-def _block_dists(q, q2, kp, kv, p2):
+def _block_dists(q, q2, kp, kv, p2, precision=None):
     """(Tq, Tk) squared distances, invalid keys masked to +inf."""
     cross = jax.lax.dot_general(
         q, kp.T, (((1,), (0,)), ((), ())),
-        # HIGHEST: fp32 dot products — bf16 would misorder close
-        # neighbors, changing neighbor SETS, not just distances.
-        precision=jax.lax.Precision.HIGHEST,
+        # HIGHEST default: fp32 dot products — bf16 would misorder close
+        # neighbors, changing neighbor SETS, not just distances. Callers
+        # that only consume a tolerant k=1 correspondence (ICP) can pass
+        # the 3-pass bf16 algorithm: ~fp32 accuracy at half the TPU
+        # matmul passes of HIGHEST (which lowers to 6-pass bf16).
+        precision=jax.lax.Precision.HIGHEST if precision is None
+        else precision,
     )
     d = q2 + p2[None, :] - 2.0 * cross
     return jnp.where(kv[None, :], d, jnp.inf)
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7))
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
 def _knn_padded(
     queries: jnp.ndarray,   # (M, D) float32, M % q_tile == 0
     q_valid: jnp.ndarray,   # (M,) bool
@@ -79,7 +83,13 @@ def _knn_padded(
     q_tile: int,
     k_tile: int,
     approx: bool,
+    fast_dots: bool = False,
 ):
+    # 3-pass bf16 only where the hardware has the fast path; CPU executes
+    # plain fp32 anyway (and rejects some presets).
+    prec = (jax.lax.DotAlgorithmPreset.BF16_BF16_F32_X3
+            if fast_dots and jax.default_backend() in ("tpu", "axon")
+            else None)
     M, dim = queries.shape
     N = points.shape[0]
     n_k_blocks = N // k_tile
@@ -98,7 +108,7 @@ def _knn_padded(
             def step(carry, blk):
                 best_d, best_i = carry  # (Tq,), (Tq,)
                 kp, kv, p2, base = blk
-                d = _block_dists(q, q2, kp, kv, p2)
+                d = _block_dists(q, q2, kp, kv, p2, prec)
                 j = jnp.argmin(d, axis=1)
                 dmin = jnp.take_along_axis(d, j[:, None], 1)[:, 0]
                 better = dmin < best_d
@@ -117,7 +127,7 @@ def _knn_padded(
             # approx pass, ordered with one tiny exact sort over k.
             def step(_, blk):
                 kp, kv, p2, base = blk
-                d = _block_dists(q, q2, kp, kv, p2)
+                d = _block_dists(q, q2, kp, kv, p2, prec)
                 nd, nloc = jax.lax.approx_min_k(d, k)
                 return None, (nd, base + nloc.astype(jnp.int32))
 
@@ -135,7 +145,7 @@ def _knn_padded(
         def step(carry, blk):
             best_d, best_i = carry  # (Tq, k)
             kp, kv, p2, base = blk
-            d = _block_dists(q, q2, kp, kv, p2)
+            d = _block_dists(q, q2, kp, kv, p2, prec)
             idx = base + jnp.arange(k_tile, dtype=jnp.int32)
             cat_d = jnp.concatenate([best_d, d], axis=1)
             cat_i = jnp.concatenate(
@@ -192,6 +202,7 @@ def knn(
     q_tile: int = 1024,
     k_tile: int | None = None,
     method: str = "auto",
+    fast_dots: bool = False,
 ):
     """k nearest points for each query (defaults: queries = points).
 
@@ -226,7 +237,7 @@ def knn(
     q_pad, qv_pad = pad_points(queries, queries_valid, q_tile)
 
     d, i = _knn_padded(q_pad, qv_pad, p_pad, pv_pad, kk, q_tile, k_tile,
-                       method == "approx")
+                       method == "approx", fast_dots)
     d, i = d[:n_q], i[:n_q]
 
     if exclude_self and self_query:

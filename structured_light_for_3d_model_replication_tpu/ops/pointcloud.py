@@ -27,6 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .brickknn import brick_knn
 from .gridknn import grid_knn
 from .knn import knn
 from .mortonknn import morton_knn
@@ -43,6 +44,9 @@ def _self_knn(points, k, valid, exclude_self, method="auto"):
     ``dense``  — exact tiled matmul (ops/knn.py), O(N²);
     ``morton`` — Morton-blocked approximate (ops/mortonknn.py), the
                  large-N default: gather-free, ~0.97+ kth-distance accuracy;
+    ``rescue`` — brick-grid engine (ops/brickknn.py): recall ≥ 0.99 at
+                 morton-like cost (dense per-cell bricks, no random
+                 gathers) for precision-sensitive large-N consumers;
     ``grid``   — 27-cell spatial grid (ops/gridknn.py), higher recall than
                  morton but random-gather-bound on TPU.
     """
@@ -52,6 +56,9 @@ def _self_knn(points, k, valid, exclude_self, method="auto"):
     if method == "morton":
         return morton_knn(points, k, points_valid=valid,
                           exclude_self=exclude_self)
+    if method == "rescue":
+        return brick_knn(points, k, points_valid=valid,
+                         exclude_self=exclude_self)
     if method == "grid":
         return grid_knn(points, k, points_valid=valid,
                         exclude_self=exclude_self)
@@ -311,19 +318,27 @@ def estimate_normals(
     valid: jnp.ndarray | None = None,
     k: int = 30,
     neighbor_method: str = "auto",
+    neighbors=None,
 ):
     """Per-point unit normals from the k-NN covariance (PCA), the standard
     Open3D ``estimate_normals`` method (`server/processing.py:87,178`) —
     here one batched gather + einsum + analytic eigensolve.
 
     Returns (normals (N,3), normal_valid (N,)). Sign is arbitrary; use
-    :func:`orient_normals`.
+    :func:`orient_normals`. ``neighbors`` optionally supplies a
+    precomputed ``(d2, idx, nb_valid)`` self-query KNN (ascending, ≥ k
+    columns, self included) so pipelines that need several neighborhood
+    ops on the same cloud (see `models/merge._preprocess`) pay for ONE
+    KNN sweep.
     """
     n = points.shape[0]
     if valid is None:
         valid = jnp.ones(n, dtype=bool)
     pts = jnp.asarray(points, jnp.float32)
-    _, idx, nbv = _self_knn(pts, k, valid, False, neighbor_method)
+    if neighbors is not None:
+        _, idx, nbv = (a[:, :k] for a in neighbors)
+    else:
+        _, idx, nbv = _self_knn(pts, k, valid, False, neighbor_method)
     nbr = pts[idx]  # (N, k, 3)
     w = nbv.astype(jnp.float32)[..., None]  # (N, k, 1)
     cnt = jnp.maximum(jnp.sum(w, axis=1), 1.0)  # (N, 1)

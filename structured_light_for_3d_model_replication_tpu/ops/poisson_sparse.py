@@ -1,0 +1,335 @@
+"""Block-sparse screened-Poisson solve — depth 9-12 within HBM.
+
+The dense solver (:mod:`.poisson`) is the right shape for TPU up to 256³
+(depth 8), but the reference's octree path runs at depth 10 by default and
+accepts up to 16 (`server/processing.py:207-208,293`); a dense 1024³ grid
+is 4 GB per field and CG needs ~7 fields. This module recovers the
+octree's adaptivity with a TPU-idiomatic structure: a **two-level scheme**
+over a dense coarse grid plus a **block-sparse fine band**.
+
+1. **Coarse solve**: the existing dense screened-Poisson at
+   ``min(depth, coarse_depth)`` — gives the global interior/exterior
+   field far from the surface (exactly the role of an octree's shallow
+   nodes).
+2. **Active band**: the set of 8³ voxel blocks within one block of any
+   sample, found with one sort-unique over 27-dilated block keys — static
+   capacity ``max_blocks``, padded, shape-stable.
+3. **Fine solve**: splat, divergence and screened-Laplacian CG run ONLY
+   on the band, stored as ``(M, 8, 8, 8)`` brick tensors. Cross-block
+   stencil halos come from a precomputed (M, 6) neighbor table; at the
+   band boundary the halo is a **Dirichlet condition prolonged from the
+   coarse solution** (folded into the RHS once, so the CG operator is
+   halo-free). The coarse solution also seeds ``x0``, so the fine CG only
+   refines the band.
+4. Iso level and density trimming gather from the sparse bricks; marching
+   extraction (:func:`.marching.extract_sparse`) walks only active
+   blocks.
+
+Memory at depth 10 on a 1M-point surface scan: ~10⁵ active blocks →
+~50M voxels → ~200 MB per field, an order of magnitude under the dense
+grid, with identical numerics inside the band.
+
+Everything is jit-compiled with static ``(resolution, max_blocks,
+cg_iters)``; block discovery, splat and halo exchange are sorts, segment
+ops and gathers — no pointer chasing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import poisson as dense_poisson
+
+BS = 8                       # voxels per block edge
+_KEY_BITS = 10               # per-axis block-coordinate bits (≤ depth 13)
+_KEY_MAX = (1 << _KEY_BITS) - 1
+# Plain Python int (a module-level jnp value would initialize the XLA
+# backend at import, breaking jax.distributed for multi-host users).
+_BIG = 1 << 30               # sentinel key: sorts after every real block
+
+
+class SparsePoissonGrid(NamedTuple):
+    """Band-sparse solve result; extraction input for ``extract_sparse``."""
+
+    chi: jnp.ndarray           # (M, BS, BS, BS) float32
+    density: jnp.ndarray       # (M, BS, BS, BS) float32 splat density
+    block_coords: jnp.ndarray  # (M, 3) int32 block coords (padded rows big)
+    block_valid: jnp.ndarray   # (M,) bool
+    iso: jnp.ndarray           # () float32
+    origin: jnp.ndarray        # (3,) world position of voxel (0,0,0) center
+    scale: jnp.ndarray         # () world size of one fine voxel
+    resolution: int            # static: fine voxels per axis
+
+
+def _pack(bc: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3) block coords → packed int32 key (coords must be in range)."""
+    return ((bc[..., 0] << (2 * _KEY_BITS)) | (bc[..., 1] << _KEY_BITS)
+            | bc[..., 2])
+
+
+def _unpack(key: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([key >> (2 * _KEY_BITS),
+                      (key >> _KEY_BITS) & _KEY_MAX,
+                      key & _KEY_MAX], axis=-1)
+
+
+def _lookup(block_keys: jnp.ndarray, key: jnp.ndarray):
+    """Sorted-key → slot index. Returns (slot, found) with slot clamped."""
+    m = block_keys.shape[0]
+    pos = jnp.searchsorted(block_keys, key).astype(jnp.int32)
+    pos_c = jnp.minimum(pos, m - 1)
+    return pos_c, block_keys[pos_c] == key
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("resolution", "max_blocks", "cg_iters",
+                                    "coarse_resolution", "coarse_iters"))
+def _solve_sparse(points, normals, valid, resolution: int, max_blocks: int,
+                  cg_iters: int, screen, coarse_resolution: int,
+                  coarse_iters: int):
+    R = resolution
+    nb_axis = R // BS
+    n = points.shape[0]
+
+    grid_pts, origin, scale = dense_poisson.normalize_points(points, valid, R)
+
+    # ------------------------------------------------------------------
+    # Coarse dense solve (same world cube: coords differ by a pure ratio).
+    # ------------------------------------------------------------------
+    coarse = dense_poisson._solve(points, normals, valid, coarse_resolution,
+                                  coarse_iters, screen)
+    c_ratio = (coarse_resolution - 1.0) / (R - 1.0)
+
+    # ------------------------------------------------------------------
+    # Active band: 27-dilated block keys of every sample, sort-unique into
+    # max_blocks static slots (ascending keys; surplus blocks dropped).
+    # ------------------------------------------------------------------
+    pblock = jnp.clip((grid_pts // BS).astype(jnp.int32), 0, nb_axis - 1)
+    offs = jnp.asarray([(dx, dy, dz) for dx in (-1, 0, 1)
+                        for dy in (-1, 0, 1) for dz in (-1, 0, 1)],
+                       jnp.int32)
+    cand = pblock[:, None, :] + offs[None, :, :]          # (N, 27, 3)
+    in_rng = jnp.all((cand >= 0) & (cand < nb_axis), axis=-1)
+    keys = jnp.where(in_rng & valid[:, None], _pack(cand), _BIG).reshape(-1)
+
+    sk = jnp.sort(keys)
+    first = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    new = first & (sk < _BIG)
+    rank = jnp.cumsum(new.astype(jnp.int32)) - 1
+    n_blocks = jnp.sum(new.astype(jnp.int32))
+    slot_of = jnp.where(new & (rank < max_blocks), rank, max_blocks)
+    block_keys = jnp.full((max_blocks + 1,), _BIG,
+                          jnp.int32).at[slot_of].set(
+        jnp.where(new, sk, _BIG))[:max_blocks]
+    block_valid = block_keys < _BIG
+    block_coords = jnp.where(block_valid[:, None], _unpack(block_keys),
+                             jnp.int32(nb_axis + 1))
+    m = max_blocks
+
+    # Neighbor table (M, 6): slots of the ±x/±y/±z blocks (m → "absent").
+    units = jnp.asarray([[1, 0, 0], [-1, 0, 0], [0, 1, 0],
+                         [0, -1, 0], [0, 0, 1], [0, 0, -1]], jnp.int32)
+    nb_coords = block_coords[:, None, :] + units[None]     # (M, 6, 3)
+    nb_ok = jnp.all((nb_coords >= 0) & (nb_coords < nb_axis), axis=-1)
+    nb_slot, nb_found = _lookup(block_keys, _pack(jnp.clip(nb_coords, 0,
+                                                           _KEY_MAX)))
+    nbr = jnp.where(nb_ok & nb_found & block_valid[:, None], nb_slot, m)
+
+    # ------------------------------------------------------------------
+    # Sparse trilinear splat of [normals, 1] into the bricks.
+    # ------------------------------------------------------------------
+    g = jnp.clip(grid_pts, 0.0, R - 1 - 1e-4)
+    i0 = jnp.floor(g).astype(jnp.int32)
+    f = g - i0
+    corners = jnp.asarray([[dx, dy, dz] for dx in (0, 1) for dy in (0, 1)
+                           for dz in (0, 1)], jnp.int32)
+    vidx = jnp.clip(i0[:, None, :] + corners[None], 0, R - 1)  # (N, 8, 3)
+    cb = vidx // BS
+    intra = vidx - cb * BS
+    cslot, cfound = _lookup(block_keys, _pack(cb))
+    cf = corners[None].astype(jnp.float32)
+    w = jnp.prod(cf * f[:, None, :] + (1 - cf) * (1 - f[:, None, :]),
+                 axis=-1)
+    w = w * (valid[:, None] & cfound).astype(jnp.float32)
+    flat = (cslot * BS * BS * BS
+            + (intra[..., 0] * BS + intra[..., 1]) * BS + intra[..., 2])
+    vals = jnp.concatenate([normals, jnp.ones((n, 1), jnp.float32)], -1)
+    contrib = w[..., None] * vals[:, None, :]              # (N, 8, 4)
+    acc = jnp.zeros((m * BS**3 + 1, 4), jnp.float32)
+    acc = acc.at[jnp.where(cfound, flat, m * BS**3).reshape(-1)].add(
+        contrib.reshape(-1, 4))[:-1]
+    bricks = acc.reshape(m, BS, BS, BS, 4)
+    V = bricks[..., :3]
+    density = bricks[..., 3]
+
+    # ------------------------------------------------------------------
+    # Halo'd stencils over the band.
+    # ------------------------------------------------------------------
+    def haloed(x, dirichlet=None):
+        """(M,8,8,8) → (M,10,10,10) with face halos from neighbors;
+        absent neighbors use ``dirichlet`` (M,6,8,8) or zero."""
+        xp = jnp.concatenate([x, jnp.zeros((1, BS, BS, BS), x.dtype)])
+        H = jnp.zeros((m, BS + 2, BS + 2, BS + 2), x.dtype)
+        H = H.at[:, 1:-1, 1:-1, 1:-1].set(x)
+        face_src = [  # neighbor slot axis face → our halo face
+            (0, xp[nbr[:, 0], 0, :, :], (slice(None), BS + 1,
+                                         slice(1, -1), slice(1, -1))),
+            (1, xp[nbr[:, 1], BS - 1, :, :], (slice(None), 0,
+                                              slice(1, -1), slice(1, -1))),
+            (2, xp[nbr[:, 2], :, 0, :], (slice(None), slice(1, -1),
+                                         BS + 1, slice(1, -1))),
+            (3, xp[nbr[:, 3], :, BS - 1, :], (slice(None), slice(1, -1),
+                                              0, slice(1, -1))),
+            (4, xp[nbr[:, 4], :, :, 0], (slice(None), slice(1, -1),
+                                         slice(1, -1), BS + 1)),
+            (5, xp[nbr[:, 5], :, :, BS - 1], (slice(None), slice(1, -1),
+                                              slice(1, -1), 0)),
+        ]
+        for fidx, vals_f, dst in face_src:
+            have = (nbr[:, fidx] < m)[:, None, None]
+            if dirichlet is not None:
+                fill = jnp.where(have, vals_f, dirichlet[:, fidx])
+            else:
+                fill = jnp.where(have, vals_f, 0.0)
+            H = H.at[dst].set(fill)
+        return H
+
+    def lap_band(x, dirichlet=None):
+        H = haloed(x, dirichlet)
+        c = H[:, 1:-1, 1:-1, 1:-1]
+        return (H[:, 2:, 1:-1, 1:-1] + H[:, :-2, 1:-1, 1:-1]
+                + H[:, 1:-1, 2:, 1:-1] + H[:, 1:-1, :-2, 1:-1]
+                + H[:, 1:-1, 1:-1, 2:] + H[:, 1:-1, 1:-1, :-2]
+                - 6.0 * c)
+
+    def div_band(Vb):
+        out = jnp.zeros((m, BS, BS, BS), jnp.float32)
+        for axis in range(3):
+            H = haloed(Vb[..., axis])
+            sl = [slice(None), slice(1, -1), slice(1, -1), slice(1, -1)]
+            hi = list(sl)
+            lo = list(sl)
+            hi[axis + 1] = slice(2, None)
+            lo[axis + 1] = slice(0, -2)
+            out = out + 0.5 * (H[tuple(hi)] - H[tuple(lo)])
+        return out
+
+    rhs = div_band(V)
+
+    wmean = jnp.sum(density) / jnp.maximum(
+        jnp.sum((density > 0).astype(jnp.float32)), 1.0)
+    W = screen * density / jnp.maximum(wmean, 1e-12)
+
+    # Voxel centers of every brick voxel, in fine grid coords.
+    vox = jnp.arange(BS, dtype=jnp.int32)
+    bx = block_coords[:, 0, None, None, None] * BS + vox[:, None, None]
+    by = block_coords[:, 1, None, None, None] * BS + vox[None, :, None]
+    bz = block_coords[:, 2, None, None, None] * BS + vox[None, None, :]
+    vox_xyz = jnp.stack(jnp.broadcast_arrays(bx, by, bz), -1).astype(
+        jnp.float32)                                       # (M,8,8,8,3)
+
+    def prolong(coords_xyz):
+        """Trilinear sample of the coarse chi at fine-grid coords, chunked:
+        a flat gather would materialize (M·8³, 8, 3) corner-index tensors —
+        tens of GB at a 10⁵-block band."""
+        flat = coords_xyz.reshape(-1, 3)
+        rows = flat.shape[0]
+        chunk = 1 << 21
+        pad = (-rows) % chunk
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad, 3), flat.dtype)])
+        parts = flat.reshape(-1, chunk, 3)
+        vals = jax.lax.map(
+            lambda c: dense_poisson.gather(coarse.chi, c * c_ratio), parts)
+        return vals.reshape(-1)[:rows].reshape(coords_xyz.shape[:-1])
+
+    x0 = jnp.where(block_valid[:, None, None, None], prolong(vox_xyz), 0.0)
+
+    # Dirichlet halo values for chi at absent-neighbor faces (the halo
+    # voxel = face voxel + unit step, prolonged from the coarse solution).
+    face_coords = []
+    for fidx in range(6):
+        ax = fidx // 2
+        sl = [slice(None)] * 4
+        sl[ax + 1] = BS - 1 if fidx % 2 == 0 else 0
+        fc = vox_xyz[tuple(sl)]                            # (M, 8, 8, 3)
+        face_coords.append(fc + units[fidx].astype(jnp.float32))
+    dir_chi = jnp.stack([prolong(fc) for fc in face_coords], 1)  # (M,6,8,8)
+    dir_chi = jnp.where(block_valid[:, None, None, None], dir_chi, 0.0)
+
+    # Fold the constant Dirichlet halo into the RHS once:
+    #   A(x; halo) = A0(x) + L_halo  ⇒  solve A0 x = b − L_halo.
+    halo_term = lap_band(jnp.zeros_like(x0), dirichlet=dir_chi)
+
+    def A0(x):
+        return lap_band(x) - W * x
+
+    band = block_valid[:, None, None, None]
+
+    def matvec(x):
+        return jnp.where(band, -(A0(x)), 0.0)
+
+    b = jnp.where(band, -(rhs - halo_term), 0.0)
+    r0 = b - matvec(x0)
+    p0 = r0
+    rs0 = jnp.vdot(r0, r0)
+
+    def body(_, state):
+        x, r, p, rs = state
+        Ap = matvec(p)
+        alpha = rs / jnp.maximum(jnp.vdot(p, Ap), 1e-30)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rs_new = jnp.vdot(r, r)
+        beta = rs_new / jnp.maximum(rs, 1e-30)
+        p = r + beta * p
+        return x, r, p, rs_new
+
+    chi, _, _, _ = jax.lax.fori_loop(0, cg_iters, body, (x0, r0, p0, rs0))
+    chi = jnp.where(band, chi, 0.0)
+
+    # Iso level: density-weighted mean of chi at the samples, gathered
+    # from the bricks (8 trilinear corners per sample).
+    cflat = chi.reshape(-1)
+    dflat = density.reshape(-1)
+    ok8 = cfound & valid[:, None]
+    w8 = w  # already masked by validity & found
+    chi_pts = jnp.sum(jnp.where(ok8, cflat[flat], 0.0) * w8, axis=1)
+    den_pts = jnp.sum(jnp.where(ok8, dflat[flat], 0.0) * w8, axis=1)
+    iso = jnp.sum(chi_pts * den_pts) / jnp.maximum(
+        jnp.sum(den_pts), 1e-12)
+
+    return SparsePoissonGrid(chi, density, block_coords, block_valid,
+                             iso, origin, scale, R), n_blocks
+
+
+def reconstruct_sparse(points, normals, valid=None, depth: int = 10,
+                       cg_iters: int = 200, screen: float = 4.0,
+                       max_blocks: int = 131_072, coarse_depth: int = 7,
+                       coarse_iters: int = 300):
+    """Band-sparse screened Poisson at depth 9-12 (module docstring).
+
+    Matches the reference's octree-Poisson role at its default depth 10
+    (`server/processing.py:293`); depth > 12 is rejected the way the
+    reference rejects > 16 (`server/processing.py:207-208`) — 4096³ virtual
+    grids exceed the band budget this scheme targets.
+    """
+    if depth > 12:
+        raise ValueError(f"depth={depth} > 12: the band-sparse solver is "
+                         "bounded at 4096³ virtual resolution (the "
+                         "reference similarly guards depth > 16)")
+    if 2 ** depth < 4 * BS:
+        raise ValueError(f"depth={depth} too shallow for the block solver; "
+                         "use ops.poisson.reconstruct")
+    points = jnp.asarray(points, jnp.float32)
+    normals = jnp.asarray(normals, jnp.float32)
+    if valid is None:
+        valid = jnp.ones(points.shape[0], dtype=bool)
+    grid, n_blocks = _solve_sparse(
+        points, normals, valid, 2 ** depth, max_blocks, cg_iters,
+        jnp.float32(screen), 2 ** min(coarse_depth, depth), coarse_iters)
+    return grid, n_blocks

@@ -1,0 +1,139 @@
+"""Ball-pivoting validation: mesh-quality metrics on analytic shapes.
+
+Open3D is not installable in this image, so parity with its BPA is
+asserted against the properties Open3D's output is known for on these
+shapes (VERDICT r1 item 6): near-2n triangle counts on closed surfaces,
+(near-)watertight edge topology, no non-manifold edges, outward winding,
+and open boundaries kept open. The measured numbers are recorded in
+docs/BPA_PARITY.md."""
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def mesh_metrics(pts, tris, outward_ref=None):
+    """Edge topology + winding statistics of a triangle soup."""
+    from collections import Counter
+
+    edges = Counter()
+    for t in tris:
+        for a, b in ((t[0], t[1]), (t[1], t[2]), (t[2], t[0])):
+            edges[(min(a, b), max(a, b))] += 1
+    counts = np.array(list(edges.values()))
+    m = {
+        "faces": len(tris),
+        "verts_used": len(np.unique(tris)),
+        "boundary_edges": int((counts == 1).sum()),
+        "nonmanifold_edges": int((counts > 2).sum()),
+    }
+    if outward_ref is not None and len(tris):
+        a, b, c = pts[tris[:, 0]], pts[tris[:, 1]], pts[tris[:, 2]]
+        fn = np.cross(b - a, c - a)
+        cen = (a + b + c) / 3
+        m["outward_frac"] = float(
+            (np.einsum("ij,ij->i", fn, outward_ref(cen)) > 0).mean())
+    return m
+
+
+def _radii(pts):
+    from scipy.spatial import cKDTree
+
+    d, _ = cKDTree(pts).query(pts, k=2)
+    avg = float(d[:, 1].mean())
+    return [avg * m for m in (1.0, 2.0, 4.0)]  # server/processing.py:228
+
+
+def _sphere(rng, n=4000, r=50.0):
+    u = rng.normal(size=(n, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    return (u * r).astype(np.float32), u.astype(np.float32)
+
+
+def _torus(rng, n=6000, R=50.0, r=18.0):
+    a = rng.uniform(0, 2 * np.pi, n)
+    b = rng.uniform(0, 2 * np.pi, n)
+    pts = np.stack([(R + r * np.cos(b)) * np.cos(a),
+                    (R + r * np.cos(b)) * np.sin(a),
+                    r * np.sin(b)], 1).astype(np.float32)
+    nrm = np.stack([np.cos(b) * np.cos(a), np.cos(b) * np.sin(a),
+                    np.sin(b)], 1).astype(np.float32)
+    return pts, nrm
+
+
+def _open_cylinder(rng, n=4000, r=40.0, h=120.0):
+    a = rng.uniform(0, 2 * np.pi, n)
+    z = rng.uniform(-h / 2, h / 2, n)
+    pts = np.stack([r * np.cos(a), r * np.sin(a), z], 1).astype(np.float32)
+    nrm = np.stack([np.cos(a), np.sin(a), np.zeros(n)], 1).astype(
+        np.float32)
+    return pts, nrm
+
+
+def test_sphere_watertight_and_outward(rng):
+    pts, nrm = _sphere(rng)
+    tris = native.ball_pivot(pts, nrm, _radii(pts))
+    m = mesh_metrics(pts, tris,
+                     outward_ref=lambda c: c / np.linalg.norm(
+                         c, axis=1, keepdims=True))
+    assert m["faces"] > 1.6 * len(pts)          # closed mesh ≈ 2n faces
+    assert m["nonmanifold_edges"] == 0
+    assert m["boundary_edges"] < 0.01 * m["faces"]
+    assert m["outward_frac"] > 0.99
+    assert m["verts_used"] > 0.95 * len(pts)
+
+
+def test_torus_topology(rng):
+    pts, nrm = _torus(rng)
+    tris = native.ball_pivot(pts, nrm, _radii(pts))
+
+    def outward(c):
+        ax = c.copy()
+        ax[:, 2] = 0.0
+        ax /= np.maximum(np.linalg.norm(ax, axis=1, keepdims=True), 1e-9)
+        d = c - ax * 50.0
+        return d / np.maximum(np.linalg.norm(d, axis=1, keepdims=True),
+                              1e-9)
+
+    m = mesh_metrics(pts, tris, outward_ref=outward)
+    assert m["faces"] > 1.6 * len(pts)
+    assert m["nonmanifold_edges"] == 0
+    assert m["boundary_edges"] < 0.01 * m["faces"]
+    assert m["outward_frac"] > 0.98
+
+
+def test_open_cylinder_keeps_rims_open(rng):
+    """Genuine surface boundaries (the two rims) must NOT be capped by the
+    hole filler — only small residual holes are."""
+    pts, nrm = _open_cylinder(rng)
+    tris = native.ball_pivot(pts, nrm, _radii(pts))
+    m = mesh_metrics(pts, tris)
+    assert m["faces"] > 1.4 * len(pts)
+    assert m["nonmanifold_edges"] == 0
+    # Two rims worth of boundary edges survive.
+    assert m["boundary_edges"] > 50
+
+
+def test_hole_filling_closes_small_punctures(rng):
+    """A puncture (points removed in a small cap) leaves a boundary loop
+    that the post-pass filler closes; disabling the filler leaves it."""
+    pts, nrm = _sphere(rng, n=5000)
+    # The puncture must exceed what the largest (4×avg-NN) ball bridges on
+    # its own: radius 12 ≈ 5 ball-diameters at this density.
+    keep = np.linalg.norm(pts - pts[0], axis=1) > 12.0
+    assert 20 <= (~keep).sum() <= 200
+    pts, nrm = pts[keep], nrm[keep]
+    radii = _radii(pts)
+
+    tris_nofill = native.ball_pivot(pts, nrm, radii, max_hole_edges=0)
+    m0 = mesh_metrics(pts, tris_nofill)
+    assert m0["boundary_edges"] >= 3  # the puncture is really open
+    tris_fill = native.ball_pivot(pts, nrm, radii, max_hole_edges=40)
+    m1 = mesh_metrics(pts, tris_fill)
+    assert m1["boundary_edges"] < m0["boundary_edges"]
+    assert m1["faces"] > m0["faces"]
+    assert m1["nonmanifold_edges"] == 0

@@ -111,6 +111,19 @@ def test_merge_and_mesh_cli(session, tmp_path, rng):
     assert stl.stat().st_size > 84
 
 
+def test_client_build_smoke():
+    """The satellite clients' CI-style check (real toolchains when present,
+    structural validation otherwise) passes in this image."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, os.path.join(root, "clients",
+                                                     "check.py")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_scan_virtual_auto360(tmp_path):
     rc = cli.main(["scan", "auto360", "--virtual", "--name", "t",
                    "--session", str(tmp_path), "--turns", "2",

@@ -56,6 +56,79 @@ def _pose_errors(poses, deg):
             for i, P in enumerate(poses)]
 
 
+def _vase_views(rng, n_views=8, deg=15.0, n_pts=1500):
+    """A smooth surface of revolution (vase about z) with a bump cluster
+    at one azimuth: most view pairs share NO rotation signal (the vase is
+    rotation-invariant about its own axis), only the pairs that both see
+    the bumps do. Half-space visibility (y > 0) emulates a fixed camera;
+    bumps start at azimuth 75° so they stay visible through the first few
+    stops, giving the consensus a handful of trusted edges."""
+    phi = rng.uniform(0, 2 * np.pi, 40000)
+    z = rng.uniform(-1.0, 1.0, 40000)
+    r = 0.8 + 0.25 * np.sin(2.5 * z)
+    base = np.stack([r * np.cos(phi), r * np.sin(phi), z], 1)
+    az = np.deg2rad(75.0)
+    for bz in (-0.5, 0.1, 0.6):
+        rb = 0.8 + 0.25 * np.sin(2.5 * bz)
+        u = rng.normal(size=(4000, 3))
+        u /= np.linalg.norm(u, axis=1, keepdims=True)
+        c = np.array([rb * np.cos(az), rb * np.sin(az), bz])
+        base = np.vstack([base, c + 0.25 * u])
+    base = base.astype(np.float32)
+    views = []
+    for i in range(n_views):
+        T = _rot_z(-deg * i)
+        pts = base @ T[:3, :3].T
+        vis = pts[:, 1] > 0.05          # camera side
+        sel = pts[vis]
+        sel = sel[rng.permutation(len(sel))[:n_pts]]
+        sel += rng.normal(scale=0.002, size=sel.shape)
+        views.append(sel.astype(np.float32))
+    pad = max(len(v) for v in views)
+    points = np.zeros((n_views, pad, 3), np.float32)
+    valid = np.zeros((n_views, pad), bool)
+    for i, v in enumerate(views):
+        points[i, :len(v)] = v
+        valid[i, :len(v)] = True
+    return points, valid
+
+
+def test_axis_prior_rescues_featureless_ring(rng):
+    """VERDICT r1 item 8: on a smooth surface of revolution the hint/
+    identity fallback slides (rotation unobservable per edge, fitness
+    stays high); the turntable-axis consensus seeded from the few
+    bump-visible edges must rigidify the whole ring."""
+    import dataclasses
+
+    from structured_light_for_3d_model_replication_tpu.ops import posegraph
+
+    deg = 15.0
+    points, valid = _vase_views(rng, n_views=8, deg=deg)
+
+    def ring_angles(params):
+        seq_T, _, _, _, _ = merge.register_sequence(
+            points, valid, params, loop_closure=False)
+        poses = np.asarray(posegraph.chain_poses(seq_T))
+        return np.array([
+            np.degrees(np.arccos(np.clip(
+                (np.trace(P[:3, :3]) - 1) / 2, -1, 1)))
+            for P in poses])
+
+    base = dataclasses.replace(FAST, voxel_size=0.05)
+    with_prior = ring_angles(dataclasses.replace(
+        base, axis_prior=True, step_deg=deg))
+    expected = np.arange(8) * deg
+    err_with = np.abs(with_prior - expected).max()
+    assert err_with < 4.0, f"prior ring angles {with_prior}"
+
+    without = ring_angles(dataclasses.replace(base, axis_prior=False))
+    err_without = np.abs(without - expected).max()
+    # The unassisted chain must actually be broken on this geometry —
+    # otherwise this test proves nothing.
+    assert err_without > err_with + 4.0, (
+        f"chain unexpectedly fine without prior: {without}")
+
+
 def test_merge_pro_360_recovers_ring(rng):
     views = _ring_views(rng)
     merged, poses = merge.merge_pro_360(views, FAST)

@@ -1,0 +1,83 @@
+"""End-to-end reconstruction from the photoreal capture fixture.
+
+The environment has no physical camera and the reference repo ships no
+sample captures, so `tests/fixtures/realistic_stack/` is the closest
+available stand-in for a real photographed Gray-code stack: the
+ray-traced render passed through the full sensor/optics degradation
+chain of `models/realism.py` (defocus, Brown–Conrady lens distortion,
+vignetting, exposure drift, shot+read noise, gamma, JPEG 85) and stored
+as the JPEG files a phone upload would produce. Ground truth (pre-
+degradation geometry + rig) rides along in ground_truth.npz.
+
+What this certifies that the clean synthetic tests cannot: the adaptive
+and fixed threshold variants (`server/sl_system.py:526-535`,
+`multi_point_cloud_process.py:36-38`) hold up under realistic photometry,
+the JAX decode stays bit-exact with the NumPy oracle on camera-grade
+images, and the pinhole triangulation error under a REAL lens model is
+quantified (the reference reconstructs without undistorting captures, so
+it carries the same systematic term)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import DecodeConfig
+from structured_light_for_3d_model_replication_tpu.io import images as img_io
+from structured_light_for_3d_model_replication_tpu.models import oracle
+from structured_light_for_3d_model_replication_tpu.ops import decode, triangulate
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "realistic_stack")
+COL_BITS, ROW_BITS = 8, 7
+
+
+@pytest.fixture(scope="module")
+def fixture_stack():
+    stack = img_io.load_stack(FIXTURE)
+    gt = np.load(os.path.join(FIXTURE, "ground_truth.npz"))
+    assert stack.shape == (2 + 2 * (COL_BITS + ROW_BITS), 96, 160)
+    return stack, gt
+
+
+def test_adaptive_decode_matches_oracle_on_photoreal_frames(fixture_stack):
+    stack, _ = fixture_stack
+    c, r, m = (np.asarray(a) for a in decode.decode_stack(
+        stack, COL_BITS, ROW_BITS))
+    co, ro, mo = oracle.decode_stack_np(stack, COL_BITS, ROW_BITS)
+    # Bit-exact agreement with the reference-semantics NumPy oracle, on
+    # camera-grade (noisy, distorted, JPEG) frames.
+    assert (m == mo).all()
+    assert (c[m] == co[m]).all() and (r[m] == ro[m]).all()
+    # The adaptive mask keeps the lit object+wall and drops shadow.
+    assert 0.5 < m.mean() < 0.9, m.mean()
+
+
+def test_fixed_thresholds_survive_photoreal_frames(fixture_stack):
+    stack, _ = fixture_stack
+    cfg = DecodeConfig(mode="fixed")
+    _, _, m = (np.asarray(a) for a in decode.decode_stack(
+        stack, COL_BITS, ROW_BITS, cfg=cfg))
+    mo = oracle.decode_stack_np(stack, COL_BITS, ROW_BITS, cfg=cfg)[2]
+    assert (m == mo).all()
+    assert 0.5 < m.mean() < 0.95, m.mean()
+
+
+def test_reconstruction_error_bounded_under_lens_model(fixture_stack):
+    stack, gt = fixture_stack
+    c, r, m = decode.decode_stack(stack, COL_BITS, ROW_BITS)
+    cal = triangulate.make_calibration(gt["cam_K"], gt["proj_K"], gt["R"],
+                                       gt["T"], 96, 160,
+                                       proj_width=256, proj_height=128)
+    pts, valid = triangulate.triangulate(c, r, m, cal)
+    p = np.asarray(pts).reshape(-1, 3)
+    v = np.asarray(valid)
+    gtp = gt["points"].reshape(-1, 3)
+    both = v & gt["valid"].reshape(-1)
+    assert both.mean() > 0.5
+    err = np.linalg.norm(p[both] - gtp[both], axis=1)
+    # Measured on this fixture: median ≈ 3.6 mm, p90 ≈ 18 mm at ~900 mm
+    # range — noise + the (deliberately uncorrected) barrel distortion.
+    # The bounds document the systematic lens term rather than hide it.
+    assert np.median(err) < 6.0, np.median(err)
+    assert np.percentile(err, 90) < 30.0, np.percentile(err, 90)

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 from scipy.spatial import cKDTree
 
+from structured_light_for_3d_model_replication_tpu.ops.brickknn import brick_knn
 from structured_light_for_3d_model_replication_tpu.ops.gridknn import grid_knn
 from structured_light_for_3d_model_replication_tpu.ops.mortonknn import morton_knn
 from structured_light_for_3d_model_replication_tpu.ops import pointcloud
@@ -14,6 +15,38 @@ def _surface(rng, n):
     z = rng.uniform(0, 160, n)
     pts = np.stack([80 * np.cos(t), z, 80 * np.sin(t)], -1)
     return (pts + rng.normal(0, 0.3, pts.shape)).astype(np.float32)
+
+
+def test_rescue_recall_beats_block_pass(rng):
+    """The brick-grid rescue engine reaches recall ≥ 0.99 where the Morton
+    block pass sits ≈ 0.93 (VERDICT r1 item 7)."""
+    pts = _surface(rng, 60000)
+    k = 20
+    ref_d, ref_i = cKDTree(pts).query(pts, k=k + 1)
+    ref_i = ref_i[:, 1:]
+
+    def recall(engine, **kw):
+        d2, idx, ok = engine(pts, k, exclude_self=True, **kw)
+        idx, ok = np.asarray(idx), np.asarray(ok)
+        rows = range(0, len(pts), 23)
+        return np.mean([np.isin(idx[i][ok[i]], ref_i[i]).mean()
+                        for i in rows if ok[i].any()])
+
+    base = recall(morton_knn)
+    resc = recall(brick_knn)
+    assert resc >= 0.99, f"rescue recall {resc}"
+    assert resc > base  # strictly better than the single pass
+
+
+def test_rescue_valid_mask_and_self_exclusion(rng):
+    pts = _surface(rng, 8000)
+    valid = rng.random(8000) > 0.5
+    d2, idx, ok = brick_knn(pts, 8, points_valid=valid, exclude_self=True)
+    sel = np.asarray(idx)[np.asarray(ok)]
+    assert np.asarray(valid)[sel].all()
+    own = np.arange(8000)[:, None]
+    assert not np.any((np.asarray(idx) == own) & np.asarray(ok))
+    assert not np.asarray(ok)[~valid].any()
 
 
 @pytest.mark.parametrize("engine,min_recall", [(grid_knn, 0.97),
@@ -61,6 +94,57 @@ def test_self_knn_dispatch_methods(rng):
         d2, idx, ok = pointcloud._self_knn(pts, 5, valid, True, method)
         assert d2.shape == (2048, 5)
         assert bool(np.asarray(ok).any())
+
+
+def test_fused_sor_normals_matches_two_pass(rng):
+    """The one-launch fused SOR+normals (ops/sor_normals.py) agrees with
+    the separate SOR → estimate_normals(valid=keep) chain it replaces."""
+    from structured_light_for_3d_model_replication_tpu.ops.sor_normals import (
+        sor_normals,
+    )
+
+    pts = _surface(rng, 12000)
+    out = np.vstack([pts, rng.uniform(-300, 300, (100, 3)).astype(np.float32)])
+    keep_f, nrm_f, nv_f = (np.asarray(a) for a in sor_normals(
+        out, nb_neighbors=20, std_ratio=2.0, k_normals=30))
+
+    keep_2 = pointcloud.statistical_outlier_removal(
+        out, nb_neighbors=20, std_ratio=2.0, neighbor_method="morton")
+    nrm_2, nv_2 = pointcloud.estimate_normals(
+        out, valid=keep_2, k=30, neighbor_method="morton")
+    keep_2, nrm_2, nv_2 = (np.asarray(a) for a in (keep_2, nrm_2, nv_2))
+
+    # Keep masks agree (same engine, same statistics).
+    assert (keep_f == keep_2).mean() > 0.995
+    # The injected far outliers die.
+    assert keep_f[-100:].mean() < 0.3
+    # Normals: compare where both valid — the cylinder's analytic normal is
+    # radial, so check against ground truth rather than bitwise agreement.
+    both = nv_f & nv_2
+    assert both.mean() > 0.9
+    radial = out[:12000].copy()
+    radial[:, 1] = 0.0
+    radial /= np.maximum(np.linalg.norm(radial, axis=1, keepdims=True), 1e-9)
+    m = both[:12000]
+    cosang = np.abs(np.einsum("ij,ij->i", nrm_f[:12000][m], radial[m]))
+    assert np.median(cosang) > 0.99
+    # And the fused normals track the two-pass ones directly.
+    cos2 = np.abs(np.einsum("ij,ij->i", nrm_f[both], nrm_2[both]))
+    assert np.median(cos2) > 0.999
+
+
+def test_fused_sor_normals_respects_valid_mask(rng):
+    from structured_light_for_3d_model_replication_tpu.ops.sor_normals import (
+        sor_normals,
+    )
+
+    pts = _surface(rng, 4000)
+    valid = rng.random(4000) > 0.4
+    keep, nrm, nv = (np.asarray(a) for a in sor_normals(
+        pts, valid=np.asarray(valid), nb_neighbors=10, k_normals=12))
+    assert not keep[~valid].any()
+    assert not nv[~valid].any()
+    assert nv.sum() > 0
 
 
 def test_sor_grid_matches_dense_statistics(rng):

@@ -337,15 +337,20 @@ static void fill_holes(std::vector<int32_t>& tris, int32_t n,
     // any fan diagonal coincides with an already-closed mesh edge, which
     // would go non-manifold.
     bool can_fan = true;
-    for (size_t i = 1; i + 1 < loop.size() && can_fan; i++) {
-      auto chk = [&](int32_t a, int32_t b) {
-        auto it = count.find({std::min(a, b), std::max(a, b)});
-        return it == count.end() || it->second < 2;
-      };
-      if (!chk(loop[0], loop[i]) || !chk(loop[i], loop[i + 1]) ||
-          !chk(loop[0], loop[i + 1])) {
-        can_fan = false;
-      }
+    auto facets = [&](int32_t a, int32_t b) {
+      auto it = count.find({std::min(a, b), std::max(a, b)});
+      return it == count.end() ? 0 : it->second;
+    };
+    // Loop boundary edges carry 1 facet and will take exactly one more;
+    // interior fan DIAGONALS (loop[0]..loop[j], 2 <= j <= L-2) are shared
+    // by TWO fan triangles, so they must not exist at all yet — a
+    // pre-existing single-facet chord would go to 3 facets.
+    for (size_t i = 1; i < loop.size() && can_fan; i++) {
+      if (facets(loop[i - 1], loop[i]) != 1) can_fan = false;
+    }
+    if (facets(loop.back(), loop[0]) != 1) can_fan = false;
+    for (size_t j = 2; j + 1 < loop.size() && can_fan; j++) {
+      if (facets(loop[0], loop[j]) != 0) can_fan = false;
     }
     if (!can_fan) continue;
     for (size_t i = 1; i + 1 < loop.size(); i++) {

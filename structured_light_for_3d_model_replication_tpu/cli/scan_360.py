@@ -29,6 +29,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-points", type=int, default=16_384)
     p.add_argument("--stop-chunk", type=int, default=6,
                    help="stops decoded per device dispatch (HBM bound)")
+    p.add_argument("--fused", action="store_true",
+                   help="compile the whole pipeline into ONE device launch "
+                        "(heavy cold compile; lowest latency warm)")
+    p.add_argument("--step-deg", type=float, default=None,
+                   help="commanded turntable advance per stop; feeds the "
+                        "axis-consensus prior. Default: parsed from an "
+                        "'..._<deg>deg_AUTO' session folder name when "
+                        "present")
     p.add_argument("--stl", default=None,
                    help="also mesh the merged cloud to this STL (watertight "
                         "screened Poisson; the full scan→print path in one "
@@ -52,10 +60,25 @@ def main(argv=None) -> int:
         raise SystemExit(f"{args.input}: need ≥2 per-stop frame folders, "
                          f"found {len(stop_dirs)}")
 
+    step_deg = args.step_deg
+    if step_deg is None:
+        # The auto-scan layout encodes the commanded step in the session
+        # folder name: "<base>_<deg>deg_AUTO" (`server/gui.py:703-740`).
+        import re
+
+        m = re.search(r"_([0-9.]+)deg_AUTO$",
+                      os.path.basename(os.path.normpath(args.input)))
+        if m:
+            step_deg = float(m.group(1))
+            print(f"turntable step {step_deg}° (from session folder name)",
+                  file=sys.stderr)
+
     params = scan360.Scan360Params(
         merge=merge.MergeParams(voxel_size=args.voxel_size,
-                                max_points=args.max_points),
+                                max_points=args.max_points,
+                                step_deg=step_deg),
         method=args.method,
+        fused=args.fused,
         stop_chunk=args.stop_chunk)
     merged, poses = scan360.scan_folders_to_cloud(
         stop_dirs, args.calib, output_path=args.output, params=params)

@@ -411,7 +411,12 @@ def _axis_prior_pass(params: MergeParams, xs, outs):
     w_p = log_so3(Tp[:3, :3])
     disagree = jnp.linalg.norm(w_free - w_p[None], axis=1) \
         > 0.5 * jnp.maximum(jnp.linalg.norm(w_p), 1e-3)
-    margin = jnp.where(disagree, 10.0 * params.axis_prior_margin,
+    # The widened margin for disagreeing edges is only safe when the
+    # consensus is anchored by the COMMANDED step: on an irregular ring
+    # (skipped/resumed stop) with no step_deg, a genuinely different edge
+    # must not be dragged onto the majority vote.
+    wide = 10.0 if params.step_deg is not None else 1.0
+    margin = jnp.where(disagree, wide * params.axis_prior_margin,
                        params.axis_prior_margin)
     use2 = fit2 >= fit - margin
     return (jnp.where(use2[:, None, None], T2, Ts),

@@ -431,6 +431,10 @@ def scan_folders_to_cloud(
     from ..io import matcal
 
     stacks = np.stack([img_io.load_stack(d) for d in stop_dirs])
+    if params.fused:
+        # The one-launch path needs device-resident stacks (host arrays
+        # fall back to the chunk-staged loop strategies).
+        stacks = jax.device_put(jnp.asarray(stacks))
     _, _, h, w = stacks.shape
     cal = matcal.load_calibration_mat(calib_path, h, w)
     # Bit counts follow the projector extent, `ceil(log2(dim))` — exactly how

@@ -24,7 +24,12 @@ Exact whenever the k-th neighbor lies within one cell radius and no
 involved cell overflows S — by construction of the cell-size estimate
 that holds for the overwhelming majority of queries: measured recall
 ≥ 0.99 at 1M/k=20 (tests/test_spatial_knn.py) vs 0.93 for the Morton
-engine, at comparable wall clock (no random gathers anywhere).
+engine. Measured cost at 1M on a v5e: ~4.5 s vs Morton's ~0.95 s — the
+27-brick window evaluates ~4.5× the candidates of Morton's 3-block
+window (plus empty padded slots), and that ratio IS the wall-clock
+ratio; the old gather-based grid engine at the same recall measured
+~14×. Use for precision-sensitive consumers, not the bulk statistics
+paths.
 
 Same (sq_dists, indices, neighbor_valid) contract as :func:`..ops.knn.knn`.
 """

@@ -337,6 +337,10 @@ def estimate_normals(
     pts = jnp.asarray(points, jnp.float32)
     if neighbors is not None:
         _, idx, nbv = (a[:, :k] for a in neighbors)
+        # The sweep may have been built under a wider validity mask (the
+        # shared-KNN pattern in merge._preprocess) — re-mask so invalid
+        # points never skew the covariance.
+        nbv = nbv & valid[idx]
     else:
         _, idx, nbv = _self_knn(pts, k, valid, False, neighbor_method)
     nbr = pts[idx]  # (N, k, 3)

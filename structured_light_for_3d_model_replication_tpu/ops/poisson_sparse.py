@@ -53,10 +53,15 @@ _BIG = 1 << 30               # sentinel key: sorts after every real block
 
 
 class SparsePoissonGrid(NamedTuple):
-    """Band-sparse solve result; extraction input for ``extract_sparse``."""
+    """Band-sparse solve result; extraction input for ``extract_sparse``.
 
-    chi: jnp.ndarray           # (M, BS, BS, BS) float32
-    density: jnp.ndarray       # (M, BS, BS, BS) float32 splat density
+    Brick fields are stored FLAT as (M, BS³): a materialized (M,8,8,8)
+    tensor pads 16× under the TPU's (8,128) tile (the last dim 8 rounds to
+    128) — flat bricks tile exactly. 3-D views exist only transiently
+    inside the stencil computations."""
+
+    chi: jnp.ndarray           # (M, BS³) float32
+    density: jnp.ndarray       # (M, BS³) float32 splat density
     block_coords: jnp.ndarray  # (M, 3) int32 block coords (padded rows big)
     block_valid: jnp.ndarray   # (M,) bool
     iso: jnp.ndarray           # () float32
@@ -85,29 +90,116 @@ def _lookup(block_keys: jnp.ndarray, key: jnp.ndarray):
     return pos_c, block_keys[pos_c] == key
 
 
+# ---------------------------------------------------------------------------
+# Flat-space stencils. EVERYTHING stays (M, BS³): on TPU any materialized
+# (…, 8, 8) / (…, 10, 10) trailing shape pads to the (8, 128) tile — 13-16×
+# memory blowup, the OOM that killed the first three layouts of this solver.
+# In flat index space (idx = (ix·8 + iy)·8 + iz) the 7-point stencil is six
+# rolls (±1, ±8, ±64) under boundary masks, and cross-brick faces are
+# static-index gathers from the neighbor brick's flat row.
+# ---------------------------------------------------------------------------
+
+import numpy as _np
+
+_FLAT_IDX = _np.arange(BS ** 3)
+_FIZ = _FLAT_IDX % BS
+_FIY = (_FLAT_IDX // BS) % BS
+_FIX = _FLAT_IDX // (BS * BS)
+
+# Direction order MATCHES the neighbor-table column order (units):
+# +x, -x, +y, -y, +z, -z.
+_DIRS = []
+for _ax, (_coord, _stride) in enumerate(
+        ((_FIX, BS * BS), (_FIY, BS), (_FIZ, 1))):
+    for _sign in (+1, -1):
+        _interior = (_coord < BS - 1) if _sign > 0 else (_coord > 0)
+        _at_face = ~_interior
+        # Neighbor-brick source index for our face positions: the same
+        # (other two coords), opposite wall on the stepped axis.
+        _src = _FLAT_IDX - _sign * _stride * (BS - 1)
+        # Dirichlet face map: dir_chi stores each face as the (a, b) plane
+        # of the two non-stepped axes, flattened a*8+b in vox order.
+        _others = [c for c in (_FIX, _FIY, _FIZ)
+                   if c is not _coord]
+        _face_map = _others[0] * BS + _others[1]
+        _DIRS.append((
+            _sign * _stride,
+            _interior.astype(_np.float32),
+            _at_face.astype(_np.float32),
+            _np.where(_at_face, _src, 0).astype(_np.int32),
+            _np.where(_at_face, _face_map, 0).astype(_np.int32),
+        ))
+
+
+def _dir_consts(d):
+    delta, interior, at_face, src, fmap = _DIRS[d]
+    return (delta, jnp.asarray(interior), jnp.asarray(at_face),
+            jnp.asarray(src), jnp.asarray(fmap))
+
+
+def _neighbor_sum(x, nbr, dirichlet=None):
+    """Σ over the 6 neighbors of each voxel, flat (M, BS³) in and out.
+    ``dirichlet`` (M, 6, BS²) supplies values past absent-neighbor faces
+    (None → zero)."""
+    m = x.shape[0]
+    xpad = jnp.concatenate([x, jnp.zeros((1, BS ** 3), x.dtype)])
+    acc = jnp.zeros_like(x)
+    for d in range(6):
+        delta, interior, at_face, src, fmap = _dir_consts(d)
+        inner = jnp.roll(x, -delta, axis=1) * interior
+        xn = xpad[nbr[:, d]]                       # (M, BS³) neighbor brick
+        face_vals = jnp.take(xn, src, axis=1)
+        if dirichlet is not None:
+            have = (nbr[:, d] < m)[:, None]
+            dvals = jnp.take(dirichlet[:, d], fmap, axis=1)
+            face_vals = jnp.where(have, face_vals, dvals)
+        acc = acc + inner + face_vals * at_face
+    return acc
+
+
+def _lap_band_flat(x, nbr, dirichlet=None):
+    return _neighbor_sum(x, nbr, dirichlet) - 6.0 * x
+
+
+def _div_band_flat(Vflat, nbr):
+    """Central-difference divergence; ``Vflat`` is (M, BS³, 3) (zero
+    Dirichlet — the splat support never reaches the band edge)."""
+    m = Vflat.shape[0]
+    out = jnp.zeros((m, BS ** 3), jnp.float32)
+    for ax in range(3):
+        x = Vflat[..., ax]
+        xpad = jnp.concatenate([x, jnp.zeros((1, BS ** 3), x.dtype)])
+        vals = []
+        for d in (2 * ax, 2 * ax + 1):             # +axis, −axis
+            delta, interior, at_face, src, _ = _dir_consts(d)
+            inner = jnp.roll(x, -delta, axis=1) * interior
+            xn = xpad[nbr[:, d]]
+            vals.append(inner + jnp.take(xn, src, axis=1) * at_face)
+        out = out + 0.5 * (vals[0] - vals[1])
+    return out
+
+
+# The solve runs as FOUR jitted programs (band+splat → prolong → CG →
+# iso) instead of one: a single program held the splat accumulator, the
+# prolongation temporaries (the (M,8³,3) voxel-center tensor and six face
+# stacks), the V field AND the CG state live simultaneously — compile-time
+# HBM peaked 1.3-1.5 GB over a 16 GB chip at a 10⁵-block band. Between
+# separate launches each phase's temporaries are freed before the next
+# phase's exist.
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("resolution", "max_blocks", "cg_iters",
-                                    "coarse_resolution", "coarse_iters"))
-def _solve_sparse(points, normals, valid, resolution: int, max_blocks: int,
-                  cg_iters: int, screen, coarse_resolution: int,
-                  coarse_iters: int):
+                   static_argnames=("resolution", "max_blocks"))
+def _setup_sparse(points, normals, valid, resolution: int, max_blocks: int,
+                  screen):
     R = resolution
     nb_axis = R // BS
     n = points.shape[0]
 
     grid_pts, origin, scale = dense_poisson.normalize_points(points, valid, R)
 
-    # ------------------------------------------------------------------
-    # Coarse dense solve (same world cube: coords differ by a pure ratio).
-    # ------------------------------------------------------------------
-    coarse = dense_poisson._solve(points, normals, valid, coarse_resolution,
-                                  coarse_iters, screen)
-    c_ratio = (coarse_resolution - 1.0) / (R - 1.0)
-
-    # ------------------------------------------------------------------
     # Active band: 27-dilated block keys of every sample, sort-unique into
     # max_blocks static slots (ascending keys; surplus blocks dropped).
-    # ------------------------------------------------------------------
     pblock = jnp.clip((grid_pts // BS).astype(jnp.int32), 0, nb_axis - 1)
     offs = jnp.asarray([(dx, dy, dz) for dx in (-1, 0, 1)
                         for dy in (-1, 0, 1) for dz in (-1, 0, 1)],
@@ -139,9 +231,7 @@ def _solve_sparse(points, normals, valid, resolution: int, max_blocks: int,
                                                            _KEY_MAX)))
     nbr = jnp.where(nb_ok & nb_found & block_valid[:, None], nb_slot, m)
 
-    # ------------------------------------------------------------------
     # Sparse trilinear splat of [normals, 1] into the bricks.
-    # ------------------------------------------------------------------
     g = jnp.clip(grid_pts, 0.0, R - 1 - 1e-4)
     i0 = jnp.floor(g).astype(jnp.int32)
     f = g - i0
@@ -162,67 +252,32 @@ def _solve_sparse(points, normals, valid, resolution: int, max_blocks: int,
     acc = jnp.zeros((m * BS**3 + 1, 4), jnp.float32)
     acc = acc.at[jnp.where(cfound, flat, m * BS**3).reshape(-1)].add(
         contrib.reshape(-1, 4))[:-1]
-    bricks = acc.reshape(m, BS, BS, BS, 4)
-    V = bricks[..., :3]
-    density = bricks[..., 3]
+    V = acc[:, :3].reshape(m, BS ** 3, 3)
+    density = acc[:, 3].reshape(m, BS**3)
 
-    # ------------------------------------------------------------------
-    # Halo'd stencils over the band.
-    # ------------------------------------------------------------------
-    def haloed(x, dirichlet=None):
-        """(M,8,8,8) → (M,10,10,10) with face halos from neighbors;
-        absent neighbors use ``dirichlet`` (M,6,8,8) or zero."""
-        xp = jnp.concatenate([x, jnp.zeros((1, BS, BS, BS), x.dtype)])
-        H = jnp.zeros((m, BS + 2, BS + 2, BS + 2), x.dtype)
-        H = H.at[:, 1:-1, 1:-1, 1:-1].set(x)
-        face_src = [  # neighbor slot axis face → our halo face
-            (0, xp[nbr[:, 0], 0, :, :], (slice(None), BS + 1,
-                                         slice(1, -1), slice(1, -1))),
-            (1, xp[nbr[:, 1], BS - 1, :, :], (slice(None), 0,
-                                              slice(1, -1), slice(1, -1))),
-            (2, xp[nbr[:, 2], :, 0, :], (slice(None), slice(1, -1),
-                                         BS + 1, slice(1, -1))),
-            (3, xp[nbr[:, 3], :, BS - 1, :], (slice(None), slice(1, -1),
-                                              0, slice(1, -1))),
-            (4, xp[nbr[:, 4], :, :, 0], (slice(None), slice(1, -1),
-                                         slice(1, -1), BS + 1)),
-            (5, xp[nbr[:, 5], :, :, BS - 1], (slice(None), slice(1, -1),
-                                              slice(1, -1), 0)),
-        ]
-        for fidx, vals_f, dst in face_src:
-            have = (nbr[:, fidx] < m)[:, None, None]
-            if dirichlet is not None:
-                fill = jnp.where(have, vals_f, dirichlet[:, fidx])
-            else:
-                fill = jnp.where(have, vals_f, 0.0)
-            H = H.at[dst].set(fill)
-        return H
-
-    def lap_band(x, dirichlet=None):
-        H = haloed(x, dirichlet)
-        c = H[:, 1:-1, 1:-1, 1:-1]
-        return (H[:, 2:, 1:-1, 1:-1] + H[:, :-2, 1:-1, 1:-1]
-                + H[:, 1:-1, 2:, 1:-1] + H[:, 1:-1, :-2, 1:-1]
-                + H[:, 1:-1, 1:-1, 2:] + H[:, 1:-1, 1:-1, :-2]
-                - 6.0 * c)
-
-    def div_band(Vb):
-        out = jnp.zeros((m, BS, BS, BS), jnp.float32)
-        for axis in range(3):
-            H = haloed(Vb[..., axis])
-            sl = [slice(None), slice(1, -1), slice(1, -1), slice(1, -1)]
-            hi = list(sl)
-            lo = list(sl)
-            hi[axis + 1] = slice(2, None)
-            lo[axis + 1] = slice(0, -2)
-            out = out + 0.5 * (H[tuple(hi)] - H[tuple(lo)])
-        return out
-
-    rhs = div_band(V)
+    rhs = _div_band_flat(V, nbr)
 
     wmean = jnp.sum(density) / jnp.maximum(
         jnp.sum((density > 0).astype(jnp.float32)), 1.0)
     W = screen * density / jnp.maximum(wmean, 1e-12)
+
+    return (rhs, W, nbr, block_valid, block_coords, density,
+            flat, w, cfound, origin, scale, n_blocks)
+
+
+@functools.partial(jax.jit, static_argnames=("coarse_resolution",
+                                             "coarse_iters", "resolution"))
+def _prolong_sparse(points, normals, valid, rhs, nbr, block_valid,
+                    block_coords, screen, resolution: int,
+                    coarse_resolution: int, coarse_iters: int):
+    """Coarse dense solve + its prolongation onto the band: the CG seed
+    ``x0`` and the Dirichlet-halo-folded RHS ``b``."""
+    R = resolution
+    coarse = dense_poisson._solve(points, normals, valid, coarse_resolution,
+                                  coarse_iters, screen)
+    c_ratio = (coarse_resolution - 1.0) / (R - 1.0)
+    units = jnp.asarray([[1, 0, 0], [-1, 0, 0], [0, 1, 0],
+                         [0, -1, 0], [0, 0, 1], [0, 0, -1]], jnp.int32)
 
     # Voxel centers of every brick voxel, in fine grid coords.
     vox = jnp.arange(BS, dtype=jnp.int32)
@@ -236,18 +291,21 @@ def _solve_sparse(points, normals, valid, resolution: int, max_blocks: int,
         """Trilinear sample of the coarse chi at fine-grid coords, chunked:
         a flat gather would materialize (M·8³, 8, 3) corner-index tensors —
         tens of GB at a 10⁵-block band."""
-        flat = coords_xyz.reshape(-1, 3)
-        rows = flat.shape[0]
+        flat_c = coords_xyz.reshape(-1, 3)
+        rows = flat_c.shape[0]
         chunk = 1 << 21
         pad = (-rows) % chunk
         if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad, 3), flat.dtype)])
-        parts = flat.reshape(-1, chunk, 3)
-        vals = jax.lax.map(
+            flat_c = jnp.concatenate(
+                [flat_c, jnp.zeros((pad, 3), flat_c.dtype)])
+        parts = flat_c.reshape(-1, chunk, 3)
+        vals_c = jax.lax.map(
             lambda c: dense_poisson.gather(coarse.chi, c * c_ratio), parts)
-        return vals.reshape(-1)[:rows].reshape(coords_xyz.shape[:-1])
+        return vals_c.reshape(-1)[:rows].reshape(coords_xyz.shape[:-1])
 
-    x0 = jnp.where(block_valid[:, None, None, None], prolong(vox_xyz), 0.0)
+    m = block_coords.shape[0]
+    x0 = jnp.where(block_valid[:, None],
+                   prolong(vox_xyz).reshape(m, BS ** 3), 0.0)
 
     # Dirichlet halo values for chi at absent-neighbor faces (the halo
     # voxel = face voxel + unit step, prolonged from the coarse solution).
@@ -258,22 +316,30 @@ def _solve_sparse(points, normals, valid, resolution: int, max_blocks: int,
         sl[ax + 1] = BS - 1 if fidx % 2 == 0 else 0
         fc = vox_xyz[tuple(sl)]                            # (M, 8, 8, 3)
         face_coords.append(fc + units[fidx].astype(jnp.float32))
-    dir_chi = jnp.stack([prolong(fc) for fc in face_coords], 1)  # (M,6,8,8)
-    dir_chi = jnp.where(block_valid[:, None, None, None], dir_chi, 0.0)
+    dir_chi = jnp.stack(
+        [prolong(fc).reshape(m, BS * BS) for fc in face_coords], 1)
+    dir_chi = jnp.where(block_valid[:, None, None], dir_chi, 0.0)
 
     # Fold the constant Dirichlet halo into the RHS once:
     #   A(x; halo) = A0(x) + L_halo  ⇒  solve A0 x = b − L_halo.
-    halo_term = lap_band(jnp.zeros_like(x0), dirichlet=dir_chi)
-
-    def A0(x):
-        return lap_band(x) - W * x
-
-    band = block_valid[:, None, None, None]
-
-    def matvec(x):
-        return jnp.where(band, -(A0(x)), 0.0)
-
+    halo_term = _lap_band_flat(jnp.zeros_like(x0), nbr, dirichlet=dir_chi)
+    band = block_valid[:, None]
     b = jnp.where(band, -(rhs - halo_term), 0.0)
+    return b, x0
+
+
+@functools.partial(jax.jit, static_argnames=("cg_iters",))
+def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int):
+    """All CG state is FLAT (M, BS³): the fori_loop carry materializes
+    with the buffer layout, and a (…,8,8,8) carry pads 16× under the
+    (8,128) tile — the 16 GB allocation that originally OOM'd this
+    solve."""
+    band = block_valid[:, None]
+
+    def matvec(xf):
+        out = _lap_band_flat(xf, nbr) - W * xf
+        return jnp.where(band, -out, 0.0)
+
     r0 = b - matvec(x0)
     p0 = r0
     rs0 = jnp.vdot(r0, r0)
@@ -290,21 +356,19 @@ def _solve_sparse(points, normals, valid, resolution: int, max_blocks: int,
         return x, r, p, rs_new
 
     chi, _, _, _ = jax.lax.fori_loop(0, cg_iters, body, (x0, r0, p0, rs0))
-    chi = jnp.where(band, chi, 0.0)
+    return jnp.where(band, chi, 0.0)  # (M, BS³) flat
 
-    # Iso level: density-weighted mean of chi at the samples, gathered
-    # from the bricks (8 trilinear corners per sample).
+
+@jax.jit
+def _iso_sparse(chi, density, flat, w, cfound, valid):
+    """Density-weighted mean of chi at the samples (8 trilinear corners
+    per sample, gathered from the bricks)."""
     cflat = chi.reshape(-1)
     dflat = density.reshape(-1)
     ok8 = cfound & valid[:, None]
-    w8 = w  # already masked by validity & found
-    chi_pts = jnp.sum(jnp.where(ok8, cflat[flat], 0.0) * w8, axis=1)
-    den_pts = jnp.sum(jnp.where(ok8, dflat[flat], 0.0) * w8, axis=1)
-    iso = jnp.sum(chi_pts * den_pts) / jnp.maximum(
-        jnp.sum(den_pts), 1e-12)
-
-    return SparsePoissonGrid(chi, density, block_coords, block_valid,
-                             iso, origin, scale, R), n_blocks
+    chi_pts = jnp.sum(jnp.where(ok8, cflat[flat], 0.0) * w, axis=1)
+    den_pts = jnp.sum(jnp.where(ok8, dflat[flat], 0.0) * w, axis=1)
+    return jnp.sum(chi_pts * den_pts) / jnp.maximum(jnp.sum(den_pts), 1e-12)
 
 
 def reconstruct_sparse(points, normals, valid=None, depth: int = 10,
@@ -329,7 +393,15 @@ def reconstruct_sparse(points, normals, valid=None, depth: int = 10,
     normals = jnp.asarray(normals, jnp.float32)
     if valid is None:
         valid = jnp.ones(points.shape[0], dtype=bool)
-    grid, n_blocks = _solve_sparse(
-        points, normals, valid, 2 ** depth, max_blocks, cg_iters,
-        jnp.float32(screen), 2 ** min(coarse_depth, depth), coarse_iters)
+    (rhs, W, nbr, block_valid, block_coords, density,
+     flat, w, cfound, origin, scale, n_blocks) = _setup_sparse(
+        points, normals, valid, 2 ** depth, max_blocks,
+        jnp.float32(screen))
+    b, x0 = _prolong_sparse(points, normals, valid, rhs, nbr, block_valid,
+                            block_coords, jnp.float32(screen), 2 ** depth,
+                            2 ** min(coarse_depth, depth), coarse_iters)
+    chi = _cg_sparse(b, W, x0, nbr, block_valid, cg_iters)
+    iso = _iso_sparse(chi, density, flat, w, cfound, valid)
+    grid = SparsePoissonGrid(chi, density, block_coords, block_valid,
+                             iso, origin, scale, 2 ** depth)
     return grid, n_blocks

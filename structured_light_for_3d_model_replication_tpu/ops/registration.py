@@ -424,10 +424,12 @@ def icp(
         # fast_dots: 3-pass bf16 distance matmuls (≈ fp32 accuracy) — a
         # k=1 correspondence tolerates the residual error (a swap only
         # ever lands on a near-equidistant point), and the distance sweep
-        # is ICP's measured wall-clock floor.
+        # is ICP's measured wall-clock floor. The tile adapts down so a
+        # small cloud doesn't pad its queries 4× per iteration.
         d2, idx, nbv = knn(dst_pts, 1, queries=moved,
                            points_valid=dst_valid, queries_valid=src_valid,
-                           q_tile=4096, fast_dots=True)
+                           q_tile=min(4096, max(256, src_pts.shape[0])),
+                           fast_dots=True)
         ok = nbv[:, 0] & (d2[:, 0] <= md2 * m2)
         return moved, idx[:, 0], ok, d2[:, 0]
 

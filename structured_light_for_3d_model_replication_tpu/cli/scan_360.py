@@ -66,7 +66,7 @@ def main(argv=None) -> int:
         # folder name: "<base>_<deg>deg_AUTO" (`server/gui.py:703-740`).
         import re
 
-        m = re.search(r"_([0-9.]+)deg_AUTO$",
+        m = re.search(r"_(\d+(?:\.\d+)?)deg_AUTO$",
                       os.path.basename(os.path.normpath(args.input)))
         if m:
             step_deg = float(m.group(1))

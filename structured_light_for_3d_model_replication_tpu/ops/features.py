@@ -66,8 +66,11 @@ def fpfh(
     pair_ok = nbv & (d2 <= radius * radius) & (idx != own) \
         & valid[idx] & valid[:, None]                       # (N, K)
 
-    q = pts[idx]                    # (N, K, 3) neighbor positions
-    nt = nrm[idx]                   # (N, K, 3) neighbor normals
+    # ONE gather for positions+normals (random gathers are the measured
+    # cost of this op on TPU; interleaving halves the gather row count).
+    pn = jnp.concatenate([pts, nrm], axis=1)[idx]   # (N, K, 6)
+    q = pn[..., :3]                 # (N, K, 3) neighbor positions
+    nt = pn[..., 3:]                # (N, K, 3) neighbor normals
     dvec = q - pts[:, None, :]
     dist = jnp.sqrt(jnp.maximum(jnp.sum(dvec * dvec, axis=-1), 1e-20))
     dn = dvec / dist[..., None]

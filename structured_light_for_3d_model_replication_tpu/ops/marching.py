@@ -209,8 +209,7 @@ def extract_sparse(grid, quantile_trim: float = 0.0) -> TriangleMesh:
     valid = np.asarray(grid.block_valid)
     # Brick fields arrive FLAT (M, BS³) — the TPU-tiling-friendly layout
     # (see SparsePoissonGrid) — and get their 3-D shape back on host.
-    bs3 = np.asarray(grid.chi).shape[-1]
-    bs_side = round(bs3 ** (1.0 / 3.0))
+    bs_side = round(grid.chi.shape[-1] ** (1.0 / 3.0))
     chi = np.asarray(grid.chi, np.float64)[valid].reshape(
         -1, bs_side, bs_side, bs_side)
     density = np.asarray(grid.density, np.float64)[valid].reshape(

@@ -28,11 +28,20 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
 def _strip_code(text: str, line_comment: str = "//") -> str:
-    """Remove string literals and comments (good enough for bracket
-    balancing; template literals are treated as plain strings)."""
+    """Remove string literals, comments and regex literals (good enough for
+    bracket balancing; template literals are treated as plain strings). A
+    ``/`` is a regex-literal opener, not division, when the last code
+    character before it can't end an expression (``=``, ``(``, ``,``,
+    ``return`` …) — that keeps a legitimately unbalanced ``/\\(/`` from
+    tripping the balance check."""
     out = []
+    tail = ""  # last few non-whitespace-trimmed chars — O(1) regex context
     i = 0
     n = len(text)
+    # Characters after which a `/` starts a regex literal (plus start of
+    # file / after keywords like return, handled below). `<`/`>` stay OUT:
+    # they would make JSX closing tags (`</div>`) parse as regexes.
+    regex_prefix = set("=([{,;:!&|?+-*%~^\n")
     while i < n:
         c = text[i]
         if c in "\"'`":
@@ -41,14 +50,50 @@ def _strip_code(text: str, line_comment: str = "//") -> str:
             while i < n and text[i] != q:
                 i += 2 if text[i] == "\\" else 1
             i += 1
+            # The literal leaves a value behind: a following `/` is
+            # division (keeps `<img src="x" />` out of the regex path).
+            tail = (tail + q)[-16:]
         elif text.startswith(line_comment, i):
             while i < n and text[i] != "\n":
                 i += 1
         elif text.startswith("/*", i):
             j = text.find("*/", i + 2)
             i = n if j < 0 else j + 2
+        elif c == "/":
+            prev_code = tail.rstrip()
+            prev_ch = prev_code[-1] if prev_code else "\n"
+            after_kw = re.search(r"(?:^|[^\w$])(return|typeof|case|in|of|"
+                                 r"instanceof|new|do|else|yield|await)$",
+                                 prev_code)
+            # `>` alone is NOT a regex prefix (JSX tags), but an arrow
+            # body is: `(s) => /x/.test(s)`.
+            after_arrow = prev_code.endswith("=>")
+            if (prev_ch in regex_prefix or after_kw or after_arrow
+                    or not prev_code):
+                # Regex literal: skip to the unescaped closing '/', honoring
+                # character classes where '/' needs no escape.
+                i += 1
+                in_class = False
+                while i < n and text[i] != "\n":
+                    ch = text[i]
+                    if ch == "\\":
+                        i += 2
+                        continue
+                    if ch == "[":
+                        in_class = True
+                    elif ch == "]":
+                        in_class = False
+                    elif ch == "/" and not in_class:
+                        i += 1
+                        break
+                    i += 1
+            else:
+                out.append(c)
+                tail = (tail + c)[-16:]
+                i += 1
         else:
             out.append(c)
+            tail = (tail + c)[-16:]
             i += 1
     return "".join(out)
 
@@ -113,6 +158,19 @@ def check_web() -> list[str]:
             if f.endswith((".ts", ".tsx")):
                 errs += _check_balance(os.path.join(dirpath, f))
     errs += _check_ts_imports(os.path.join(web, "src"))
+    # Protocol-capability contract: the client must keep the reference's
+    # camera enumeration/switch flow (`frotend/App.tsx:36-37,71-85,102`) —
+    # a phone with several rear lenses needs an explicit device pick.
+    app = os.path.join(web, "src", "App.tsx")
+    try:
+        src = open(app, encoding="utf-8").read()
+        for needle in ("enumerateDevices", "deviceId: { exact:",
+                       "videoinput"):
+            if needle not in src:
+                errs.append(f"web/src/App.tsx: missing camera-switch "
+                            f"capability marker {needle!r}")
+    except OSError as e:
+        errs.append(f"web/src/App.tsx: {e}")
     return errs
 
 

@@ -23,6 +23,7 @@ import React, {
 } from "react";
 import {
   CameraCaps,
+  CameraDevice,
   ConnectionState,
   DEFAULT_PRO,
   PollResponse,
@@ -52,6 +53,8 @@ export default function App() {
   const [pro, setPro] = useState<ProSettings>(DEFAULT_PRO);
   const [log, setLog] = useState<string[]>([]);
   const [captures, setCaptures] = useState(0);
+  const [devices, setDevices] = useState<CameraDevice[]>([]);
+  const [activeDeviceId, setActiveDeviceId] = useState<string>("");
 
   const addLog = useCallback((msg: string) => {
     setLog((l) => [
@@ -60,15 +63,50 @@ export default function App() {
     ]);
   }, []);
 
+  // ---- device enumeration ------------------------------------------------
+  // Like the reference (`frotend/App.tsx:71-85`): list every videoinput so
+  // a phone with several rear lenses can pick the right one. Labels are
+  // only populated once camera permission is granted, so this re-runs
+  // after the stream opens (and on the Rescan button).
+  const refreshDevices = useCallback(async () => {
+    try {
+      const all = await navigator.mediaDevices.enumerateDevices();
+      const cams: CameraDevice[] = all
+        .filter((d) => d.kind === "videoinput")
+        .map((d) => ({
+          deviceId: d.deviceId,
+          label: d.label || `Camera ${d.deviceId.slice(0, 5)}…`,
+        }));
+      setDevices(cams);
+    } catch (e) {
+      addLog(`enumerateDevices failed: ${e}`);
+    }
+  }, [addLog]);
+
   // ---- camera open -------------------------------------------------------
   useEffect(() => {
+    // The effect re-runs on camera switch; `cancelled` guards the async
+    // open so a stream resolving AFTER cleanup is stopped instead of
+    // leaking (mobile browsers hold the device until its tracks stop).
+    let cancelled = false;
     let stream: MediaStream | null = null;
     (async () => {
       try {
-        stream = await navigator.mediaDevices.getUserMedia({
-          video: { facingMode: "environment", ...TARGET },
+        // Explicit deviceId once the user picked one (`exact`, like the
+        // reference's constraint at frotend/App.tsx:102); first open
+        // falls back to the environment-facing default.
+        const video_c: MediaTrackConstraints = activeDeviceId
+          ? { deviceId: { exact: activeDeviceId }, ...TARGET }
+          : { facingMode: "environment", ...TARGET };
+        const s = await navigator.mediaDevices.getUserMedia({
+          video: video_c,
           audio: false,
         });
+        if (cancelled) {
+          s.getTracks().forEach((t) => t.stop());
+          return;
+        }
+        stream = s;
         const video = videoRef.current!;
         video.srcObject = stream;
         await video.play();
@@ -76,14 +114,18 @@ export default function App() {
         trackRef.current = track;
         const c = (track.getCapabilities?.() ?? {}) as CameraCaps;
         setCaps(c);
-        const s = track.getSettings();
-        addLog(`camera ${s.width}x${s.height}`);
+        const st = track.getSettings();
+        addLog(`camera ${st.width}x${st.height}`);
+        void refreshDevices(); // labels become visible post-permission
       } catch (e) {
-        addLog(`camera error: ${e}`);
+        if (!cancelled) addLog(`camera error: ${e}`);
       }
     })();
-    return () => stream?.getTracks().forEach((t) => t.stop());
-  }, [addLog]);
+    return () => {
+      cancelled = true;
+      stream?.getTracks().forEach((t) => t.stop());
+    };
+  }, [addLog, activeDeviceId, refreshDevices]);
 
   // ---- capture + upload --------------------------------------------------
   const handleCapture = useCallback(
@@ -223,6 +265,23 @@ export default function App() {
       <video ref={videoRef} playsInline muted />
       <canvas ref={canvasRef} style={{ display: "none" }} />
       <section className="controls">
+        <label className="camera-select">
+          Camera
+          <select
+            value={activeDeviceId}
+            onChange={(e) => setActiveDeviceId(e.target.value)}
+          >
+            <option value="">default (rear)</option>
+            {devices.map((d) => (
+              <option key={d.deviceId} value={d.deviceId}>
+                {d.label}
+              </option>
+            ))}
+          </select>
+          <button type="button" onClick={() => void refreshDevices()}>
+            Rescan
+          </button>
+        </label>
         <label>
           <input
             type="checkbox"

@@ -39,6 +39,14 @@ export const DEFAULT_PRO: ProSettings = {
   torch: false,
 };
 
+/** A selectable camera (`enumerateDevices` videoinput), like the
+ * reference's device list (`frotend/App.tsx:36-37,71-85`) — a phone with
+ * several rear lenses needs an explicit pick. */
+export interface CameraDevice {
+  deviceId: string;
+  label: string;
+}
+
 /** Capability ranges discovered from MediaStreamTrack.getCapabilities(). */
 export interface CapRange {
   min: number;

@@ -244,9 +244,8 @@ def _edge_body(params: MergeParams):
 
         def count_corr(T):
             moved = registration.transform_points(T, s_pts)
-            d2, _, nbv = knn(d_pts, 1, queries=moved, points_valid=d_val,
-                             queries_valid=s_val)
-            return jnp.sum(nbv[:, 0] & (d2[:, 0] <= (4.0 * v) ** 2))
+            idx, found, d2 = registration._nn1(moved, d_pts, d_val, s_val)
+            return jnp.sum(found & (d2 <= (4.0 * v) ** 2))
 
         counts = jax.vmap(count_corr)(cands)
         init = cands[jnp.argmax(counts)]
@@ -260,6 +259,9 @@ def _edge_body(params: MergeParams):
             max_iterations=it,
             method="point_to_plane",
             schedule=anneal,
+            # Early sweeps on every 4th point (see icp docstring): the
+            # correspondence sweep is the edge's wall-clock floor.
+            warmup_subsample=4,
         )
         info = registration.information_matrix(
             s_pts, d_pts, fine.transformation,
@@ -523,7 +525,8 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
     if loop_closure:
         loop_T, loop_info = Ts[n - 1], infos[n - 1]
         log.info("loop edge 0→%d fitness=%.3f", n - 1, fit_np[n - 1])
-    return (seq_T, seq_info, loop_T, loop_info, list(fit_np[: n - 1]))
+    return (seq_T, seq_info, loop_T, loop_info, list(fit_np[: n - 1]),
+            list(rmse_np[: n - 1]))
 
 
 # ---------------------------------------------------------------------------
@@ -617,7 +620,7 @@ def merge_pro_360(
     """
     params = params or MergeParams()
     padded = _Padded(clouds, max_points=params.max_points)
-    seq_T, _, _, _, _ = register_sequence(padded.reg_points, padded.reg_valid,
+    seq_T, _, _, _, _, _ = register_sequence(padded.reg_points, padded.reg_valid,
                                           params, loop_closure=False, key=key)
     poses = posegraph.chain_poses(seq_T)
     merged = _apply_poses_and_merge(padded, poses, params)
@@ -637,7 +640,7 @@ def merge_posegraph_360(
     """
     params = params or MergeParams()
     padded = _Padded(clouds, max_points=params.max_points)
-    seq_T, seq_info, loop_T, loop_info, _ = register_sequence(
+    seq_T, seq_info, loop_T, loop_info, _, _ = register_sequence(
         padded.reg_points, padded.reg_valid, params,
         loop_closure=params.loop_closure, key=key)
     graph = posegraph.build_360_graph(seq_T, seq_info, loop_T, loop_info)

@@ -113,6 +113,8 @@ def mesh_from_cloud(
 
     trim = quantile_trim if mode == "watertight" else max(quantile_trim, 0.25)
     if int(depth) > 8:
+        # Block-budget overflow (→ dropped blocks → holes) is detected and
+        # handled INSIDE reconstruct_sparse before the solve runs.
         grid, n_blocks = poisson_sparse.reconstruct_sparse(
             pts, normals, depth=int(depth), cg_iters=cg_iters)
         log.info("sparse Poisson depth=%d: %d active blocks", int(depth),

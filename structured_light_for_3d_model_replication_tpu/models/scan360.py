@@ -76,9 +76,9 @@ class Scan360Params:
     # fusion temporaries — more than a v5e has). Chunking bounds memory at
     # chunk × per-stop while keeping dispatch overhead amortized.
     stop_chunk: int = 6
-    # Stops per dispatch in the per-view MERGE reduction — far lighter than
-    # decode (no per-pixel fusion temporaries), so it can run bigger chunks
-    # to cut launch count (each launch is a round trip on remote TPUs).
+    # Retained for config compatibility; the merge reduction no longer
+    # chunks (it transforms the pre-gathered per-stop subsample directly —
+    # see `_subsample_views_body`).
     reduce_chunk: int = 6
 
 
@@ -102,25 +102,64 @@ def _decode_scan_fn(col_bits: int, row_bits: int, decode_cfg, tri_cfg,
     return run
 
 
-@functools.lru_cache(maxsize=None)
-def _reduce_views_fn(view_cap: int):
-    """Per-view reduction (transform → stratified decimation into view_cap
-    slots) as ONE jitted vmapped program — a bare ``jax.vmap`` would
-    dispatch every inner op eagerly, paying a device round trip each
-    (ruinous on a remote TPU).
+def _subsample_views_body(view_cap: int, m_reg: int):
+    """ONE stratified pass per stop feeding BOTH downstream consumers:
+    the merge view gathers ``view_cap`` slots, and the registration view
+    resamples those uniformly down to ``m_reg``
+    (stratified-of-stratified = stratified). Running the cumsum +
+    binary-search machinery once instead of twice per stop was ~190 ms of
+    the fused 360 program (XProf searchsorted gathers).
 
     Deliberately NO per-view voxel downsample: ``_finalize`` voxel-dedups
-    the concatenation globally anyway, and a per-view pass would sort every
-    view's full 2M-pixel cloud (3 sort passes each — it dominated the whole
-    merge stage). The stratified decimation is a cumsum + binary search:
-    no sort at all."""
+    the concatenation globally anyway, and a per-view pass would sort
+    every view's full 2M-pixel cloud (3 sort passes each — it dominated
+    the whole merge stage in round 1)."""
 
-    def reduce_view(pose, pts, colors, valid):
-        moved = registration.transform_points(pose, pts)
-        return pointcloud.stratified_subsample(
-            moved, view_cap, valid=valid, attrs=colors.astype(jnp.float32))
+    def run(pts, cols, vals):
+        sub_idx, sub_val = jax.vmap(
+            lambda v: pointcloud.stratified_indices(v, view_cap))(vals)
+        sub_pts = jnp.where(
+            sub_val[..., None],
+            jnp.take_along_axis(pts, sub_idx[..., None], axis=1), 0.0)
+        sub_col = jnp.where(
+            sub_val[..., None],
+            jnp.take_along_axis(cols, sub_idx[..., None], axis=1),
+            0.0).astype(jnp.float32)
+        if view_cap >= m_reg:
+            # Uniform resample of the VALID prefix of the gathered slots
+            # (they pack at the front): stride by each stop's own valid
+            # count, not by view_cap — a stop with fewer valid points than
+            # view_cap would otherwise land most registration slots on
+            # invalid padding. Float stride like stratified_indices (an
+            # int product can overflow int32 at 4K sizes); ≤ m_reg valid
+            # points keep identity slots (masked by sub_val).
+            nv = jnp.sum(sub_val.astype(jnp.int32), axis=1)     # (N,)
+            j = jnp.arange(m_reg, dtype=jnp.int32)
+            stridef = nv.astype(jnp.float32)[:, None] / float(m_reg)
+            rj = jnp.floor(j[None, :].astype(jnp.float32)
+                           * stridef).astype(jnp.int32)
+            rj = jnp.where(nv[:, None] > m_reg, rj,
+                           jnp.minimum(j[None, :], view_cap - 1))
+            rj = jnp.clip(rj, 0, view_cap - 1)
+            reg_pts = jnp.take_along_axis(sub_pts, rj[..., None], axis=1)
+            reg_val = jnp.take_along_axis(sub_val, rj, axis=1)
+        else:  # unusual config: merge view smaller than registration view
+            reg_pts, _, reg_val = jax.vmap(
+                lambda p, v: pointcloud.stratified_subsample(
+                    p, m_reg, valid=v))(pts, vals)
+        return sub_pts, sub_col, sub_val, reg_pts, reg_val
 
-    return jax.jit(jax.vmap(reduce_view))
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _subsample_views_fn(view_cap: int, m_reg: int):
+    return jax.jit(_subsample_views_body(view_cap, m_reg))
+
+
+@functools.lru_cache(maxsize=None)
+def _transform_views_fn():
+    return jax.jit(jax.vmap(registration.transform_points))
 
 
 @functools.lru_cache(maxsize=None)
@@ -142,8 +181,6 @@ def _fused_fn(params: Scan360Params, decode_cfg, tri_cfg,
     mp = params.merge
     chunk = max(1, min(params.stop_chunk, n))
     n_pad = ((n + chunk - 1) // chunk) * chunk
-    rchunk = max(1, min(params.reduce_chunk, n))
-    rn_pad = ((n + rchunk - 1) // rchunk) * rchunk
     loop = params.method == "posegraph" and mp.loop_closure
     ring = merge_mod._ring_body(mp, n, loop)
     recon = pipeline_mod.reconstruct_batch_fn(col_bits, row_bits, decode_cfg,
@@ -165,11 +202,13 @@ def _fused_fn(params: Scan360Params, decode_cfg, tri_cfg,
         vals = vals.reshape(n_pad, -1)[:n]
         p_count = pts.shape[1]
 
-        # Registration view: fixed-size stratified subsample per stop.
+        # Shared subsample structure (see `_subsample_views_body` — the
+        # loop strategies use the SAME traced body, so the paths cannot
+        # diverge).
+        vc = min(view_cap, p_count)
         mr = min(m_reg, p_count)
-        reg_pts, _, reg_val = jax.vmap(
-            lambda p, v: pointcloud.stratified_subsample(p, mr, valid=v)
-        )(pts, vals)
+        sub_pts, sub_col, sub_val, reg_pts, reg_val = _subsample_views_body(
+            vc, mr)(pts, cols, vals)
 
         keys = jax.random.split(key, n)
         Ts, fit, rmse, infos = ring(reg_pts, reg_val, keys)
@@ -184,38 +223,14 @@ def _fused_fn(params: Scan360Params, decode_cfg, tri_cfg,
             poses = posegraph.chain_poses(Ts[: n - 1])
         poses_f = poses.astype(jnp.float32)
 
-        # Per-view reduce (transform + stratified decimation) in rchunk
-        # chunks under one lax.scan; stop-axis padding uses zeroed stops
-        # (all-False valid contributes nothing).
-        vc = min(view_cap, p_count)
-
-        def pad_stops(a):
-            if rn_pad == n:
-                return a
-            return jnp.concatenate(
-                [a, jnp.zeros((rn_pad - n,) + a.shape[1:], a.dtype)])
-
-        rp, rc, rv = pad_stops(pts), pad_stops(cols), pad_stops(vals)
-        pp = jnp.concatenate(
-            [poses_f, jnp.broadcast_to(jnp.eye(4), (rn_pad - n, 4, 4))]
-        ) if rn_pad != n else poses_f
-
-        def reduce_view(pose, p, c, v):
-            moved = registration.transform_points(pose, p)
-            return pointcloud.stratified_subsample(
-                moved, vc, valid=v, attrs=c.astype(jnp.float32))
-
-        def red_body(carry, xs):
-            return carry, jax.vmap(reduce_view)(*xs)
-
-        _, (vpts, vcol, vval) = jax.lax.scan(red_body, 0, (
-            pp.reshape(rn_pad // rchunk, rchunk, 4, 4),
-            rp.reshape(rn_pad // rchunk, rchunk, p_count, 3),
-            rc.reshape(rn_pad // rchunk, rchunk, p_count, 3),
-            rv.reshape(rn_pad // rchunk, rchunk, p_count)))
-        flat_pts = vpts.reshape(rn_pad, vc, 3)[:n].reshape(-1, 3)
-        flat_col = vcol.reshape(rn_pad, vc, 3)[:n].reshape(-1, 3)
-        flat_val = vval.reshape(rn_pad, vc)[:n].reshape(-1)
+        # Per-view reduce: the subsample is already gathered, so the merge
+        # contribution is just the pose transform of (n, vc, 3) points
+        # (transform commutes with the gather — no per-chunk scan, no
+        # second stratified pass over the full 2M-pixel clouds).
+        moved = jax.vmap(registration.transform_points)(poses_f, sub_pts)
+        flat_pts = moved.reshape(-1, 3)
+        flat_col = sub_col.reshape(-1, 3)
+        flat_val = sub_val.reshape(-1)
 
         # Final cleanup chain (`server/processing.py:171-181`) — the SAME
         # traced body as merge._finalize_fn, so fused and standalone paths
@@ -236,6 +251,7 @@ def scan_stacks_to_cloud(
     decode_cfg: DecodeConfig = DecodeConfig(),
     tri_cfg: TriangulationConfig = TriangulationConfig(),
     key=None,
+    with_stats: bool = False,
 ):
     """(N, F, H, W) uint8 capture stacks → (merged PointCloud, poses (N,4,4)).
 
@@ -248,6 +264,11 @@ def scan_stacks_to_cloud(
     rotation step), which is what the ring registration chain relies on —
     same assumption as the reference's numeric filename sort
     (`Old/new360Merge.py:7-20`).
+
+    ``with_stats`` appends a third return value: a dict with per-edge
+    registration quality (``{"edges": [{src, dst, fitness, rmse}, ...]}``)
+    so callers (bench telemetry, quality guards) can attribute ring
+    regressions to specific edges.
     """
     if params.method not in ("sequential", "posegraph"):
         raise ValueError(f"method must be 'sequential' or 'posegraph', "
@@ -262,7 +283,7 @@ def scan_stacks_to_cloud(
 
     if params.fused and not isinstance(stacks, np.ndarray):
         return _run_fused(stacks, calib, col_bits, row_bits, params,
-                          decode_cfg, tri_cfg, key)
+                          decode_cfg, tri_cfg, key, with_stats=with_stats)
 
     # 1. Decode + triangulate every stop, chunked (see ``stop_chunk``). Only
     # the dense outputs actually needed downstream (points/colors/valid) are
@@ -311,19 +332,21 @@ def scan_stacks_to_cloud(
                 jnp.concatenate(val_p)[:n], None, None)
             del pts_p, col_p, val_p
 
-    # 2. Fixed-size registration view of each stop (device-side). Clamped to
-    # the slot count: a small camera may have fewer pixels than the cap
-    # (top_k needs m ≤ n).
+    # 2. ONE stratified pass per stop feeds BOTH the registration view and
+    # the merge reduce (same structure as the fused path, `_fused_fn`, so
+    # the two cannot diverge): view_cap slots gathered once, registration
+    # view strided down to m_reg.
     m_reg = min(merge_mod._round_up(mp.max_points), res.points.shape[1])
+    view_cap = merge_mod._round_up(min(params.view_cap, res.points.shape[1]))
     with trace.span("scan360.subsample", m=m_reg):
-        reg_pts, _, reg_val = jax.vmap(
-            lambda p, v: pointcloud.stratified_subsample(p, m_reg, valid=v)
-        )(res.points, res.valid)
+        sub_pts, sub_col, sub_val, reg_pts, reg_val = _subsample_views_fn(
+            view_cap, m_reg)(res.points, res.colors, res.valid)
 
     # 3. Ring registration → per-stop poses.
     loop = params.method == "posegraph" and mp.loop_closure
     with trace.span("scan360.register", edges=n - 1 + int(loop)):
-        seq_T, seq_info, loop_T, loop_info, _ = merge_mod.register_sequence(
+        (seq_T, seq_info, loop_T, loop_info, edge_fit,
+         edge_rmse) = merge_mod.register_sequence(
             reg_pts, reg_val, mp, loop_closure=loop, key=key,
             strategy=params.ring_strategy)
         if params.method == "posegraph":
@@ -334,49 +357,41 @@ def scan_stacks_to_cloud(
         else:
             poses = posegraph.chain_poses(seq_T)
 
-    # 4. Merge the FULL-resolution clouds under the poses. Each stop is
-    # first reduced per-view (transform + stratified decimation into
-    # view_cap static slots; the global voxel dedup happens in _finalize),
-    # then the final cleanup chain runs on the concatenation.
-    view_cap = merge_mod._round_up(min(params.view_cap, res.points.shape[1]))
-    reduce_views = _reduce_views_fn(view_cap)
+    # 4. Merge under the poses: the per-stop subsample is already gathered
+    # (stage 2), so the merge contribution is just the pose transform of
+    # (N, view_cap, 3) points; the global voxel dedup happens in
+    # _finalize.
     poses_f = jnp.asarray(poses, jnp.float32)
     with trace.span("scan360.merge", view_cap=view_cap):
-        # Same chunk-shape discipline as stage 1 (pad the stop axis with
-        # zeroed stops — all-False valid masks contribute nothing — slice
-        # after), but with its own, larger chunk: the reduction holds no
-        # per-pixel fusion temporaries.
-        rchunk = max(1, min(params.reduce_chunk, n))
-        rn_pad = ((n + rchunk - 1) // rchunk) * rchunk
-
-        def pad_stops(a):
-            if rn_pad == n:
-                return a
-            zeros = jnp.zeros((rn_pad - n,) + a.shape[1:], a.dtype)
-            return jnp.concatenate([a, zeros])
-
-        rp, rc, rv = (pad_stops(res.points), pad_stops(res.colors),
-                      pad_stops(res.valid))
-        pp = jnp.concatenate(
-            [poses_f, jnp.broadcast_to(jnp.eye(4), (rn_pad - n, 4, 4))]
-        ) if rn_pad != n else poses_f
-        vparts = []
-        for s in range(0, rn_pad, rchunk):
-            e = s + rchunk
-            vparts.append(reduce_views(pp[s:e], rp[s:e], rc[s:e], rv[s:e]))
-        vpts = jnp.concatenate([p for p, _, _ in vparts])[:n]
-        vcol = jnp.concatenate([c for _, c, _ in vparts])[:n]
-        vval = jnp.concatenate([v for _, _, v in vparts])[:n]
+        moved = _transform_views_fn()(poses_f, sub_pts)
         merged = merge_mod._finalize(
-            vpts.reshape(-1, 3), vcol.reshape(-1, 3), vval.reshape(-1), mp,
-            has_colors=True)
+            moved.reshape(-1, 3), sub_col.reshape(-1, 3),
+            sub_val.reshape(-1), mp, has_colors=True)
     log.info("scan_stacks_to_cloud: %d stops → %d points (%s)", n,
              len(merged), params.method)
+    if with_stats:
+        return merged, np.asarray(poses), _edge_stats(
+            n, np.asarray(edge_fit), np.asarray(edge_rmse))
     return merged, np.asarray(poses)
 
 
+def _edge_stats(n: int, fit: np.ndarray, rmse: np.ndarray) -> dict:
+    """Per-edge registration-quality telemetry (edge i maps stop src→dst,
+    the ring ordering of `merge._ring_edge_indices`)."""
+    edges = []
+    for i in range(fit.shape[0]):
+        src, dst = (i + 1, i) if i < n - 1 else (0, n - 1)  # loop edge last
+        edges.append({"src": src, "dst": dst,
+                      "fitness": round(float(fit[i]), 4),
+                      "rmse": round(float(rmse[i]), 4)})
+    fits = [e["fitness"] for e in edges]
+    return {"edges": edges,
+            "min_fitness": min(fits) if fits else None,
+            "mean_fitness": round(float(np.mean(fits)), 4) if fits else None}
+
+
 def _run_fused(stacks, calib, col_bits, row_bits, params, decode_cfg,
-               tri_cfg, key):
+               tri_cfg, key, with_stats: bool = False):
     """Dispatch the one-launch fused program and compact the result on host
     (the single sync of the whole pipeline)."""
     n = stacks.shape[0]
@@ -405,6 +420,8 @@ def _run_fused(stacks, calib, col_bits, row_bits, params, decode_cfg,
         normals=normals[keep])
     log.info("scan_stacks_to_cloud[fused]: %d stops → %d points (%s)", n,
              len(merged), params.method)
+    if with_stats:
+        return merged, np.asarray(poses), _edge_stats(n, fit, rmse)
     return merged, np.asarray(poses)
 
 

@@ -42,6 +42,9 @@ import jax
 import jax.numpy as jnp
 
 from .gridknn import _estimate_cell_size
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
 
 _BITS = 10
 _GRID_MAX = (1 << _BITS) - 1
@@ -184,7 +187,11 @@ def _brick_knn_impl(points, valid, k, slots, chunk_cells, exclude_self,
     out_d = jnp.zeros((n + 1, k), jnp.float32).at[rows].set(d)[:n]
     out_i = jnp.zeros((n + 1, k), jnp.int32).at[rows].set(i)[:n]
     out_v = jnp.zeros((n + 1, k), bool).at[rows].set(v)[:n]
-    return out_d, out_i, out_v
+    # Points lost to slot overflow or the cell budget report zero neighbors
+    # (out_v False); surface the count so precision-sensitive callers can
+    # see the truncation at runtime, not just in the docstring.
+    n_dropped = jnp.sum(val_s & ~ok)
+    return out_d, out_i, out_v, n_dropped
 
 
 def brick_knn(
@@ -218,6 +225,18 @@ def brick_knn(
     cc = min(chunk_cells, max(256, max_cells))
     if max_cells % cc:  # static chunking needs a divisor-friendly budget
         max_cells = ((max_cells + cc - 1) // cc) * cc
-    return _brick_knn_impl(points, points_valid, k, slots, cc,
-                           exclude_self, int(round(cell_scale * 100)),
-                           max_cells)
+    d, i, v, n_dropped = _brick_knn_impl(
+        points, points_valid, k, slots, cc, exclude_self,
+        int(round(cell_scale * 100)), max_cells)
+    # debug.callback: works under jit/vmap, async, fires only at runtime.
+    jax.debug.callback(_warn_dropped, n_dropped, n)
+    return d, i, v
+
+
+def _warn_dropped(n_dropped, n_total) -> None:
+    nd = int(n_dropped)
+    if nd > 0:
+        log.warning(
+            "brick_knn dropped %d/%d points (cell-slot overflow or cell "
+            "budget); they report zero neighbors — raise `slots`/"
+            "`max_cells` for full coverage", nd, int(n_total))

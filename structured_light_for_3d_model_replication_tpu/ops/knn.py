@@ -42,6 +42,23 @@ from ..utils.log import get_logger
 
 log = get_logger(__name__)
 
+# Packed single-block approx top-k (see `_knn_padded`): key index bits
+# embedded in the distance mantissa — bounds the key count it applies to.
+_PACK_BITS = 13
+
+
+def check_neighbors(neighbors, n: int, width: int) -> None:
+    """Validate a precomputed ``(d2, idx, nb_valid)`` sweep against its
+    consumer's cloud length and required column count. Undersized or
+    mismatched sweeps would silently truncate neighborhoods — fail loudly
+    at trace time instead."""
+    for a in neighbors:
+        shape = tuple(a.shape)
+        if len(shape) != 2 or shape[0] != n or shape[1] < width:
+            raise ValueError(
+                f"precomputed neighbors shape {shape} incompatible with "
+                f"cloud n={n}, required width={width}")
+
 
 def pad_points(points: jnp.ndarray, valid: jnp.ndarray | None, multiple: int):
     """Pad (N,D) points (+ valid mask) to a multiple; padding is invalid."""
@@ -86,9 +103,15 @@ def _knn_padded(
     fast_dots: bool = False,
 ):
     # 3-pass bf16 only where the hardware has the fast path; CPU executes
-    # plain fp32 anyway (and rejects some presets).
-    prec = (jax.lax.DotAlgorithmPreset.BF16_BF16_F32_X3
-            if fast_dots and jax.default_backend() in ("tpu", "axon")
+    # plain fp32 anyway (and rejects some presets). getattr fallback (on
+    # the class too — it shipped together with the preset): an older
+    # jaxlib degrades to HIGHEST instead of raising at trace time in
+    # every ICP call.
+    _preset = getattr(getattr(jax.lax, "DotAlgorithmPreset", None),
+                      "BF16_BF16_F32_X3", None)
+    prec = (_preset
+            if fast_dots and _preset is not None
+            and jax.default_backend() in ("tpu", "axon")
             else None)
     M, dim = queries.shape
     N = points.shape[0]
@@ -121,6 +144,32 @@ def _knn_padded(
             (bd, bi), _ = jax.lax.scan(
                 step, init, (key_blocks, key_valid, p2_blocks, base_idx))
             return bd[:, None], bi[:, None]
+
+        if approx and n_k_blocks == 1 and N <= (1 << _PACK_BITS):
+            # Single-block packed path: embed the key index in the low
+            # mantissa bits of the (nonnegative) squared distance, so the
+            # ENTIRE top-k — PartialReduce candidates + final ordering —
+            # runs on ONE operand. The generic path's aggregation sorts
+            # (value, index) pairs and reorders carried indices with
+            # take_along_axis gathers that XProf measured at ~400 ms per
+            # ring sweep (k=100); packing removes every index operand.
+            # Cost: distances quantized to ~2⁻¹⁰ relative (the low 13
+            # mantissa bits), irrelevant to the approx path's consumers
+            # (neighbor sets at recall ≈ 0.95, radius masks).
+            kp, kv, p2 = key_blocks[0], key_valid[0], p2_blocks[0]
+            d = jnp.maximum(_block_dists(q, q2, kp, kv, p2, prec), 0.0)
+            bits = jax.lax.bitcast_convert_type(d, jnp.int32)
+            mask = jnp.int32((1 << _PACK_BITS) - 1)
+            iota = jnp.arange(N, dtype=jnp.int32)
+            packed = jnp.where(jnp.isfinite(d),
+                               (bits & ~mask) | iota[None, :],
+                               bits)  # +inf keeps its exact bit pattern
+            fd = jax.lax.bitcast_convert_type(packed, jnp.float32)
+            cand, _ = jax.lax.approx_min_k(fd, k, aggregate_to_topk=False)
+            top = jnp.sort(cand, axis=-1)[:, :k]  # single-operand sort
+            tb = jax.lax.bitcast_convert_type(top, jnp.int32)
+            return (jax.lax.bitcast_convert_type(tb & ~mask, jnp.float32),
+                    tb & mask)
 
         if approx:
             # Per-block PartialReduce candidates, merged with a second

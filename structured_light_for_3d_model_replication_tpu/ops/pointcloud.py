@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from .brickknn import brick_knn
 from .gridknn import grid_knn
-from .knn import knn
+from .knn import check_neighbors, knn
 from .mortonknn import morton_knn
 
 # Above this many points, self-query neighborhoods route to the
@@ -225,6 +225,33 @@ def random_subsample(
 
 
 @functools.partial(jax.jit, static_argnames=("m",))
+def stratified_indices(valid: jnp.ndarray, m: int):
+    """Row indices + validity of the stratified subsample — the selection
+    half of :func:`stratified_subsample`, exposed so pipelines with
+    several consumers of the SAME subsample (registration view, merge
+    reduce) pay for the cumsum + binary search once and gather many
+    times."""
+    n = valid.shape[0]
+    rank = jnp.cumsum(valid.astype(jnp.int32))  # 1-based rank of each valid
+    n_valid = rank[-1]
+    j = jnp.arange(m, dtype=jnp.int32)
+    # Target ranks: stratified when n_valid > m, identity (+mask) otherwise.
+    # Computed as j·(n_valid/m) — NOT (j·n_valid)/m, whose product overflows
+    # fp32 grid at 4K-camera sizes — then repaired to be strictly
+    # increasing: in exact math t_j − j is nondecreasing, so a running max
+    # over it undoes any ±1 fp32 floor misround that would duplicate a rank.
+    stride = n_valid.astype(jnp.float32) / float(m)
+    t = jnp.floor(j.astype(jnp.float32) * stride).astype(jnp.int32) + 1
+    u = jax.lax.associative_scan(jnp.maximum, t - j)
+    t = jnp.minimum(u + j, jnp.maximum(n_valid, 1))
+    targets = jnp.where(n_valid > m, t, j + 1)
+    idx = jnp.searchsorted(rank, targets, side="left").astype(jnp.int32)
+    idx = jnp.minimum(idx, n - 1)
+    out_valid = j < jnp.minimum(n_valid, m)
+    return idx, out_valid
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
 def stratified_subsample(
     points: jnp.ndarray,
     m: int,
@@ -243,25 +270,9 @@ def stratified_subsample(
     rows. When fewer than ``m`` valid points exist every valid point is
     kept once (surplus slots masked), like random_subsample.
     """
-    n = points.shape[0]
     if valid is None:
-        valid = jnp.ones(n, dtype=bool)
-    rank = jnp.cumsum(valid.astype(jnp.int32))  # 1-based rank of each valid
-    n_valid = rank[-1]
-    j = jnp.arange(m, dtype=jnp.int32)
-    # Target ranks: stratified when n_valid > m, identity (+mask) otherwise.
-    # Computed as j·(n_valid/m) — NOT (j·n_valid)/m, whose product overflows
-    # fp32 grid at 4K-camera sizes — then repaired to be strictly
-    # increasing: in exact math t_j − j is nondecreasing, so a running max
-    # over it undoes any ±1 fp32 floor misround that would duplicate a rank.
-    stride = n_valid.astype(jnp.float32) / float(m)
-    t = jnp.floor(j.astype(jnp.float32) * stride).astype(jnp.int32) + 1
-    u = jax.lax.associative_scan(jnp.maximum, t - j)
-    t = jnp.minimum(u + j, jnp.maximum(n_valid, 1))
-    targets = jnp.where(n_valid > m, t, j + 1)
-    idx = jnp.searchsorted(rank, targets, side="left").astype(jnp.int32)
-    idx = jnp.minimum(idx, n - 1)
-    out_valid = j < jnp.minimum(n_valid, m)
+        valid = jnp.ones(points.shape[0], dtype=bool)
+    idx, out_valid = stratified_indices(valid, m)
     out_points = jnp.where(out_valid[:, None], points[idx], 0.0)
     out_attrs = None
     if attrs is not None:
@@ -336,6 +347,7 @@ def estimate_normals(
         valid = jnp.ones(n, dtype=bool)
     pts = jnp.asarray(points, jnp.float32)
     if neighbors is not None:
+        check_neighbors(neighbors, n, k)
         _, idx, nbv = (a[:, :k] for a in neighbors)
         # The sweep may have been built under a wider validity mask (the
         # shared-KNN pattern in merge._preprocess) — re-mask so invalid

@@ -31,7 +31,32 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from . import nn_pallas
 from .knn import knn
+
+
+def _nn1(moved, dst_pts, dst_valid, src_valid, table=None):
+    """k=1 correspondence sweep: the fused pallas running-argmin kernel on
+    TPU backends (ops/nn_pallas.py — the XLA path materializes the full
+    (M, N) distance field in HBM), the tiled-matmul KNN elsewhere.
+    Returns (idx (N,), found (N,), d2 (N,)) with d2 = +inf where no valid
+    key exists. ``table`` optionally reuses a precomputed
+    ``nn_pallas.key_table`` when the same keys are swept repeatedly."""
+    n = dst_pts.shape[0]
+    if nn_pallas.available() and n <= nn_pallas.max_keys():
+        if table is None:
+            table = nn_pallas.key_table(dst_pts, dst_valid)
+        d2, idx = nn_pallas.nearest_one(moved, *table)
+        found = jnp.isfinite(d2)
+        if src_valid is not None:
+            found = found & src_valid
+        return idx, found, jnp.where(jnp.isfinite(d2), d2, jnp.inf)
+    d2, idx, nbv = knn(dst_pts, 1, queries=moved,
+                       points_valid=dst_valid, queries_valid=src_valid,
+                       q_tile=min(4096, max(256, moved.shape[0])),
+                       fast_dots=True)
+    return (idx[:, 0], nbv[:, 0],
+            jnp.where(nbv[:, 0], d2[:, 0], jnp.inf))
 
 
 def transform_points(T: jnp.ndarray, pts: jnp.ndarray) -> jnp.ndarray:
@@ -203,6 +228,34 @@ class RegistrationResult(NamedTuple):
     inlier_rmse: jnp.ndarray
 
 
+def _triplet_rigid(s: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """Exact rigid transform from a 3-point correspondence via triangle
+    frames: R maps src's orthonormal triangle frame onto dst's.
+
+    RANSAC's hypothesis solver. For an exactly-rigid triplet this equals
+    the LS solution; for a noisy one it differs slightly from
+    :func:`kabsch` — irrelevant inside RANSAC, where every hypothesis is
+    judged by its inlier vote and the winner is re-solved with a
+    converged Kabsch on all inliers. What matters is cost: ~40 flops and
+    a short dependency chain, vs the unrolled 4×4 power-iteration chain
+    that was the latency floor of every vmapped hypothesis batch.
+    Degenerate (near-collinear) triplets produce garbage rotations that
+    lose the vote, exactly like a degenerate Kabsch sample."""
+    def frame(p):
+        u = p[1] - p[0]
+        v = p[2] - p[0]
+        e1 = u / jnp.maximum(jnp.linalg.norm(u), 1e-12)
+        w = v - jnp.dot(v, e1) * e1
+        e2 = w / jnp.maximum(jnp.linalg.norm(w), 1e-12)
+        e3 = jnp.cross(e1, e2)
+        return jnp.stack([e1, e2, e3], axis=1)         # columns
+    hi = jax.lax.Precision.HIGHEST
+    R = jnp.matmul(frame(d), frame(s).T, precision=hi)
+    t = jnp.mean(d, axis=0) - jnp.matmul(R, jnp.mean(s, axis=0),
+                                         precision=hi)
+    return _assemble_rigid(R, t)
+
+
 # ---------------------------------------------------------------------------
 # Global registration: feature matching + vmapped RANSAC
 # ---------------------------------------------------------------------------
@@ -221,13 +274,19 @@ def match_features(
     that are each other's nearest neighbors — the reference passes
     mutual_filter=True (`server/processing.py:105`).
     """
+    # fast_dots: 3-pass bf16 for the 33-D feature distance matmuls — the
+    # k=1 match only flips between near-equidistant descriptors, and the
+    # HIGHEST-precision sweep (6-pass bf16) was half the measured
+    # feature-matching cost of every ring edge.
     _, idx_sd, v_sd = knn(dst_feat, 1, queries=src_feat,
-                          points_valid=dst_valid, queries_valid=src_valid)
+                          points_valid=dst_valid, queries_valid=src_valid,
+                          fast_dots=True)
     nn = idx_sd[:, 0]
     ok = v_sd[:, 0]
     if mutual:
         _, idx_ds, v_ds = knn(src_feat, 1, queries=dst_feat,
-                              points_valid=src_valid, queries_valid=dst_valid)
+                              points_valid=src_valid, queries_valid=dst_valid,
+                              fast_dots=True)
         back = idx_ds[:, 0][nn]
         ok = ok & v_ds[:, 0][nn] & (back == jnp.arange(src_feat.shape[0]))
     return nn, ok
@@ -261,8 +320,10 @@ def _ransac_core(
     # Hypothesis RANKING runs on a strided subset of the correspondences —
     # scoring 100k hypotheses against every point is >90% of RANSAC's FLOPs
     # and the ranking is statistically identical; the winner is re-scored
-    # and polished on the FULL set below.
-    sub = max(1, n // 1024)
+    # and polished on the FULL set below. 256 points still separate
+    # hypotheses by inlier count decisively (the margin between a correct
+    # and a wrong pose is ~a hundred inliers at typical inlier ratios).
+    sub = max(1, n // 256)
     sub_src = src_pts[::sub]
     sub_dst = dst_pts[corr_idx][::sub]
     sub_ok = corr_ok[::sub]
@@ -272,11 +333,21 @@ def _ransac_core(
         d2 = jnp.sum((moved - sub_dst) ** 2, axis=-1)
         return jnp.sum(sub_ok & (d2 <= distance_threshold**2))
 
+    # ONE packed sample table: (src | dst[corr] | ok) rows, so each
+    # hypothesis triplet is a single 7-wide gather instead of four chained
+    # gathers (src, corr_idx, corr_ok, dst) — the chained form was ~250 ms
+    # of every 100k-budget edge batch on TPU (XProf fusion.303/.305/.306/
+    # .311: row-gather overhead, not bytes).
+    tbl = jnp.concatenate(
+        [src_pts, dst_pts[corr_idx], corr_ok.astype(jnp.float32)[:, None]],
+        axis=1)                                            # (n, 7)
+
     def hypothesis(k):
         samp = jax.random.randint(k, (ransac_n,), 0, n)
-        s = src_pts[samp]
-        d = dst_pts[corr_idx[samp]]
-        ok = jnp.all(corr_ok[samp])
+        rows = tbl[samp]                                   # (ransac_n, 7)
+        s = rows[:, :3]
+        d = rows[:, 3:6]
+        ok = jnp.all(rows[:, 6] > 0.5)
         # Edge-length checker: every pairwise edge ratio within
         # [ratio, 1/ratio] (`CorrespondenceCheckerBasedOnEdgeLength(0.9)`).
         ii, jj = jnp.triu_indices(ransac_n, 1)
@@ -284,11 +355,12 @@ def _ransac_core(
         ed = jnp.linalg.norm(d[ii] - d[jj], axis=-1)
         ratio = jnp.minimum(es, ed) / jnp.maximum(jnp.maximum(es, ed), 1e-12)
         ok &= jnp.all(ratio >= edge_length_ratio)
-        # 12 power iterations, not the default 24: a 3-point hypothesis
-        # either converges fast or is junk the inlier vote discards — and
-        # the unrolled dependent-matvec chain is the latency floor of every
-        # RANSAC step (the winner is re-solved converged in the polish).
-        T = kabsch(s, d, power_iters=12)
+        # Triangle-frame solve (see _triplet_rigid): exact for rigid
+        # triplets at a fraction of a power-iteration Kabsch; the winner
+        # is re-solved converged in the polish. (Non-default sample sizes
+        # need the general LS solve.)
+        T = (_triplet_rigid(s, d) if ransac_n == 3
+             else kabsch(s, d, power_iters=12))
         # Distance checker on the sampled set.
         moved = transform_points(T, s)
         ok &= jnp.all(jnp.linalg.norm(moved - d, axis=-1)
@@ -331,10 +403,13 @@ def ransac_feature_registration(
     mutual: bool = True,
     edge_length_ratio: float = 0.9,
     num_iterations: int = 100_000,
-    # 2048 hypotheses per vmapped step: fewer, wider sequential steps (a
-    # 100k budget becomes ~49 steps instead of ~196 — the step chain, not
-    # the FLOPs, bounds RANSAC wall clock on TPU).
-    batch: int = 2048,
+    # 8192 hypotheses per vmapped step: fewer, wider sequential steps (a
+    # 100k budget becomes ~12 steps instead of ~196 at 512 — the step
+    # chain, not the FLOPs, bounds RANSAC wall clock on TPU: XProf showed
+    # ~15 ms/step of fixed dispatch+small-kernel latency at batch 2048,
+    # so quadrupling the batch quarters the sequential chain for the same
+    # hypothesis budget and negligible extra memory).
+    batch: int = 8192,
     ransac_n: int = 3,
     key=None,
 ) -> RegistrationResult:
@@ -368,7 +443,8 @@ def ransac_feature_registration(
 
 
 @functools.partial(jax.jit, static_argnames=("max_iterations", "method",
-                                             "schedule"))
+                                             "schedule",
+                                             "warmup_subsample"))
 def icp(
     src_pts: jnp.ndarray,
     dst_pts: jnp.ndarray,
@@ -380,6 +456,7 @@ def icp(
     max_iterations: int = 30,
     method: str = "point_to_plane",
     schedule: tuple | None = None,
+    warmup_subsample: int = 1,
 ) -> RegistrationResult:
     """Iterative closest point, ``registration_icp`` semantics
     (`server/processing.py:154-156`: point-to-plane, seeded with the RANSAC
@@ -394,6 +471,13 @@ def icp(
     annealing that converges from rough initializations where a fixed
     tight radius finds zero correspondences and stalls. The final fitness/
     rmse are always evaluated at the base distance.
+
+    ``warmup_subsample`` > 1 runs the first 80% of iterations on every
+    S-th source point (≥ 8 iterations only): the early sweeps only need a
+    descent direction, and a 2048-point subset still overdetermines the 6
+    DoF ~300×; the last 20% and the final fitness/rmse always use the
+    full set. The correspondence sweep is ICP's measured wall-clock floor,
+    so this cuts it ~4× with no observable pose change (ring tests).
     """
     src_pts = jnp.asarray(src_pts, jnp.float32)
     dst_pts = jnp.asarray(dst_pts, jnp.float32)
@@ -415,42 +499,48 @@ def icp(
                              f"max_iterations {max_iterations}")
         mults = jnp.asarray(schedule, jnp.float32)
 
-    def correspondences(T, m2=1.0):
-        moved = transform_points(T, src_pts)
-        # Wide query tiles: at registration sizes (≤ 8k × 8k) the k=1
-        # sweep fits one or two tiles, and each tile is a sequential step
-        # in the per-iteration chain — 30 iterations × 8 narrow tiles was
-        # a measured chunk of ring wall clock.
-        # fast_dots: 3-pass bf16 distance matmuls (≈ fp32 accuracy) — a
-        # k=1 correspondence tolerates the residual error (a swap only
-        # ever lands on a near-equidistant point), and the distance sweep
-        # is ICP's measured wall-clock floor. The tile adapts down so a
-        # small cloud doesn't pad its queries 4× per iteration.
-        d2, idx, nbv = knn(dst_pts, 1, queries=moved,
-                           points_valid=dst_valid, queries_valid=src_valid,
-                           q_tile=min(4096, max(256, src_pts.shape[0])),
-                           fast_dots=True)
-        ok = nbv[:, 0] & (d2[:, 0] <= md2 * m2)
-        return moved, idx[:, 0], ok, d2[:, 0]
+    # The key side is constant across iterations: build the kernel table
+    # once (a transpose + squared norms), not per sweep.
+    table = (nn_pallas.key_table(dst_pts, dst_valid)
+             if nn_pallas.available()
+             and dst_pts.shape[0] <= nn_pallas.max_keys() else None)
 
-    def step(T, mult):
-        moved, idx, ok, _ = correspondences(T, mult * mult)
-        w = ok.astype(jnp.float32)
-        q = dst_pts[idx]
-        if method == "point_to_point":
-            dT = kabsch(moved, q, weights=w, ensure_converged=True)
-        else:
-            nq = dst_normals[idx]
-            r = jnp.sum((moved - q) * nq, axis=-1)          # (N,)
-            J = jnp.concatenate([jnp.cross(moved, nq), nq], axis=-1)  # (N,6)
-            A = jnp.einsum("ni,nj->ij", J * w[:, None], J, precision=hi)
-            b = -jnp.einsum("ni,n->i", J * w[:, None], r, precision=hi)
-            x = jnp.linalg.solve(A + 1e-9 * jnp.eye(6, dtype=A.dtype), b)
-            dT = exp_se3(x[:3], x[3:])
-        return jnp.matmul(dT, T, precision=hi), None
+    def correspondences(T, pts, valid, m2=1.0):
+        moved = transform_points(T, pts)
+        idx, found, d2 = _nn1(moved, dst_pts, dst_valid, valid, table)
+        ok = found & (d2 <= md2 * m2)
+        return moved, idx, ok, jnp.where(jnp.isfinite(d2), d2, 0.0)
 
-    T, _ = jax.lax.scan(step, init.astype(jnp.float32), mults)
-    _, idx, ok, d2 = correspondences(T)
+    def make_step(pts, valid):
+        def step(T, mult):
+            moved, idx, ok, _ = correspondences(T, pts, valid, mult * mult)
+            w = ok.astype(jnp.float32)
+            q = dst_pts[idx]
+            if method == "point_to_point":
+                dT = kabsch(moved, q, weights=w, ensure_converged=True)
+            else:
+                nq = dst_normals[idx]
+                r = jnp.sum((moved - q) * nq, axis=-1)      # (N,)
+                J = jnp.concatenate([jnp.cross(moved, nq), nq],
+                                    axis=-1)                # (N, 6)
+                A = jnp.einsum("ni,nj->ij", J * w[:, None], J, precision=hi)
+                b = -jnp.einsum("ni,n->i", J * w[:, None], r, precision=hi)
+                x = jnp.linalg.solve(A + 1e-9 * jnp.eye(6, dtype=A.dtype), b)
+                dT = exp_se3(x[:3], x[3:])
+            return jnp.matmul(dT, T, precision=hi), None
+        return step
+
+    T = init.astype(jnp.float32)
+    if warmup_subsample > 1 and max_iterations >= 8:
+        n_warm = int(round(0.8 * max_iterations))
+        T, _ = jax.lax.scan(
+            make_step(src_pts[::warmup_subsample],
+                      src_valid[::warmup_subsample]), T, mults[:n_warm])
+        T, _ = jax.lax.scan(make_step(src_pts, src_valid), T,
+                            mults[n_warm:])
+    else:
+        T, _ = jax.lax.scan(make_step(src_pts, src_valid), T, mults)
+    _, idx, ok, d2 = correspondences(T, src_pts, src_valid)
     cnt = jnp.sum(ok)
     fitness = cnt / jnp.maximum(jnp.sum(src_valid), 1)
     rmse = jnp.sqrt(jnp.sum(jnp.where(ok, d2, 0.0)) / jnp.maximum(cnt, 1))
@@ -478,10 +568,9 @@ def information_matrix(
     src_pts = jnp.asarray(src_pts, jnp.float32)
     dst_pts = jnp.asarray(dst_pts, jnp.float32)
     moved = transform_points(jnp.asarray(T, jnp.float32), src_pts)
-    d2, idx, nbv = knn(dst_pts, 1, queries=moved,
-                       points_valid=dst_valid, queries_valid=src_valid)
-    ok = nbv[:, 0] & (d2[:, 0] <= max_correspondence_distance**2)
-    q = dst_pts[idx[:, 0]]
+    idx, found, d2 = _nn1(moved, dst_pts, dst_valid, src_valid)
+    ok = found & (d2 <= max_correspondence_distance**2)
+    q = dst_pts[idx]
     J = jnp.concatenate([-skew(q), jnp.broadcast_to(jnp.eye(3), q.shape[:-1] + (3, 3))], axis=-1)  # (N, 3, 6)
     w = ok.astype(jnp.float32)[:, None, None]
     hi = jax.lax.Precision.HIGHEST

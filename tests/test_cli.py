@@ -76,6 +76,7 @@ def test_read_calib(session, capsys):
     assert "wPlaneCol" in text
 
 
+@pytest.mark.slow
 def test_scan_360_cli(session, tmp_path):
     root, mat = session
     out = tmp_path / "merged.ply"

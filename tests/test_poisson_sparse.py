@@ -27,6 +27,7 @@ def test_pack_unpack_roundtrip():
     assert np.array_equal(np.asarray(back), np.asarray(bc))
 
 
+@pytest.mark.slow
 def test_sparse_depth6_matches_dense(rng):
     pts, nrm = _sphere_cloud(rng, 20_000)
     dense_grid = poisson.reconstruct(pts, nrm, depth=6, cg_iters=150)
@@ -45,6 +46,7 @@ def test_sparse_depth6_matches_dense(rng):
     assert abs(r_d - r_s) < 1.0
 
 
+@pytest.mark.slow
 def test_sparse_depth10_sphere_surface_error(rng):
     """Depth 10 (1024³ virtual) at a scale the dense solver cannot touch:
     surface error bounded by a few fine voxels, memory bounded by the
@@ -85,6 +87,7 @@ def test_sparse_rejects_out_of_range_depth(rng):
         poisson_sparse.reconstruct_sparse(pts, nrm, depth=4)
 
 
+@pytest.mark.slow
 def test_meshing_routes_deep_depth_to_sparse(rng):
     from structured_light_for_3d_model_replication_tpu.io.ply import PointCloud
     from structured_light_for_3d_model_replication_tpu.models import meshing

@@ -105,6 +105,7 @@ FAST = scan360.Scan360Params(
 )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("method", ["sequential", "posegraph"])
 def test_scan_stacks_to_cloud(turntable_stacks, method):
     stacks, (cam_K, proj_K, R, T) = turntable_stacks
@@ -138,6 +139,7 @@ def test_scan_stacks_method_validation(turntable_stacks):
             params=scan360.Scan360Params(method="nope"))
 
 
+@pytest.mark.slow
 def test_decode_strategy_scan_matches_loop(turntable_stacks):
     stacks, (cam_K, proj_K, R, T) = turntable_stacks
     calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
@@ -155,6 +157,7 @@ def test_decode_strategy_scan_matches_loop(turntable_stacks):
     assert abs(len(m_scan) - len(m_loop)) <= 2
 
 
+@pytest.mark.slow
 def test_fused_pipeline_matches_scan_strategy(turntable_stacks):
     """The one-launch fused program computes the same registration and
     produces an equivalent merged cloud as the multi-launch "scan"
@@ -184,6 +187,7 @@ def test_fused_pipeline_matches_scan_strategy(turntable_stacks):
     assert abs(ang - 10.0) < 3.0, ang
 
 
+@pytest.mark.slow
 def test_fused_host_stacks_fall_back(turntable_stacks):
     """Host np.ndarray stacks cannot ride the fused path (they must stage
     chunk-by-chunk); the flag silently falls back to the loop strategies."""

@@ -4,6 +4,8 @@ import json
 import threading
 import time
 
+import pytest
+
 from structured_light_for_3d_model_replication_tpu.utils import trace
 
 
@@ -62,6 +64,7 @@ def test_wrap_decorator_and_reset():
     assert tr.totals() == {}
 
 
+@pytest.mark.slow
 def test_scan360_emits_spans(synth_rig, synth_scan):
     import jax.numpy as jnp
     import numpy as np

@@ -118,7 +118,16 @@ export default function App() {
         addLog(`camera ${st.width}x${st.height}`);
         void refreshDevices(); // labels become visible post-permission
       } catch (e) {
-        if (!cancelled) addLog(`camera error: ${e}`);
+        if (!cancelled) {
+          addLog(`camera error: ${e}`);
+          // A failed explicit-device open (unplugged / overconstrained)
+          // already stopped the previous stream — fall back to the
+          // default camera instead of leaving a dead feed.
+          if (activeDeviceId) {
+            addLog("falling back to default camera");
+            setActiveDeviceId("");
+          }
+        }
       }
     })();
     return () => {

@@ -525,8 +525,11 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
     if loop_closure:
         loop_T, loop_info = Ts[n - 1], infos[n - 1]
         log.info("loop edge 0→%d fitness=%.3f", n - 1, fit_np[n - 1])
-    return (seq_T, seq_info, loop_T, loop_info, list(fit_np[: n - 1]),
-            list(rmse_np[: n - 1]))
+    # Fitness/rmse lists cover EVERY edge (the loop edge last, when
+    # present) so telemetry consumers see the same edges on the loop and
+    # fused paths.
+    return (seq_T, seq_info, loop_T, loop_info, list(fit_np),
+            list(rmse_np))
 
 
 # ---------------------------------------------------------------------------

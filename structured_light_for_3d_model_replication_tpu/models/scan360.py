@@ -80,6 +80,14 @@ class Scan360Params:
     # chunks (it transforms the pre-gathered per-stop subsample directly —
     # see `_subsample_views_body`).
     reduce_chunk: int = 6
+    # Device-side compaction of the FUSED path's outputs to this many
+    # static slots before readback (surviving points pack to the front, so
+    # the host pulls ~the real cloud instead of `final_max_points` padded
+    # slots — on a remote/tunneled TPU the readback rides a slow link).
+    # None = no compaction. If survivors exceed the cap the result is a
+    # stratified subset (a warning logs the truncation); size it above the
+    # expected post-voxel/SOR count.
+    output_cap: int | None = None
 
 
 @functools.lru_cache(maxsize=None)
@@ -237,7 +245,23 @@ def _fused_fn(params: Scan360Params, decode_cfg, tri_cfg,
         # cannot diverge.
         dpts, dcol, normals, out_valid = merge_mod._finalize_body(
             mp, cap)(flat_pts, flat_col, flat_val)
-        return dpts, dcol, normals, out_valid, poses_f, fit, rmse
+        n_out = jnp.sum(out_valid.astype(jnp.int32))
+        if params.output_cap is not None:
+            # Pack survivors to the front of output_cap slots (identity
+            # order when they fit; stratified subset + warning when not)
+            # — the readback then moves ~the real cloud, not the padded
+            # final_max_points buffers. Colors travel as uint8 and
+            # normals as f16 (unit vectors; ~5e-4 error) for the same
+            # reason; points stay f32.
+            cidx, cval = pointcloud.stratified_indices(out_valid,
+                                                       params.output_cap)
+            dpts = jnp.where(cval[:, None], dpts[cidx], 0.0)
+            dcol = jnp.where(cval[:, None], dcol[cidx], 0.0)
+            normals = jnp.where(cval[:, None], normals[cidx], 0.0)
+            out_valid = cval
+        dcol_u8 = jnp.clip(dcol, 0, 255).astype(jnp.uint8)
+        return (dpts, dcol_u8, normals.astype(jnp.float16), out_valid,
+                n_out, poses_f, fit, rmse)
 
     return jax.jit(run)
 
@@ -408,7 +432,12 @@ def _run_fused(stacks, calib, col_bits, row_bits, params, decode_cfg,
         outs = fn(stacks, calib, key)
         # ONE batched readback: per-array np.asarray pulls would each pay
         # a full round trip on a remote/tunneled TPU (~0.1 s apiece).
-        dpts, dcol, normals, keep, poses, fit, rmse = jax.device_get(outs)
+        (dpts, dcol, normals, keep, n_out, poses, fit,
+         rmse) = jax.device_get(outs)
+    if params.output_cap is not None and int(n_out) > params.output_cap:
+        log.warning("fused output compaction truncated %d survivors to "
+                    "output_cap=%d (stratified subset)", int(n_out),
+                    params.output_cap)
     for i in range(1, n):
         log.info("edge %d→%d fitness=%.3f rmse=%.4f", i, i - 1,
                  fit[i - 1], rmse[i - 1])
@@ -416,8 +445,8 @@ def _run_fused(stacks, calib, col_bits, row_bits, params, decode_cfg,
         log.info("loop edge 0→%d fitness=%.3f", n - 1, fit[n - 1])
     merged = ply_io.PointCloud(
         points=dpts[keep],
-        colors=np.clip(dcol[keep], 0, 255).astype(np.uint8),
-        normals=normals[keep])
+        colors=dcol[keep],
+        normals=normals[keep].astype(np.float32))
     log.info("scan_stacks_to_cloud[fused]: %d stops → %d points (%s)", n,
              len(merged), params.method)
     if with_stats:

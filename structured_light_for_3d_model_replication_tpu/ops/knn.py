@@ -157,7 +157,11 @@ def _knn_padded(
             # mantissa bits), irrelevant to the approx path's consumers
             # (neighbor sets at recall ≈ 0.95, radius masks).
             kp, kv, p2 = key_blocks[0], key_valid[0], p2_blocks[0]
-            d = jnp.maximum(_block_dists(q, q2, kp, kv, p2, prec), 0.0)
+            # Floor at a small NORMAL float: a denormal packed value (a
+            # zero self-distance carrying only index bits) could be
+            # flushed to zero by the TPU, dropping the embedded index
+            # (same guard as ops/nn_pallas.py).
+            d = jnp.maximum(_block_dists(q, q2, kp, kv, p2, prec), 1e-30)
             bits = jax.lax.bitcast_convert_type(d, jnp.int32)
             mask = jnp.int32((1 << _PACK_BITS) - 1)
             iota = jnp.arange(N, dtype=jnp.int32)

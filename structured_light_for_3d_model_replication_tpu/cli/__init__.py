@@ -14,6 +14,9 @@ host:
 ``scan-360``       full fused pipeline: stacks → merged cloud (new)
 ``mesh``           cloud → STL, watertight/surface (`server/gui.py:643-684`)
 ``scan``           drive a capture rig, real or virtual (`server/gui.py:686`)
+``view``           render a .ply/.stl to PNG — the headless stand-in for the
+                   reference's Open3D viewer moments (`Old/New360.py:72`,
+                   `Old/StatisticalOutlierRemoval.py:66-71`)
 ================  ===========================================================
 
 Invoke via ``python -m structured_light_for_3d_model_replication_tpu.cli <tool> [args]``.
@@ -30,6 +33,7 @@ _TOOLS = {
     "scan-360": "scan_360",
     "mesh": "mesh",
     "scan": "scan",
+    "view": "view",
 }
 
 

@@ -354,6 +354,8 @@ class ScannerGUI(WorkerMixin):
             f, textvariable=self.var_thresholds,
             values=("adaptive", "fixed"), state="readonly"))
         self._button(t, "Generate point cloud(s)", self.do_cloud_gen)
+        self._button(t, "Preview cloud (PNG)",
+                     lambda: self.do_preview(self.var_cloud_out.get))
 
     def do_cloud_gen(self):
         from .cli import process_cloud
@@ -382,6 +384,14 @@ class ScannerGUI(WorkerMixin):
         self._button(t, "Merge 360 point clouds", self.do_merge)
         self._button(t, "Remove background (plane)", self.do_remove_bg)
         self._button(t, "Remove outliers (SOR)", self.do_remove_outliers)
+        self._button(t, "Preview merged (PNG)",
+                     lambda: self.do_preview(self.var_merge_out.get))
+        self._button(t, "Preview outliers (PNG)",
+                     lambda: self.do_preview(self.var_merge_out.get,
+                                             mode="outliers"))
+        self._button(t, "Preview plane split (PNG)",
+                     lambda: self.do_preview(self.var_merge_out.get,
+                                             mode="plane"))
 
     def do_merge(self):
         from .models import merge
@@ -422,6 +432,42 @@ class ScannerGUI(WorkerMixin):
 
         self._cleanup(merge.remove_outliers, "remove-outliers")
 
+    def do_preview(self, path_getter, mode: str | None = None):
+        """Render a .ply/.stl to PNG (``cli view``) and pop it up in a
+        Toplevel — the offline twin of the reference's Open3D viewer
+        buttons (`Old/New360.py:72`, `Old/StatisticalOutlierRemoval.py:66`).
+        Tk ≥ 8.6 reads PNG natively; headless use still gets the file."""
+        src = path_getter() if callable(path_getter) else path_getter
+        if not src:
+            self.log_line("preview: set an output path first")
+            return
+        png = os.path.splitext(src)[0] + (f"_{mode}" if mode else "") + ".png"
+
+        def work():
+            from .cli import view as view_cli
+
+            argv = [src, "-o", png] + ([f"--{mode}"] if mode else [])
+            rc = view_cli.main(argv)
+            if rc != 0:
+                raise RuntimeError(f"view exited {rc}")
+            return png
+
+        def done(path):
+            self.log_line(f"preview -> {path}")
+            try:
+                top = self.tk.Toplevel(self.root)
+                top.title(path)
+                photo = self.tk.PhotoImage(file=path)
+                label = self.ttk.Label(top, image=photo)
+                label.image = photo  # keep a ref: Tk GCs otherwise
+                label.pack()
+            except Exception as e:  # headless / pre-8.6 Tk: file still wrote
+                self.log_line(f"preview window unavailable ({e}); "
+                              f"open {path} manually")
+
+        self.run_bg("preview", work, done,
+                    on_error=lambda e: self.log_line(f"preview failed: {e}"))
+
     # ------------------------------------------------------------------
     # Tab 6: meshing (`server/gui.py:643-684`)
     # ------------------------------------------------------------------
@@ -436,6 +482,8 @@ class ScannerGUI(WorkerMixin):
             f, textvariable=self.var_mesh_orient,
             values=("radial", "tangent"), state="readonly"))
         self._button(t, "Run 360 meshing", self.do_mesh)
+        self._button(t, "Preview mesh (PNG)",
+                     lambda: self.do_preview(self.var_mesh_out.get))
 
     def do_mesh(self):
         from .io import ply as ply_io

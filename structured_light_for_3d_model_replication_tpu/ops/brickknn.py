@@ -24,12 +24,16 @@ Exact whenever the k-th neighbor lies within one cell radius and no
 involved cell overflows S — by construction of the cell-size estimate
 that holds for the overwhelming majority of queries: measured recall
 ≥ 0.99 at 1M/k=20 (tests/test_spatial_knn.py) vs 0.93 for the Morton
-engine. Measured cost at 1M on a v5e: ~4.5 s vs Morton's ~0.95 s — the
-27-brick window evaluates ~4.5× the candidates of Morton's 3-block
-window (plus empty padded slots), and that ratio IS the wall-clock
-ratio; the old gather-based grid engine at the same recall measured
-~14×. Use for precision-sensitive consumers, not the bulk statistics
-paths.
+engine.
+
+Two implementations share this setup: the XLA path below (the exact
+oracle and CPU fallback — ~4.6 s at 1M/k=20 on a v5e, bounded by
+take_along_axis/approx_top_k/scatter bookkeeping) and the Mosaic kernel
+(`ops/brickknn_pallas.py` — ~1.15 s, 1.19× the Morton engine), which is
+the default on TPU backends and makes high recall cheap enough to be the
+large-N default for every consumer (`ops/pointcloud.py:_self_knn`).
+Round 2 measured the old gather-based grid engine at the same recall at
+~14×.
 
 Same (sq_dists, indices, neighbor_valid) contract as :func:`..ops.knn.knn`.
 """
@@ -48,10 +52,62 @@ log = get_logger(__name__)
 
 _BITS = 10
 _GRID_MAX = (1 << _BITS) - 1
+S_PALLAS = 32  # the Mosaic kernel's fixed brick capacity
 # Plain Python int, NOT jnp.int32: a module-level jax value would
 # initialize the XLA backend at import time, which breaks
 # jax.distributed.initialize for multi-host users importing the package.
 _BIG = 1 << 30
+
+
+def _grid_cells(points, valid, k, cell_scale_x100, h_scale=None):
+    """Shared cell assignment: the r_k cell-size estimate (floored so the
+    grid fits 10 bits/axis) and the packed per-point cell id. Used by
+    BOTH the XLA engine below and the Mosaic kernel
+    (`ops/brickknn_pallas.py`) — a divergence here would silently break
+    the kernel's oracle tests against this path."""
+    h = _estimate_cell_size(points, valid, k) * (cell_scale_x100 / 100.0)
+    mins = jnp.min(jnp.where(valid[:, None], points, jnp.inf), axis=0)
+    maxs = jnp.max(jnp.where(valid[:, None], points, -jnp.inf), axis=0)
+    extent = jnp.max(maxs - mins)
+    h = jnp.maximum(h, extent / (_GRID_MAX - 2) + 1e-12)
+    if h_scale is not None:
+        h = h * h_scale
+
+    def quantize(hh):
+        cell = jnp.clip(((points - mins) / hh).astype(jnp.int32),
+                        0, _GRID_MAX)
+        cc = (cell[:, 0] << (2 * _BITS)) | (cell[:, 1] << _BITS) \
+            | cell[:, 2]
+        return jnp.where(valid, cc, _BIG)
+
+    return h, quantize
+
+
+def _sorted_segments(points, valid, cid, slots, max_cells):
+    """Shared sort + segment structure + brick destinations (module
+    docstring step 2). Returns the sorted views and the per-point brick
+    destination (dump row = max_cells·slots for overflow/budget drops)."""
+    n = points.shape[0]
+    order = jnp.argsort(cid)
+    cid_s = cid[order]
+    pts_s = points[order]
+    val_s = valid[order] & (cid_s < _BIG)
+    orig_s = order.astype(jnp.int32)
+
+    first = jnp.concatenate([jnp.ones(1, bool), cid_s[1:] != cid_s[:-1]])
+    first = first & val_s
+    cell_rank = jnp.cumsum(first.astype(jnp.int32)) - 1       # (N,)
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, jnp.arange(n, dtype=jnp.int32), 0))
+    within = jnp.arange(n, dtype=jnp.int32) - seg_start
+
+    ok = val_s & (within < slots) & (cell_rank < max_cells)
+    dest = jnp.where(ok, cell_rank * slots + within, max_cells * slots)
+    # Sorted unique cell ids (ascending) for neighbor lookup.
+    ucid = jnp.full((max_cells + 1,), _BIG, jnp.int32).at[
+        jnp.where(first & (cell_rank < max_cells), cell_rank,
+                  max_cells)].set(jnp.where(first, cid_s, _BIG))[:-1]
+    return cid_s, pts_s, val_s, orig_s, first, cell_rank, ok, dest, ucid
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
@@ -65,46 +121,17 @@ def _brick_knn_impl(points, valid, k, slots, chunk_cells, exclude_self,
     # report no neighbors — degenerate inputs only).
     m_cells = max_cells
 
-    # Cell size: the sampled k-th-NN estimate, scaled so ball(q, r_k) fits
-    # the 3³ neighborhood of q's cell for the typical query.
-    h = _estimate_cell_size(points, valid, k) * (cell_scale_x100 / 100.0)
-    mins = jnp.min(jnp.where(valid[:, None], points, jnp.inf), axis=0)
-    maxs = jnp.max(jnp.where(valid[:, None], points, -jnp.inf), axis=0)
-    extent = jnp.max(maxs - mins)
-    h = jnp.maximum(h, extent / (_GRID_MAX - 2) + 1e-12)
-    cell = jnp.clip(((points - mins) / h).astype(jnp.int32), 0, _GRID_MAX)
-    cid = (cell[:, 0] << (2 * _BITS)) | (cell[:, 1] << _BITS) | cell[:, 2]
-    cid = jnp.where(valid, cid, _BIG)
+    h, quantize = _grid_cells(points, valid, k, cell_scale_x100)
+    cid = quantize(h)
+    (cid_s, pts_s, val_s, orig_s, first, cell_rank, ok, dest,
+     ucid) = _sorted_segments(points, valid, cid, S, m_cells)
 
-    order = jnp.argsort(cid)
-    cid_s = cid[order]
-    pts_s = points[order]
-    val_s = valid[order] & (cid_s < _BIG)
-    orig_s = order.astype(jnp.int32)
-
-    # Segment structure of the sorted cells.
-    first = jnp.concatenate([jnp.ones(1, bool), cid_s[1:] != cid_s[:-1]])
-    first = first & val_s
-    cell_rank = jnp.cumsum(first.astype(jnp.int32)) - 1       # (N,)
-    seg_start = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(first, jnp.arange(n, dtype=jnp.int32), 0))
-    within = jnp.arange(n, dtype=jnp.int32) - seg_start
-
-    # Brick scatter: (M*S) slots; overflow (within ≥ S or cell budget
-    # exceeded) → dump row.
-    ok = val_s & (within < S) & (cell_rank < m_cells)
-    dest = jnp.where(ok, cell_rank * S + within, m_cells * S)
     bp = jnp.zeros((m_cells * S + 1, 3), jnp.float32).at[dest].set(pts_s)
     bo = jnp.full((m_cells * S + 1,), -1, jnp.int32).at[dest].set(orig_s)
     bv = jnp.zeros((m_cells * S + 1,), bool).at[dest].set(ok)
     bp = bp[:-1].reshape(m_cells, S, 3)
     bo = bo[:-1].reshape(m_cells, S)
     bv = bv[:-1].reshape(m_cells, S)
-
-    # Sorted unique cell ids (ascending) for neighbor lookup.
-    ucid = jnp.full((m_cells + 1,), _BIG, jnp.int32).at[
-        jnp.where(first & (cell_rank < m_cells), cell_rank, m_cells)].set(
-        jnp.where(first, cid_s, _BIG))[:-1]
 
     # 27 neighbor cell ranks per cell (boundary-masked per axis — packed-id
     # arithmetic aliases across axis borrows otherwise, see ops/gridknn.py).
@@ -203,6 +230,7 @@ def brick_knn(
     chunk_cells: int = 2048,
     cell_scale: float = 1.4,
     max_cells: int | None = None,
+    use_pallas: bool | None = None,
 ):
     """High-recall brick-grid self-query KNN (module docstring).
 
@@ -213,6 +241,12 @@ def brick_knn(
     the 3³ neighborhood covers the true neighbor ball. ``max_cells``
     bounds the static occupied-cell budget (default n/8 + 1024 — cells
     hold ~O(k) points by construction, so real clouds occupy far fewer).
+
+    ``use_pallas``: None = the Mosaic kernel (`ops/brickknn_pallas.py`)
+    on TPU backends when ``slots==32`` and ``k<=32``, XLA elsewhere;
+    True forces it in interpret mode off-TPU (tests). The kernel clears
+    the low 10 mantissa bits of returned d² (≤ 2⁻¹³ relative); the XLA
+    path is exact.
     """
     points = jnp.asarray(points, jnp.float32)
     n = points.shape[0]
@@ -222,6 +256,27 @@ def brick_knn(
         raise ValueError(f"slots {slots} too small for k={k}")
     if max_cells is None:
         max_cells = n // 8 + 1024
+
+    from . import brickknn_pallas
+
+    kernel_fits = (slots == S_PALLAS and k <= brickknn_pallas.MAX_K
+                   and n <= brickknn_pallas.MAX_N)
+    if use_pallas is None:
+        use_pallas = brickknn_pallas.available() and kernel_fits
+    elif use_pallas and not kernel_fits:
+        raise ValueError(
+            f"use_pallas=True but the Mosaic brick kernel requires "
+            f"slots={S_PALLAS}, k<={brickknn_pallas.MAX_K} and "
+            f"n<={brickknn_pallas.MAX_N} (got slots={slots}, k={k}, "
+            f"n={n})")
+    if use_pallas:
+        d, i, v, n_dropped = brickknn_pallas.brick_knn_pallas(
+            points, points_valid, k, exclude_self,
+            int(round(cell_scale * 100)), max_cells,
+            interpret=not brickknn_pallas.available())
+        jax.debug.callback(_warn_dropped, n_dropped, n)
+        return d, i, v
+
     cc = min(chunk_cells, max(256, max_cells))
     if max_cells % cc:  # static chunking needs a divisor-friendly budget
         max_cells = ((max_cells + cc - 1) // cc) * cc

@@ -1,0 +1,392 @@
+"""Pallas TPU kernel for the brick-grid rescue KNN (`ops/brickknn.py`).
+
+Round-2 measured the XLA brick engine at ~4.7 s vs Morton's ~0.95 s at
+1M/k=20 — 4.9× for the window-exact candidate set where the VERDICT asked
+≤ 1.5×. XProf showed the gap is NOT the distance math (42 ms) or even the
+27-brick gathers (80 ms): it is TPU-hostile index bookkeeping —
+``take_along_axis`` chains (2.1 s), ``approx_top_k`` over (rows, 864)
+(0.73 s), 27-way ``searchsorted`` (0.46 s) and scattering the 3.3×-padded
+brick rows back to point order (0.65 s).
+
+This kernel eliminates the bookkeeping instead of accelerating it:
+
+* the candidate "gather" is DMA addressing — per query cell the kernel
+  walks its (compacted, present-first) neighbor-brick list and DMAs each
+  brick's packed ``x|y|z|id`` 128-lane row straight into VMEM,
+  double-buffered in stages of 4;
+* distances accumulate into a (CP·32, 896) VMEM tile packed with the
+  candidate's lane id in the LOW 10 MANTISSA BITS (896 < 1024 lanes, so
+  the packing is a total order: ties cannot produce duplicate picks) —
+  the same trick as `ops/nn_pallas.py`/`ops/knn.py` packed top-k;
+* selection is THRESHOLD extraction: the k-th pick is "min of packed
+  values strictly above the (k-1)-th" — one fused where+min pass per k,
+  no masking writes, no sort, no approx_top_k, no position gathers. The
+  global point id of each pick is selected in the same pass from a
+  parallel id tile, so the output needs NO local→global translation;
+* 4 cells share a grid step (CP=4): extraction reductions run on all 128
+  VPU sublanes instead of 32 (measured 0.69 → 0.52 s kernel time);
+* outputs land in brick order; the caller maps them to point order with
+  ONE (N, k) row gather instead of scattering every padded brick row.
+
+Packing cost: returned d² has its low 10 mantissa bits cleared (≤ 2⁻¹³
+relative underestimate) and near-exact ties at the k-th distance may
+resolve differently than exact f32 — measured recall vs brute force stays
+≥ 0.99 (`tests/test_spatial_knn.py`). The XLA path in `ops/brickknn.py`
+remains the exact oracle and the CPU fallback.
+
+Replaces the Open3D KDTree exactness role of the reference
+(`server/processing.py:64,87`) at TPU speed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .brickknn import _grid_cells, _sorted_segments
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+S = 32            # brick slots (queries AND candidates per cell)
+NB = 27           # 3³ neighbor window
+G = 7             # DMA stages of 4 bricks (7·4 = 28 ≥ 27)
+CP = 4            # cells per grid step (128 query sublanes)
+W = G * 4 * S     # 896 candidate lanes per cell
+MAX_K = 32        # output block width
+BIGID = 3.0e7     # id sentinel (exact in f32; > any real point id)
+_BITS = 10
+_GRID_MAX = (1 << _BITS) - 1
+_BIG = 1 << 30
+
+
+def available() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _kernel(nbr_ref, nnb_ref, q_ref, bpc_hbm, d_ref, i_ref,
+            cand, work, ridq, sem, *, k: int, exclude_self: bool):
+    cbase = pl.program_id(0) * CP
+    inf = float("inf")
+
+    # Ghost steps (cells past the occupied count — the static budget is
+    # generous) have nnb == 0 for every sub: skip the whole body. Their
+    # output rows are never gathered (gatherpos can't point at them).
+    any_live = sum(nnb_ref[0, sub, 0] for sub in range(CP)) > 0
+
+    @pl.when(any_live)
+    def _body():
+        _kernel_body(nbr_ref, nnb_ref, q_ref, bpc_hbm, d_ref, i_ref,
+                     cand, work, ridq, sem, k=k, exclude_self=exclude_self,
+                     cbase=cbase)
+
+
+def _kernel_body(nbr_ref, nnb_ref, q_ref, bpc_hbm, d_ref, i_ref,
+                 cand, work, ridq, sem, *, k: int, exclude_self: bool,
+                 cbase):
+    inf = float("inf")
+
+    def dma(slot, sub, u, jj):
+        return pltpu.make_async_copy(
+            bpc_hbm.at[nbr_ref[0, sub, jj]], cand.at[slot, sub, u],
+            sem.at[slot, sub, u])
+
+    def start_stage(slot, g):
+        for sub in range(CP):
+            for u in range(4):
+                dma(slot, sub, u, jnp.minimum(g * 4 + u, NB - 1)).start()
+
+    # Dynamic stage count: surface cells average ~14 live neighbors, so
+    # half the 7 stages would DMA dead bricks (the kernel is DMA-bound:
+    # 112 copies/step at the static count). Stages never entered leave
+    # stale lanes -> one upfront inf-fill masks them.
+    nnmax = nnb_ref[0, 0, 0]
+    for sub in range(1, CP):
+        nnmax = jnp.maximum(nnmax, nnb_ref[0, sub, 0])
+    gmax = (nnmax + 3) // 4
+    work[...] = jnp.full_like(work, inf)
+
+    start_stage(0, 0)
+    q = q_ref[0]                           # (CP·S, 3)
+    qx = q[:, 0:1]
+    qy = q[:, 1:2]
+    qz = q[:, 2:3]
+
+    def stage(g, _):
+        slot = jax.lax.rem(g, 2)
+        nxt = jax.lax.rem(g + 1, 2)
+
+        @pl.when(g + 1 < gmax)
+        def _():
+            start_stage(nxt, g + 1)
+
+        uparts = []
+        idparts = []
+        eye = (jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+               == jax.lax.broadcasted_iota(jnp.int32, (S, S), 1))
+        for u in range(4):
+            jj = g * 4 + u
+            jc = jnp.minimum(jj, NB - 1)
+            subparts = []
+            subids = []
+            for sub in range(CP):
+                dma(slot, sub, u, jc).wait()
+                kp = cand[slot, sub, u]               # (1, 128)
+                sq = slice(sub * S, (sub + 1) * S)
+                dx = qx[sq] - kp[:, 0:S]
+                dy = qy[sq] - kp[:, S:2 * S]
+                dz = qz[sq] - kp[:, 2 * S:3 * S]
+                d2 = dx * dx + dy * dy + dz * dz      # (S, S)
+                if exclude_self:
+                    own = nbr_ref[0, sub, jc] == cbase + sub
+                    d2 = jnp.where(own & eye, inf, d2)
+                d2 = jnp.where(jj < nnb_ref[0, sub, 0], d2, inf)
+                subparts.append(d2)
+                subids.append(jnp.broadcast_to(kp[:, 3 * S:], (S, S)))
+            uparts.append(jnp.concatenate(subparts, axis=0))
+            idparts.append(jnp.concatenate(subids, axis=0))
+        slab = jnp.concatenate(uparts, axis=1)        # (CP·S, 128)
+        idslab = jnp.concatenate(idparts, axis=1)
+        # Lane id in the low mantissa (denormal-floored first so FTZ can't
+        # erase it; NaN/inf from empty-slot sentinels -> +inf, id dropped).
+        slab = jnp.maximum(slab, 1e-30)
+        bits = jax.lax.bitcast_convert_type(slab, jnp.int32)
+        lane = (jax.lax.broadcasted_iota(jnp.int32, (CP * S, 128), 1)
+                + g * 128)
+        pk = (bits & ~jnp.int32(_GRID_MAX)) | lane
+        pk = jnp.where(jnp.isfinite(slab),
+                       jax.lax.bitcast_convert_type(pk, jnp.float32),
+                       jnp.float32(jnp.inf))
+        work[:, pl.ds(g * 128, 128)] = pk
+        ridq[:, pl.ds(g * 128, 128)] = idslab
+        return 0
+
+    jax.lax.fori_loop(0, gmax, stage, 0)
+
+    w = work[...]                          # (CP·S, W) packed
+    ridb = ridq[...]
+    t = jnp.full((CP * S, 1), -jnp.inf, jnp.float32)
+    for kk in range(k):
+        m = jnp.min(jnp.where(w > t, w, inf), axis=1, keepdims=True)
+        sel = jnp.min(jnp.where(w == m, ridb, BIGID), axis=1, keepdims=True)
+        mb = (jax.lax.bitcast_convert_type(m, jnp.int32)
+              & ~jnp.int32(_GRID_MAX))
+        d_ref[0, :, kk] = jax.lax.bitcast_convert_type(mb, jnp.float32)[:, 0]
+        i_ref[0, :, kk] = sel[:, 0].astype(jnp.int32)
+        t = m
+    for kk in range(k, MAX_K):             # unused output lanes
+        d_ref[0, :, kk] = jnp.full((CP * S,), inf, jnp.float32)
+        i_ref[0, :, kk] = jnp.zeros((CP * S,), jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(2, 3, 4, 5, 6))
+def _brick_knn_pallas_impl(points, valid, k, exclude_self, cell_scale_x100,
+                           max_cells, interpret):
+    n = points.shape[0]
+    m_cells = max_cells
+
+    # --- cell assignment: shared with the XLA engine ---
+    h, quantize = _grid_cells(points, valid, k, cell_scale_x100)
+
+    # Occupancy retarget: the r_k-derived cell size packs surface clouds
+    # at ~5 points/cell — 1M points occupy ~220k cells, blowing the cell
+    # budget AND paying the kernel's fixed per-cell cost on mostly-empty
+    # bricks. Growing h only widens the exact window (recall cannot
+    # drop), but fixed 32-slot bricks overflow where the cloud is
+    # DENSEST, so the safe growth is set by the tail of the occupancy
+    # distribution, not its mean. Probe the p99.5 PER-POINT occupancy at
+    # h and 2h (sort + histogram, no percentile sort): their ratio gives
+    # the local packing exponent at the dense cells (≈2² for surfaces,
+    # ≈2³ for volumetric cores), then grow h until that tail occupancy
+    # reaches ~28 of the 32 slots. A cell-budget floor keeps giant
+    # uniform clouds inside max_cells. Overflow stays counted and warned.
+    def occ_probe(hh):
+        cs = jnp.sort(quantize(hh))
+        vs = cs < _BIG
+        firstp = jnp.concatenate(
+            [cs[:1] < _BIG, (cs[1:] != cs[:-1]) & vs[1:]])
+        rankp = jnp.cumsum(firstp.astype(jnp.int32)) - 1
+        counts = jnp.zeros((n + 1,), jnp.int32).at[
+            jnp.where(vs, rankp, n)].add(1)
+        cpp = jnp.where(vs, counts[jnp.minimum(rankp, n - 1)], 0)
+        # Invalid points land in bin 257, OUTSIDE the scanned range —
+        # dumping them into bin 0 would satisfy the cumulative threshold
+        # immediately on masked clouds (occ_hi = 0 → maximum growth →
+        # mass slot overflow).
+        hist = jnp.zeros((258,), jnp.int32).at[
+            jnp.where(vs, jnp.minimum(cpp, 256), 257)].add(1)
+        nv = jnp.maximum(jnp.sum(vs), 1)
+        cum = jnp.cumsum(hist[:257])
+        occ_hi = jnp.argmax(cum >= (0.995 * nv).astype(jnp.int32))
+        return (jnp.maximum(occ_hi, 1).astype(jnp.float32),
+                jnp.sum(firstp).astype(jnp.float32))
+
+    occ0, cells0 = occ_probe(h)
+    occ2, _ = occ_probe(2.0 * h)
+    beta_p = jnp.clip(jnp.log2(jnp.maximum(occ2, occ0 * 1.1) / occ0),
+                      1.5, 3.0)
+    s_pack = jnp.maximum(28.0 / occ0, 1.0) ** (1.0 / beta_p)
+    # cells(h·s) ≤ cells0/s² for any geometry with β ≥ 2.
+    s_budget = jnp.sqrt(cells0 / (0.95 * m_cells))
+    h = h * jnp.clip(jnp.maximum(s_pack, s_budget), 1.0, 4.0)
+    cid = quantize(h)
+    (cid_s, pts_s, val_s, orig_s, first, cell_rank, ok, dest,
+     ucid) = _sorted_segments(points, valid, cid, S, m_cells)
+
+    # --- brick arrays ---
+    # Candidate side (M, 1, 128): x|y|z|gid lanes; empty slots carry +inf
+    # coords (d² -> inf in-kernel) and the BIGID gid sentinel.
+    row4 = jnp.concatenate(
+        [pts_s, orig_s.astype(jnp.float32)[:, None]], axis=1)
+    fill4 = jnp.asarray([jnp.inf, jnp.inf, jnp.inf, BIGID], jnp.float32)
+    b4 = jnp.broadcast_to(fill4, (m_cells * S + 1, 4)).at[dest].set(row4)
+    bpc = (b4[:-1].reshape(m_cells, S, 4).transpose(0, 2, 1)
+           .reshape(m_cells, 1, 4 * S))
+    # Query side (M, S, 3); empty query slots at 0 (their rows are never
+    # gathered — gatherpos has no source pointing at them).
+    bq = jnp.zeros((m_cells * S + 1, 3), jnp.float32).at[dest].set(
+        pts_s)[:-1].reshape(m_cells, S, 3)
+    # Point-order -> brick-order map for the final row gather; dropped
+    # points land on the dump row (all-inf -> neighbor_valid False).
+    gatherpos = jnp.full((n + 1,), m_cells * S, jnp.int32).at[
+        jnp.where(ok, orig_s, n)].set(dest)[:n]
+
+    # --- neighbor table: 13 directed deltas + mirror (the 27-delta
+    # searchsorted was 0.46 s of the XLA engine; symmetry halves it) ---
+    ux = ucid >> (2 * _BITS)
+    uy = (ucid >> _BITS) & _GRID_MAX
+    uz = ucid & _GRID_MAX
+    all_deltas = [(dx, dy, dz) for dx in (-1, 0, 1) for dy in (-1, 0, 1)
+                  for dz in (-1, 0, 1)]
+    pos_deltas = jnp.asarray(all_deltas[14:], jnp.int32)      # 13 directed
+    nxyz = (jnp.stack([ux, uy, uz], -1)[:, None, :]
+            + pos_deltas[None])                               # (M, 13, 3)
+    in_grid = jnp.all((nxyz >= 0) & (nxyz <= _GRID_MAX), axis=-1) \
+        & (ucid < _BIG)[:, None]
+    ncid = (nxyz[..., 0] << (2 * _BITS)) | (nxyz[..., 1] << _BITS) \
+        | nxyz[..., 2]
+    # Lookup by SORT-MERGE, not searchsorted: with in-range coordinates
+    # the packed neighbor id is exactly ucid + const offset, so each
+    # delta's query list is itself ascending — rank queries against the
+    # table with one stable concat-argsort per delta (vmapped; ~13 small
+    # sorts) instead of 1.7M binary searches (0.22 s of vmapped while).
+    ncid_q = jnp.where(in_grid, ncid, _BIG)                   # (M, 13)
+
+    def rank_in_table(queries):
+        keys = jnp.concatenate([ucid, queries])
+        order3 = jnp.argsort(keys, stable=True)   # ties: table first
+        cum = jnp.cumsum((order3 < m_cells).astype(jnp.int32))
+        inv = jnp.zeros((2 * m_cells,), jnp.int32).at[order3].set(
+            jnp.arange(2 * m_cells, dtype=jnp.int32))
+        c = cum[inv[m_cells:]]          # table entries ≤ query (stable)
+        return c                        # rank+1 when present
+
+    c13 = jax.vmap(rank_in_table, in_axes=1, out_axes=1)(ncid_q)
+    pos_c = jnp.clip(c13 - 1, 0, m_cells - 1)
+    found = in_grid & (c13 > 0) & (ucid[pos_c] == ncid)
+    fwd = jnp.where(found, pos_c, m_cells)                    # (M, 13)
+
+    nbr27 = jnp.full((m_cells, NB), m_cells, jnp.int32)
+    # Self (slot 13) only for OCCUPIED ranks — a ghost cell (rank past
+    # the occupied count) must end with nnb == 0 or the kernel's
+    # whole-body skip never fires and every ghost step pays a full DMA
+    # stage + extraction.
+    nbr27 = nbr27.at[:, 13].set(jnp.where(
+        ucid < _BIG, jnp.arange(m_cells, dtype=jnp.int32), m_cells))
+    nbr27 = nbr27.at[:, 14:].set(fwd)
+    # Mirror: if B is A's neighbor at directed delta d (slot 14+d), then A
+    # is B's neighbor at the mirrored slot 12-d.
+    mslot = jnp.arange(12, -1, -1, dtype=jnp.int32)           # (13,)
+    mdest = jnp.where(found, pos_c * NB + mslot[None, :], m_cells * NB)
+    msrc = jnp.broadcast_to(
+        jnp.arange(m_cells, dtype=jnp.int32)[:, None], (m_cells, 13))
+    nbr27 = nbr27.reshape(-1)
+    nbr27 = jnp.concatenate([nbr27, jnp.zeros((1,), jnp.int32)]).at[
+        mdest.reshape(-1)].set(msrc.reshape(-1))[:-1].reshape(m_cells, NB)
+
+    # Present-first compaction; absent -> own rank (elided DMA revisits).
+    present = nbr27 < m_cells
+    key = jnp.where(present, jnp.arange(NB, dtype=jnp.int32)[None, :], 64)
+    order2 = jnp.argsort(key, axis=1)
+    nbr_c = jnp.take_along_axis(nbr27, order2, axis=1)
+    nnb = jnp.sum(present, axis=1).astype(jnp.int32)
+    own = jnp.arange(m_cells, dtype=jnp.int32)[:, None]
+    nbr_c = jnp.where(nbr_c < m_cells, nbr_c, own)
+
+    # --- kernel ---
+    mg = m_cells // CP   # max_cells is CP-aligned (caller rounds)
+    d, i = pl.pallas_call(
+        functools.partial(_kernel, k=k, exclude_self=exclude_self),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(mg,),
+            in_specs=[
+                pl.BlockSpec((1, CP, NB), lambda c: (c, 0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, CP, 1), lambda c: (c, 0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, CP * S, 3), lambda c: (c, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, CP * S, MAX_K), lambda c: (c, 0, 0)),
+                pl.BlockSpec((1, CP * S, MAX_K), lambda c: (c, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, CP, 4, 1, 128), jnp.float32),
+                pltpu.VMEM((CP * S, W), jnp.float32),
+                pltpu.VMEM((CP * S, W), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, CP, 4)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((mg, CP * S, MAX_K), jnp.float32),
+            jax.ShapeDtypeStruct((mg, CP * S, MAX_K), jnp.int32),
+        ],
+        interpret=interpret,
+    )(nbr_c.reshape(mg, CP, NB), nnb.reshape(mg, CP, 1), bq.reshape(
+        mg, CP * S, 3), bpc)
+
+    # --- back to point order: ONE row gather (vs scattering every padded
+    # brick row — 0.65 s of the XLA engine at 1M). No dump-row concat: a
+    # concatenate here copies the whole 540 MB result before the gather
+    # (measured 1.2 s of dynamic-update-slices); clamp + mask instead. ---
+    d = d.reshape(m_cells * S, MAX_K)
+    i = i.reshape(m_cells * S, MAX_K)
+    in_brick = gatherpos < m_cells * S
+    gp = jnp.minimum(gatherpos, m_cells * S - 1)
+    # d[gp][:, :k], NOT d[gp, :k]: the fused gather-with-slice lowers to
+    # a sequential dynamic-slice loop on TPU (measured 2.86 s vs 0.15 s
+    # for gather-then-slice at 1M rows).
+    out_d = d[gp][:, :k]
+    out_i = i[gp][:, :k]
+    out_v = (jnp.isfinite(out_d) & valid[:, None] & in_brick[:, None])
+    out_d = jnp.where(out_v, out_d, 0.0)
+    out_i = jnp.clip(jnp.where(out_v, out_i, 0), 0, n - 1)
+    n_dropped = jnp.sum(val_s & ~ok)
+    return out_d, out_i, out_v, n_dropped
+
+
+MAX_N = 1 << 24  # point ids travel as exact f32 lanes
+
+
+def brick_knn_pallas(points, valid, k: int, exclude_self: bool,
+                     cell_scale_x100: int, max_cells: int,
+                     interpret: bool = False):
+    """Kernel-path entry used by :func:`..brickknn.brick_knn` dispatch.
+    ``max_cells`` is rounded up to the CP grid multiple here."""
+    if k > MAX_K:
+        raise ValueError(f"pallas brick engine caps k at {MAX_K}, got {k}")
+    if points.shape[0] > MAX_N:
+        raise ValueError(
+            f"pallas brick engine caps n at {MAX_N} (ids are exact-f32 "
+            f"lanes), got {points.shape[0]}; use the XLA path")
+    mc = ((max_cells + CP - 1) // CP) * CP
+    return _brick_knn_pallas_impl(points, valid, k, exclude_self,
+                                  cell_scale_x100, mc, interpret)

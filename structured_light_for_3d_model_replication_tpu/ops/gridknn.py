@@ -45,10 +45,16 @@ def _estimate_cell_size(points, valid, k):
     n = points.shape[0]
     s = max(1, n // 1024)
     p = max(1, n // 8192)
-    q_samp = points[::s][:1024]
-    qv = valid[::s][:1024]
-    p_samp = points[::p][:8192]
-    pv = valid[::p][:8192]
+    # Index-array gathers, NOT strided slices: `points[::1024]` lowers to
+    # a sequential dynamic-slice loop on TPU — XProf measured ~4.7 s of a
+    # 1M-point brick_knn call inside these two sample lines. A small
+    # explicit gather is microseconds.
+    qi = jnp.arange(min(1024, (n + s - 1) // s), dtype=jnp.int32) * s
+    pi = jnp.arange(min(8192, (n + p - 1) // p), dtype=jnp.int32) * p
+    q_samp = points[qi]
+    qv = valid[qi]
+    p_samp = points[pi]
+    pv = valid[pi]
     d2 = jnp.sum((q_samp[:, None, :] - p_samp[None, :, :]) ** 2, axis=-1)
     d2 = jnp.where(pv[None, :], d2, jnp.inf)
     kk = min(k + 1, p_samp.shape[0])  # +1: the sample may contain the query

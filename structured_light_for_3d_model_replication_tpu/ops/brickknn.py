@@ -274,7 +274,7 @@ def brick_knn(
             points, points_valid, k, exclude_self,
             int(round(cell_scale * 100)), max_cells,
             interpret=not brickknn_pallas.available())
-        jax.debug.callback(_warn_dropped, n_dropped, n)
+        _emit_drop_warning(n_dropped, n)
         return d, i, v
 
     cc = min(chunk_cells, max(256, max_cells))
@@ -283,9 +283,21 @@ def brick_knn(
     d, i, v, n_dropped = _brick_knn_impl(
         points, points_valid, k, slots, cc, exclude_self,
         int(round(cell_scale * 100)), max_cells)
-    # debug.callback: works under jit/vmap, async, fires only at runtime.
-    jax.debug.callback(_warn_dropped, n_dropped, n)
+    _emit_drop_warning(n_dropped, n)
     return d, i, v
+
+
+def _emit_drop_warning(n_dropped, n_total) -> None:
+    """Surface the truncation count at runtime. Eager callers get a plain
+    host-side check; under an outer jit the count is a tracer, so attach a
+    debug callback — except on the axon backend, whose PJRT lacks host
+    callbacks entirely (UNIMPLEMENTED at dispatch): there nested-jit
+    consumers go unwarned rather than crashing."""
+    if isinstance(n_dropped, jax.core.Tracer):
+        if jax.default_backend() != "axon":
+            jax.debug.callback(_warn_dropped, n_dropped, n_total)
+        return
+    _warn_dropped(n_dropped, n_total)
 
 
 def _warn_dropped(n_dropped, n_total) -> None:

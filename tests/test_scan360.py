@@ -188,6 +188,44 @@ def test_fused_pipeline_matches_scan_strategy(turntable_stacks):
 
 
 @pytest.mark.slow
+def test_stream_matches_fused(turntable_stacks):
+    """The capture-overlapped streaming path (per-stop host arrays in, one
+    tail launch out) reproduces the fused pipeline's registration and an
+    equivalent merged cloud, and reports its overlap timing."""
+    stacks, (cam_K, proj_K, R, T) = turntable_stacks
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    base = dict(merge=FAST.merge, method="sequential", view_cap=FAST.view_cap,
+                stop_chunk=2)
+    m_fused, p_fused = scan360.scan_stacks_to_cloud(
+        jnp.asarray(stacks), calib, SMALL_PROJ.col_bits, SMALL_PROJ.row_bits,
+        params=scan360.Scan360Params(**base, fused=True))
+
+    timing = {}
+    m_str, p_str = scan360.scan_stream_to_cloud(
+        (s for s in stacks), calib, SMALL_PROJ.col_bits, SMALL_PROJ.row_bits,
+        params=scan360.Scan360Params(**base), timing=timing)
+    np.testing.assert_allclose(p_str, p_fused, atol=1e-4)
+    assert abs(len(m_str) - len(m_fused)) <= 0.02 * len(m_fused) + 2
+    assert m_str.colors is not None and m_str.normals is not None
+    assert timing["stops"] == 4 and len(timing["stage_decode_s"]) == 2
+    assert timing["tail_s"] > 0
+
+
+def test_stream_needs_two_stops(turntable_stacks):
+    stacks, (cam_K, proj_K, R, T) = turntable_stacks
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    with pytest.raises(ValueError, match="at least 2"):
+        scan360.scan_stream_to_cloud(
+            (s for s in stacks[:1]), calib, SMALL_PROJ.col_bits,
+            SMALL_PROJ.row_bits, params=scan360.Scan360Params(
+                merge=FAST.merge, view_cap=FAST.view_cap))
+
+
+@pytest.mark.slow
 def test_fused_host_stacks_fall_back(turntable_stacks):
     """Host np.ndarray stacks cannot ride the fused path (they must stage
     chunk-by-chunk); the flag silently falls back to the loop strategies."""

@@ -59,7 +59,7 @@ S_PALLAS = 32  # the Mosaic kernel's fixed brick capacity
 _BIG = 1 << 30
 
 
-def _grid_cells(points, valid, k, cell_scale_x100, h_scale=None):
+def _grid_cells(points, valid, k, cell_scale_x100):
     """Shared cell assignment: the r_k cell-size estimate (floored so the
     grid fits 10 bits/axis) and the packed per-point cell id. Used by
     BOTH the XLA engine below and the Mosaic kernel
@@ -70,8 +70,6 @@ def _grid_cells(points, valid, k, cell_scale_x100, h_scale=None):
     maxs = jnp.max(jnp.where(valid[:, None], points, -jnp.inf), axis=0)
     extent = jnp.max(maxs - mins)
     h = jnp.maximum(h, extent / (_GRID_MAX - 2) + 1e-12)
-    if h_scale is not None:
-        h = h * h_scale
 
     def quantize(hh):
         cell = jnp.clip(((points - mins) / hh).astype(jnp.int32),
@@ -231,6 +229,7 @@ def brick_knn(
     cell_scale: float = 1.4,
     max_cells: int | None = None,
     use_pallas: bool | None = None,
+    return_dropped: bool = False,
 ):
     """High-recall brick-grid self-query KNN (module docstring).
 
@@ -247,6 +246,12 @@ def brick_knn(
     True forces it in interpret mode off-TPU (tests). The kernel clears
     the low 10 mantissa bits of returned d² (≤ 2⁻¹³ relative); the XLA
     path is exact.
+
+    ``return_dropped``: also return the scalar count of points lost to
+    slot/budget overflow (they report all-False ``neighbor_valid`` rows)
+    — the in-graph channel for precision-sensitive callers; under an
+    outer jit no host-side warning can be emitted (see
+    :func:`_emit_drop_warning`).
     """
     points = jnp.asarray(points, jnp.float32)
     n = points.shape[0]
@@ -274,28 +279,35 @@ def brick_knn(
             points, points_valid, k, exclude_self,
             int(round(cell_scale * 100)), max_cells,
             interpret=not brickknn_pallas.available())
-        _emit_drop_warning(n_dropped, n)
-        return d, i, v
-
-    cc = min(chunk_cells, max(256, max_cells))
-    if max_cells % cc:  # static chunking needs a divisor-friendly budget
-        max_cells = ((max_cells + cc - 1) // cc) * cc
-    d, i, v, n_dropped = _brick_knn_impl(
-        points, points_valid, k, slots, cc, exclude_self,
-        int(round(cell_scale * 100)), max_cells)
+    else:
+        cc = min(chunk_cells, max(256, max_cells))
+        if max_cells % cc:  # static chunking needs a divisor-friendly budget
+            max_cells = ((max_cells + cc - 1) // cc) * cc
+        d, i, v, n_dropped = _brick_knn_impl(
+            points, points_valid, k, slots, cc, exclude_self,
+            int(round(cell_scale * 100)), max_cells)
     _emit_drop_warning(n_dropped, n)
+    if return_dropped:
+        return d, i, v, n_dropped
     return d, i, v
 
 
 def _emit_drop_warning(n_dropped, n_total) -> None:
-    """Surface the truncation count at runtime. Eager callers get a plain
-    host-side check; under an outer jit the count is a tracer, so attach a
-    debug callback — except on the axon backend, whose PJRT lacks host
-    callbacks entirely (UNIMPLEMENTED at dispatch): there nested-jit
-    consumers go unwarned rather than crashing."""
+    """Surface the truncation count at runtime — EAGER calls only.
+
+    Under an outer jit the count is a tracer and NOTHING is staged: a
+    ``jax.debug.callback`` here crashed round 3's bench at dispatch
+    (`UNIMPLEMENTED: axon_pjrt does not support host send/recv
+    callbacks`) because this image's TPU PJRT has no host-callback
+    support, and a backend-name guard proved unreliable
+    (``jax.default_backend()`` returns ``"tpu"`` on the axon platform).
+    Library kernels must not emit host callbacks from jitted code at
+    all: traced consumers observe drops through the returned
+    ``neighbor_valid`` mask (all-False rows — which
+    ``ops/pointcloud.statistical_outlier_removal`` treats as
+    conservatively invalid) or request the in-graph count via
+    ``return_dropped``."""
     if isinstance(n_dropped, jax.core.Tracer):
-        if jax.default_backend() != "axon":
-            jax.debug.callback(_warn_dropped, n_dropped, n_total)
         return
     _warn_dropped(n_dropped, n_total)
 

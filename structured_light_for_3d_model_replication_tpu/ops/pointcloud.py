@@ -151,22 +151,29 @@ def statistical_outlier_removal(
     """Open3D ``remove_statistical_outlier`` semantics
     (`server/processing.py:64`: nb=20, ratio=2.0): per point, mean distance
     to its nb nearest OTHER points; drop points whose mean exceeds
-    global_mean + std_ratio · global_std. Returns the surviving mask."""
+    global_mean + std_ratio · global_std. Returns the surviving mask.
+
+    Points with NO valid neighbors are undecidable and fail conservative:
+    they are excluded from the μ/σ statistics and removed. The approximate
+    large-N engines can produce such rows (brick slot/budget overflow,
+    `ops/brickknn.py`); giving them mean_d = 0 would instead make dropped
+    points unconditionally survive outlier removal."""
     n = points.shape[0]
     if valid is None:
         valid = jnp.ones(n, dtype=bool)
     d2, _, nbv = _self_knn(points, nb_neighbors, valid, True,
                            neighbor_method)
     d = jnp.sqrt(d2)
-    cnt = jnp.maximum(jnp.sum(nbv, axis=1), 1)
-    mean_d = jnp.sum(jnp.where(nbv, d, 0.0), axis=1) / cnt
+    cnt = jnp.sum(nbv, axis=1)
+    decidable = valid & (cnt > 0)
+    mean_d = jnp.sum(jnp.where(nbv, d, 0.0), axis=1) / jnp.maximum(cnt, 1)
 
-    vf = valid.astype(jnp.float32)
+    vf = decidable.astype(jnp.float32)
     nv = jnp.maximum(jnp.sum(vf), 1.0)
     mu = jnp.sum(mean_d * vf) / nv
     var = jnp.sum((mean_d - mu) ** 2 * vf) / nv
     thresh = mu + std_ratio * jnp.sqrt(var)
-    return valid & (mean_d <= thresh)
+    return decidable & (mean_d <= thresh)
 
 
 @functools.partial(jax.jit, static_argnames=("min_neighbors",

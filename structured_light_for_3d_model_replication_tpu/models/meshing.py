@@ -89,10 +89,10 @@ def mesh_from_cloud(
     2%, `server/processing.py:217`; pass 0.0 for fully watertight — the
     `mesh_360` GUI default, `server/gui.py:65`). ``mode="surface"`` trims
     hard (25%) as the ball-pivot substitute. ``depth`` ≤ 8 solves on a
-    2^depth dense grid; depth 9-12 routes to the band-sparse solver
-    (`ops/poisson_sparse.py`), covering the reference octree's default
-    depth 10 (`server/processing.py:293`); > 12 is rejected like the
-    reference rejects > 16 (`server/processing.py:207-208`).
+    2^depth dense grid; depth 9-16 routes to the band-sparse solver
+    (`ops/poisson_sparse.py`), covering the reference octree's full
+    acceptance envelope (default depth 10, `server/processing.py:293`;
+    ≤ 16 accepted, > 16 rejected, `server/processing.py:207-208`).
     """
     if mode not in ("watertight", "surface"):
         raise ValueError(f"unknown mesh mode {mode!r}")

@@ -223,6 +223,12 @@ export default function App() {
           adv.focusDistance = next.focusDistance;
         }
         if (next.zoom != null) adv.zoom = next.zoom;
+        // EV bias rides the auto-exposure pipeline: only meaningful when
+        // exposure is NOT forced manual (shutter/ISO untouched) — the
+        // path for devices that reject full manual control.
+        if (next.exposureCompensation != null && next.shutterMs == null &&
+            next.iso == null)
+          adv.exposureCompensation = next.exposureCompensation;
         adv.torch = next.torch;
         if (adv.exposureTime != null || adv.iso != null)
           adv.exposureMode = "manual";
@@ -306,6 +312,8 @@ export default function App() {
             {slider("Shutter (ms)", "shutterMs", { min: 1, max: 100 })}
             {slider("ISO", "iso", caps.iso)}
             {slider("Focus", "focusDistance", caps.focusDistance)}
+            {slider("Exp. comp (EV)", "exposureCompensation",
+                    caps.exposureCompensation)}
             {slider("Zoom", "zoom", caps.zoom)}
             {caps.torch && (
               <label>

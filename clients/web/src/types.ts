@@ -26,6 +26,10 @@ export interface ProSettings {
   iso: number | null;
   /** 0 = infinity focus; device-specific diopter scale. */
   focusDistance: number | null;
+  /** EV bias applied by the auto-exposure pipeline (the reference's pro
+   * slider, frotend/App.tsx:11,24) — useful when the device rejects full
+   * manual exposure but still honors a bias. */
+  exposureCompensation: number | null;
   zoom: number | null;
   torch: boolean;
 }
@@ -35,6 +39,7 @@ export const DEFAULT_PRO: ProSettings = {
   shutterMs: null,
   iso: null,
   focusDistance: null,
+  exposureCompensation: null,
   zoom: null,
   torch: false,
 };
@@ -58,6 +63,7 @@ export interface CameraCaps {
   exposureTime?: CapRange;
   iso?: CapRange;
   focusDistance?: CapRange;
+  exposureCompensation?: CapRange;
   zoom?: CapRange;
   torch?: boolean;
 }

@@ -32,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(nb=20, std=2.0 — the reference defaults)")
     p.add_argument("--plane", action="store_true",
                    help="color the dominant RANSAC plane green")
+    p.add_argument("--plane-threshold", type=float, default=None,
+                   help="RANSAC plane distance threshold; default derives "
+                        "from the cloud scale (bbox diagonal / 50 — ≈ the "
+                        "reference's 10.0 on its mm-scale scans)")
     p.add_argument("--compare", metavar="OTHER",
                    help="second cloud: render a before|after pair panel")
     p.add_argument("--icp", action="store_true",
@@ -109,13 +113,20 @@ def main(argv=None) -> int:
 
         from ..ops import segmentation
 
+        thresh = args.plane_threshold
+        if thresh is None:
+            # Scale-free default: a fixed 10.0 is the reference's unit
+            # choice; clouds in other units got an all-or-nothing preview.
+            diag = float(np.linalg.norm(
+                np.ptp(np.asarray(pts, np.float64), axis=0)))
+            thresh = max(diag / 50.0, 1e-9)
         _, inl = segmentation.segment_plane(
-            jnp.asarray(pts, jnp.float32), distance_threshold=10.0,
+            jnp.asarray(pts, jnp.float32), distance_threshold=thresh,
             num_iterations=1000)
         pm = np.asarray(inl)[: len(pts)]
         img = viz.render_plane_split(pts, pm, point_px=args.point_px, **kw)
-        print(f"plane: {int(pm.sum())}/{len(pts)} points on the plane",
-              file=sys.stderr)
+        print(f"plane: {int(pm.sum())}/{len(pts)} points on the plane "
+              f"(threshold {thresh:.3g})", file=sys.stderr)
     else:
         img = viz.render_points(pts, colors, point_px=args.point_px, **kw)
 

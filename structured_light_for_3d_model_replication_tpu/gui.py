@@ -76,6 +76,17 @@ class WorkerMixin:
         threading.Thread(target=runner, daemon=True, name=name).start()
 
 
+def selected_pose_dirs(all_pose_dirs, selection: dict) -> list:
+    """Pose-culling filter (the reference's pose-selection step,
+    `server/gui.py:500-523`): keep a pose directory iff its basename is
+    checked. With no analysis yet (empty selection) every pose is used —
+    the reference's 'all' answer."""
+    if not selection:
+        return list(all_pose_dirs)
+    return [d for d in all_pose_dirs
+            if selection.get(os.path.basename(d), False)]
+
+
 class ScannerGUI(WorkerMixin):
     """Six-tab Tk application. Instantiate with a ``tk.Tk()`` root."""
 
@@ -249,7 +260,18 @@ class ScannerGUI(WorkerMixin):
         self._entry(t, "Pose index", self.var_pose)
         self._button(t, "Capture pose", self.do_calib_capture)
         self._button(t, "Analyze poses (reprojection)", self.do_calib_analyze)
-        self._button(t, "Final stereo calibration", self.do_calib_final)
+        # Pose-culling list (the reference's prompt_pose_selection dialog,
+        # `server/gui.py:500-523`): Analyze fills one checkbox per pose
+        # with its reprojection errors; Final calibrates on the CHECKED
+        # subset only (all poses until an analysis has run).
+        self._row(t, "Poses (after analyze)",
+                  lambda f: self.ttk.Label(f, text="all (run Analyze to "
+                                                   "cull)"))
+        self.pose_list_frame = self.ttk.Frame(t)
+        self.pose_list_frame.pack(fill="x", padx=30)
+        self.pose_checks: dict = {}
+        self._button(t, "Final stereo calibration (selected poses)",
+                     self.do_calib_final)
         self._entry(t, "Calibration file", self.var_calib_file)
 
     def _need_scanner(self):
@@ -276,18 +298,41 @@ class ScannerGUI(WorkerMixin):
         def work():
             return calibration.analyze_calibration(calib_dir)
 
-        self.run_bg(
-            "calib-analyze", work,
-            lambda res: self.log_line(
-                "per-pose reprojection errors: " + ", ".join(
-                    f"{os.path.basename(p)}={e:.3f}"
-                    for e, p in zip(res[0], res[1]))))
+        def done(res):
+            errors, _poses = res
+            self.log_line("per-pose reprojection (px): " + ", ".join(
+                f"{p}: cam={ce:.2f} proj={pe:.2f}"
+                for p, (ce, pe) in errors.items()))
+            self._populate_pose_checks(errors)
+
+        self.run_bg("calib-analyze", work, done,
+                    on_error=lambda e: self.log_line(f"analyze failed: {e}"))
+
+    def _populate_pose_checks(self, errors):
+        """Rebuild the pose-culling checkboxes from an analysis result
+        ({pose: (cam_err, proj_err)}); everything starts checked, like the
+        reference's 'all' default (`server/gui.py:514-515`)."""
+        for child in self.pose_list_frame.winfo_children():
+            child.destroy()
+        self.pose_checks = {}
+        for pose, (ce, pe) in errors.items():
+            var = self.tk.BooleanVar(value=True)
+            self.ttk.Checkbutton(
+                self.pose_list_frame,
+                text=f"{pose}   cam={ce:.2f}px  proj={pe:.2f}px",
+                variable=var).pack(anchor="w")
+            self.pose_checks[pose] = var
 
     def do_calib_final(self):
         from . import calibration
 
         out = self.var_calib_file.get()
-        pose_dirs = self.layout.pose_dirs()
+        selection = {p: bool(v.get()) for p, v in self.pose_checks.items()}
+        pose_dirs = selected_pose_dirs(self.layout.pose_dirs(), selection)
+        if len(pose_dirs) < 3:
+            self.log_line(f"need >= 3 selected poses ({len(pose_dirs)} "
+                          f"checked)")
+            return
 
         def work():
             return calibration.calibrate_final(pose_dirs, out)
@@ -295,7 +340,8 @@ class ScannerGUI(WorkerMixin):
         self.run_bg("calib-final", work,
                     lambda res: self.log_line(
                         f"calibration saved -> {out} "
-                        f"(stereo RMS {res[1].rms:.3f})"))
+                        f"({len(pose_dirs)} poses, "
+                        f"stereo RMS {res[1].rms:.3f})"))
 
     # ------------------------------------------------------------------
     # Tab 3: scanning (`server/gui.py:686-773`)

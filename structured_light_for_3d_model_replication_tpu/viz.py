@@ -169,17 +169,23 @@ def _splat(img, zbuf, u, v, z, colors, point_px):
 def render_points(points, colors=None, *, width: int = 960,
                   height: int = 720, azim: float = 30.0, elev: float = 20.0,
                   zoom: float = 2.1, point_px: int = 2,
-                  bg=BACKGROUND) -> np.ndarray:
+                  bg=BACKGROUND, camera=None) -> np.ndarray:
     """Render a point cloud to an (H, W, 3) uint8 image.
 
     ``colors``: (N, 3) uint8/float per-point colors, or None for depth-cued
-    grey. Empty clouds render as background.
+    grey. Empty clouds render as background. ``camera``: optional
+    precomputed ``(R, eye)`` pose overriding the per-cloud orbit fit — for
+    multi-panel renders that must share one viewpoint (see
+    :func:`render_pair`).
     """
     pts = np.asarray(points, np.float64).reshape(-1, 3)
     img = _blank(width, height, bg)
     if pts.shape[0] == 0:
         return img
-    R, eye, radius = _orbit_camera(pts, azim, elev, zoom)
+    if camera is None:
+        R, eye, _ = _orbit_camera(pts, azim, elev, zoom)
+    else:
+        R, eye = camera
     u, v, z, ok = _project(pts, R, eye, width, height)
     if colors is None:
         # Depth cue: nearer → brighter.
@@ -290,17 +296,32 @@ def render_plane_split(points, plane_mask, **kw) -> np.ndarray:
 
 
 def render_pair(source, target, transform=None, *, width: int = 1280,
-                height: int = 480, point_px: int = 2, **kw) -> np.ndarray:
+                height: int = 480, point_px: int = 2, azim: float = 30.0,
+                elev: float = 20.0, zoom: float = 2.1, **kw) -> np.ndarray:
     """Before/after registration panel — the offline twin of
     `Old/New360.py:72-73`.
 
     Left half: source (orange) and target (blue) as given. Right half: the
     same pair with ``transform`` (4×4, applied to source). With
-    ``transform=None`` both halves show the raw pair.
+    ``transform=None`` both halves show the raw pair. BOTH panels share one
+    camera, fitted to the union of {source, moved source, target} — a
+    per-panel orbit fit would change viewpoint/scale when the transform
+    moves the source, making the halves incomparable.
     """
     src = np.asarray(source, np.float64).reshape(-1, 3)
     dst = np.asarray(target, np.float64).reshape(-1, 3)
     half_w = width // 2
+
+    if transform is not None:
+        t = np.asarray(transform, np.float64).reshape(4, 4)
+        moved = src @ t[:3, :3].T + t[:3, 3]
+    else:
+        moved = src
+    union = np.concatenate([src, moved, dst], axis=0)
+    cam = None
+    if union.shape[0]:
+        R, eye, _ = _orbit_camera(union, azim, elev, zoom)
+        cam = (R, eye)
 
     def panel(s):
         pts = np.concatenate([s, dst], axis=0)
@@ -308,15 +329,10 @@ def render_pair(source, target, transform=None, *, width: int = 1280,
             [np.tile(np.uint8(PAIR_ORANGE), (len(s), 1)),
              np.tile(np.uint8(PAIR_BLUE), (len(dst), 1))], axis=0)
         return render_points(pts, cols, width=half_w, height=height,
-                             point_px=point_px, **kw)
+                             point_px=point_px, camera=cam, **kw)
 
     left = panel(src)
-    if transform is not None:
-        t = np.asarray(transform, np.float64).reshape(4, 4)
-        moved = src @ t[:3, :3].T + t[:3, 3]
-        right = panel(moved)
-    else:
-        right = panel(src)
+    right = panel(moved)
     out = np.concatenate([left, right], axis=1)
     out[:, half_w - 1:half_w + 1] = 90  # seam
     return out

@@ -63,6 +63,20 @@ def test_worker_mixin_routes_errors():
     assert errs == ["boom"]
 
 
+def test_selected_pose_dirs_culls_by_basename():
+    """Pose-culling contract (reference `server/gui.py:500-523`): checked
+    poses survive, unchecked are culled, no analysis yet = use all."""
+    dirs = ["/s/calib/pose_1", "/s/calib/pose_2", "/s/calib/pose_3"]
+    # No analysis yet: everything.
+    assert gui.selected_pose_dirs(dirs, {}) == dirs
+    sel = {"pose_1": True, "pose_2": False, "pose_3": True}
+    assert gui.selected_pose_dirs(dirs, sel) == [dirs[0], dirs[2]]
+    # Poses missing from the selection (new capture after analyze) are
+    # conservatively excluded rather than silently included.
+    sel2 = {"pose_1": True}
+    assert gui.selected_pose_dirs(dirs, sel2) == [dirs[0]]
+
+
 def test_worker_runs_off_ui_thread():
     w = gui.WorkerMixin()
     w._init_worker(FakeRoot())

@@ -263,6 +263,12 @@ def stratified_indices(valid: jnp.ndarray, m: int):
     u = jax.lax.associative_scan(jnp.maximum, t - j)
     t = jnp.minimum(u + j, jnp.maximum(n_valid, 1))
     targets = jnp.where(n_valid > m, t, j + 1)
+    # searchsorted, deliberately: m ≪ n here (16k queries over a 2M-row
+    # cumsum), so m·log n binary-search reads beat building an n-row
+    # rank→index table — measured on the tunneled v5e: 221 ms vs 371 ms
+    # per 24-stop ring even with a unique+drop scatter (non-unique
+    # scatter was 475 ms). The opposite geometry (queries ≫ table) is
+    # where sort-merge wins — see ops/poisson_sparse.py:_rank_lookup1.
     idx = jnp.searchsorted(rank, targets, side="left").astype(jnp.int32)
     idx = jnp.minimum(idx, n - 1)
     out_valid = j < jnp.minimum(n_valid, m)
@@ -378,7 +384,10 @@ def estimate_normals(
     cnt = jnp.maximum(jnp.sum(w, axis=1), 1.0)  # (N, 1)
     mu = jnp.sum(nbr * w, axis=1) / cnt
     xc = (nbr - mu[:, None, :]) * w
-    # Batched 3×3 covariances: one einsum, MXU-friendly.
+    # Batched 3×3 covariances: one einsum, MXU-friendly. (A 6-unique-
+    # entry elementwise variant — the sor_normals trick — measured SLOWER
+    # here, 233 vs 180 ms per 24-ring: the (N,k,6) gather-expand costs
+    # more than the tiny-matmul einsum.)
     C = jnp.einsum("nki,nkj->nij", xc, xc,
                    precision=jax.lax.Precision.HIGHEST) / cnt[..., None]
     normals = smallest_eigenvector_sym3(C)

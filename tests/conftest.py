@@ -23,6 +23,17 @@ import jax  # noqa: E402
 # in-process config update wins as long as no backend has initialized yet.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache SHARED across test processes and runs: the
+# heavy fixtures (fused scan360 pipelines, registration scans, sparse
+# Poisson) are compile-dominated on the CPU mesh; one warm cache cuts the
+# suite wall-clock by the full compile share on every rerun (VERDICT r3
+# weak #8). Kept separate from the TPU cache (.jax_cache) — entries are
+# platform-specific and interleaving them churns both.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache_cpu"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

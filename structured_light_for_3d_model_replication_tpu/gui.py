@@ -130,7 +130,7 @@ class ScannerGUI(WorkerMixin):
         self.var_voxel = tk.DoubleVar(value=0.02)
         self.var_mesh_in = tk.StringVar(value="merged.ply")
         self.var_mesh_out = tk.StringVar(value="model.stl")
-        self.var_mesh_depth = tk.IntVar(value=8)  # ≤8 dense; 9-12 sparse solver
+        self.var_mesh_depth = tk.IntVar(value=8)  # ≤8 dense; 9-16 sparse solver
         self.var_mesh_trim = tk.DoubleVar(value=0.0)
         self.var_mesh_orient = tk.StringVar(value="radial")
         self.var_status = tk.StringVar(value="disconnected")

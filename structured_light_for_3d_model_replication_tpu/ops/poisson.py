@@ -137,7 +137,7 @@ def divergence(V: jnp.ndarray) -> jnp.ndarray:
 
 @functools.partial(jax.jit, static_argnames=("resolution", "cg_iters"))
 def _solve(points, normals, valid, resolution: int, cg_iters: int,
-           screen: float):
+           screen: float, rtol=1e-4):
     R = resolution
     grid_pts, origin, scale = normalize_points(points, valid, R)
     vw = splat(grid_pts, jnp.concatenate(
@@ -154,30 +154,43 @@ def _solve(points, normals, valid, resolution: int, cg_iters: int,
     def A(x):
         return laplacian(x) - W * x
 
-    # Plain CG (A is symmetric negative-definite with the screen term; CG on
-    # -A). Fixed iteration count keeps the program shape-static.
+    # Jacobi-preconditioned CG on -A (symmetric positive-definite with the
+    # screen term); the diagonal 6 + W removes the screening term's
+    # density variation, the same preconditioner as the band-sparse
+    # solver's fine CG (`ops/poisson_sparse.py:_cg_sparse` — measured
+    # ~2.5× fewer iterations to tolerance there). ``cg_iters`` caps the
+    # loop; the residual stop usually ends it sooner.
     b = -rhs
 
     def matvec(x):
         return -A(x)
 
+    dinv = 1.0 / (6.0 + W)
     x0 = jnp.zeros((R, R, R), jnp.float32)
     r0 = b - matvec(x0)
-    p0 = r0
-    rs0 = jnp.vdot(r0, r0)
+    z0 = dinv * r0
+    rz0 = jnp.vdot(r0, z0)
+    rtolf = jnp.float32(rtol)
+    tol2 = rtolf * rtolf * jnp.vdot(b, b)
 
-    def body(_, state):
-        x, r, p, rs = state
+    def cond(state):
+        _, _, _, _, rs, it = state
+        return (it < cg_iters) & (rs > tol2)
+
+    def body(state):
+        x, r, p, rz, _, it = state
         Ap = matvec(p)
-        alpha = rs / jnp.maximum(jnp.vdot(p, Ap), 1e-30)
+        alpha = rz / jnp.maximum(jnp.vdot(p, Ap), 1e-30)
         x = x + alpha * p
         r = r - alpha * Ap
-        rs_new = jnp.vdot(r, r)
-        beta = rs_new / jnp.maximum(rs, 1e-30)
-        p = r + beta * p
-        return x, r, p, rs_new
+        z = dinv * r
+        rz_new = jnp.vdot(r, z)
+        beta = rz_new / jnp.maximum(rz, 1e-30)
+        p = z + beta * p
+        return x, r, p, rz_new, jnp.vdot(r, r), it + 1
 
-    chi, _, _, _ = jax.lax.fori_loop(0, cg_iters, body, (x0, r0, p0, rs0))
+    chi, _, _, _, _, _ = jax.lax.while_loop(
+        cond, body, (x0, r0, z0, rz0, jnp.vdot(r0, r0), jnp.int32(0)))
 
     # Iso level: density-weighted mean of chi at the samples.
     chi_at_pts = gather(chi, grid_pts)
@@ -187,13 +200,16 @@ def _solve(points, normals, valid, resolution: int, cg_iters: int,
 
 
 def reconstruct(points, normals, valid=None, depth: int = 6,
-                cg_iters: int = 300, screen: float = 4.0) -> PoissonGrid:
+                cg_iters: int = 300, screen: float = 4.0,
+                rtol: float = 1e-4) -> PoissonGrid:
     """Screened-Poisson solve on a 2^depth dense grid.
 
     Drop-in for the solve half of `create_from_point_cloud_poisson`
     (`server/processing.py:212,293`); extraction is :func:`.marching.extract`.
     ``depth`` > 8 is rejected like the reference rejects > 16
     (`server/processing.py:207-208`) — dense 512³ does not fit sanely.
+    ``cg_iters`` caps the PCG; the residual stop (``rtol``, same knob as
+    :func:`..poisson_sparse.reconstruct_sparse`) usually ends it sooner.
     """
     if depth > 8:
         raise ValueError(
@@ -203,4 +219,5 @@ def reconstruct(points, normals, valid=None, depth: int = 6,
     normals = jnp.asarray(normals, jnp.float32)
     if valid is None:
         valid = jnp.ones(points.shape[0], dtype=bool)
-    return _solve(points, normals, valid, 2 ** depth, cg_iters, screen)
+    return _solve(points, normals, valid, 2 ** depth, cg_iters, screen,
+                  rtol)

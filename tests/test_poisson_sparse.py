@@ -235,6 +235,38 @@ def test_sparse_depth14_wide_keys_accepted(rng):
     assert np.median(err) < 2.0, np.median(err)
 
 
+@pytest.mark.slow
+def test_sparse_depth16_envelope_smoke(rng):
+    """Depth 16 (65536³ virtual) — the far end of the reference's
+    acceptance envelope (`server/processing.py:207-208`). At this
+    fineness a sparse cloud's band is isolated specks, so no coherent
+    surface exists to assert against; what this pins is the envelope
+    itself: the solve ACCEPTS depth 16, the wide key pair carries block
+    coordinates beyond the depth-14 range, the band stays within budget,
+    and the solver returns finite fields."""
+    pts, nrm = _sphere_cloud(rng, 1500, r=50.0)
+    anchors = np.asarray(
+        [[s * 100.0, t * 100.0, u * 100.0]
+         for s in (-1, 1) for t in (-1, 1) for u in (-1, 1)], np.float32)
+    pts = np.vstack([pts, anchors])
+    nrm = np.vstack([nrm, np.tile([1.0, 0.0, 0.0], (8, 1))]).astype(
+        np.float32)
+
+    sgrid, n_blocks = poisson_sparse.reconstruct_sparse(
+        pts, nrm, depth=16, cg_iters=4, max_blocks=49_152,
+        coarse_depth=6, coarse_iters=60)
+    nb = int(n_blocks)
+    assert 0 < nb <= 49_152
+    coords = np.asarray(sgrid.block_coords)[np.asarray(sgrid.block_valid)]
+    # Block grid is 8192 per axis: coordinates must use the range the
+    # depth-14 test never reaches (its grid caps at 2048).
+    assert coords.max() > 2048
+    assert coords.max() < 8192
+    chi = np.asarray(sgrid.chi)
+    assert np.isfinite(chi).all()
+    assert np.abs(chi).sum() > 0.0
+
+
 def test_wide_key_rank_lookup_matches_narrow():
     """The sort-merge pair lookup agrees with searchsorted on a shared
     random table (the wide path's only novel primitive)."""

@@ -536,7 +536,11 @@ def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
 
 @functools.partial(jax.jit, static_argnames=("cg_iters",))
 def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int,
-               rtol=jnp.float32(1e-4)):
+               rtol=3e-4):
+    # rtol default is a PLAIN float (and matches the public 3e-4): a
+    # jnp.float32 default would evaluate at import time and initialize
+    # the XLA backend, breaking jax.distributed for multi-host users
+    # (the same rule as the module-level _BIG comment).
     """Jacobi-preconditioned CG. All state is FLAT (M, BS³): the loop
     carry materializes with the buffer layout, and a (…,8,8,8) carry pads
     16× under the (8,128) tile — the 16 GB allocation that originally

@@ -235,6 +235,51 @@ def test_sparse_depth14_wide_keys_accepted(rng):
     assert np.median(err) < 2.0, np.median(err)
 
 
+def test_rtol_forwards_to_coarse_solve(rng, monkeypatch):
+    """reconstruct_sparse must hand its rtol to the coarse dense solve:
+    the coarse chi becomes the fine band's Dirichlet halo, so coarse
+    accuracy bounds what a caller's rtol can buy."""
+    from structured_light_for_3d_model_replication_tpu.ops import poisson
+
+    seen = {}
+    real = poisson._solve
+
+    def spy(points, normals, valid, res, iters, screen, rtol=3e-4):
+        seen["rtol"] = float(rtol)
+        return real(points, normals, valid, res, iters, screen, rtol=rtol)
+
+    monkeypatch.setattr(poisson_sparse.dense_poisson, "_solve", spy)
+    pts, nrm = _sphere_cloud(rng, 3_000)
+    poisson_sparse.reconstruct_sparse(
+        pts, nrm, depth=7, cg_iters=4, max_blocks=8192, coarse_depth=6,
+        coarse_iters=20, rtol=2e-3)
+    assert seen["rtol"] == pytest.approx(2e-3)
+
+
+def test_rtol_knob_stops_fine_cg_earlier(rng):
+    """The rtol plumb: a looser tolerance must stop the fine CG earlier,
+    pinning the measured-equal 3e-4 default's machinery."""
+    import jax.numpy as jnp
+
+    pts, nrm = _sphere_cloud(rng, 8_000)
+    valid = jnp.ones(pts.shape[0], bool)
+    setup = poisson_sparse._setup_sparse(
+        jnp.asarray(pts), jnp.asarray(nrm), valid, 2 ** 7, 8192,
+        jnp.float32(4.0))
+    (rhs, W, nbr, bvalid, bcoords, *_rest) = setup
+    from structured_light_for_3d_model_replication_tpu.ops import poisson
+
+    coarse = poisson._solve(jnp.asarray(pts), jnp.asarray(nrm), valid,
+                            2 ** 6, 300, jnp.float32(4.0))
+    b, x0 = poisson_sparse._prolong_band(coarse.chi, rhs, nbr, bvalid,
+                                         bcoords, 2 ** 7, 2 ** 6)
+    _, it_tight = poisson_sparse._cg_sparse(b, W, x0, nbr, bvalid, 300,
+                                            jnp.float32(1e-5))
+    _, it_loose = poisson_sparse._cg_sparse(b, W, x0, nbr, bvalid, 300,
+                                            jnp.float32(1e-2))
+    assert int(it_loose) < int(it_tight), (int(it_loose), int(it_tight))
+
+
 @pytest.mark.slow
 def test_sparse_depth16_envelope_smoke(rng):
     """Depth 16 (65536³ virtual) — the far end of the reference's

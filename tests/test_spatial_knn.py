@@ -267,6 +267,37 @@ def test_jitted_brick_consumers_stage_no_host_callbacks(rng):
         jax.block_until_ready(out)
 
 
+def test_no_host_callbacks_anywhere_in_package():
+    """Package-wide invariant behind the round-3 postmortem: library code
+    must never stage host callbacks (`jax.debug.callback`, `jax.pure_
+    callback`, `io_callback`, legacy `host_callback`) — the TPU PJRT this
+    framework targets has no host send/recv support, and a callback
+    traced into any consumer's jit crashes at dispatch. Surfacing
+    runtime conditions belongs in returned values (masks, counts) and
+    eager-boundary logging."""
+    import io
+    import pathlib
+    import tokenize
+
+    pkg = (pathlib.Path(__file__).resolve().parent.parent
+           / "structured_light_for_3d_model_replication_tpu")
+    banned = ("debug.callback", "pure_callback", "io_callback",
+              "host_callback")
+    hits = []
+    for py in pkg.rglob("*.py"):
+        # Scan CODE tokens only — docstrings and comments legitimately
+        # cite these names when documenting why they are banned.
+        toks = tokenize.generate_tokens(
+            io.StringIO(py.read_text()).readline)
+        code = "".join(t.string for t in toks
+                       if t.type not in (tokenize.STRING,
+                                         tokenize.COMMENT))
+        for b in banned:
+            if b in code:
+                hits.append(f"{py.name}: {b}")
+    assert not hits, f"host-callback use in library code: {hits}"
+
+
 def test_brick_drops_fail_conservative_in_sor(rng):
     """Points lost to brick slot overflow report all-False neighbor rows;
     SOR must treat them as undecidable and REMOVE them (VERDICT r3 weak

@@ -281,7 +281,12 @@ def test_no_host_callbacks_anywhere_in_package():
 
     pkg = (pathlib.Path(__file__).resolve().parent.parent
            / "structured_light_for_3d_model_replication_tpu")
-    banned = ("debug.callback", "pure_callback", "io_callback",
+    # Bare names too, not just dotted calls: an aliased import
+    # (`from jax.debug import callback as cb`, `from jax import
+    # pure_callback as pc`) still spells the banned name at its import
+    # site, and `jax.debug` bans the module path wholesale (nothing in
+    # it is library-safe on this backend).
+    banned = ("jax.debug", "pure_callback", "io_callback",
               "host_callback")
     hits = []
     for py in pkg.rglob("*.py"):

@@ -252,7 +252,8 @@ export default function App() {
   const slider = (
     label: string,
     key: keyof ProSettings,
-    range?: { min: number; max: number; step?: number }
+    range?: { min: number; max: number; step?: number },
+    inert?: { disabled: boolean; hint: string }
   ) =>
     range && (
       <label className="slider">
@@ -263,11 +264,14 @@ export default function App() {
           max={range.max}
           step={range.step ?? (range.max - range.min) / 100}
           value={(pro[key] as number | null) ?? range.min}
+          disabled={inert?.disabled ?? false}
           onChange={(e) =>
             void applyPro({ ...pro, [key]: Number(e.target.value) })
           }
         />
-        <span>{String(pro[key] ?? "auto")}</span>
+        <span>
+          {inert?.disabled ? inert.hint : String(pro[key] ?? "auto")}
+        </span>
       </label>
     );
 
@@ -312,8 +316,14 @@ export default function App() {
             {slider("Shutter (ms)", "shutterMs", { min: 1, max: 100 })}
             {slider("ISO", "iso", caps.iso)}
             {slider("Focus", "focusDistance", caps.focusDistance)}
+            {/* EV bias rides auto-exposure only — applyPro drops it once
+                shutter or ISO forces manual mode, so reflect that in the
+                control instead of leaving a silently inert slider. */}
             {slider("Exp. comp (EV)", "exposureCompensation",
-                    caps.exposureCompensation)}
+                    caps.exposureCompensation, {
+                      disabled: pro.shutterMs != null || pro.iso != null,
+                      hint: "n/a in manual exposure",
+                    })}
             {slider("Zoom", "zoom", caps.zoom)}
             {caps.torch && (
               <label>

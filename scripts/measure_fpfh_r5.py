@@ -1,0 +1,83 @@
+"""Round-5 on-TPU measurement: gather vs brick FPFH at the ring
+preprocess shape (24 views x 8192 pts, voxel 3.0), plus the rewritten
+brick_knn rescue-pass cost at 1M. Not part of the test suite — a
+measure-first harness (run alone; never concurrently with another TPU
+process)."""
+
+import statistics
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.models import merge  # noqa: E402
+from structured_light_for_3d_model_replication_tpu.ops.brickknn import (  # noqa: E402
+    brick_knn,
+)
+
+rng = np.random.default_rng(0)
+
+
+def view(i):
+    u = rng.normal(size=(8192, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    r = 80 + 8 * np.sin(4 * u[:, 0] + i) * np.cos(3 * u[:, 1])
+    p = u * r[:, None] + np.asarray([0.0, 10.0, 500.0])
+    return p.astype(np.float32)
+
+
+pts = jax.device_put(jnp.asarray(np.stack([view(i) for i in range(24)])))
+val = jnp.ones((24, 8192), bool)
+jax.block_until_ready(pts)
+
+for engine in ("gather", "brick"):
+    f = jax.jit(jax.vmap(
+        lambda p, v: merge._preprocess(p, v, 3.0, 30, 100, engine)))
+
+    def run(rep):
+        o = f(pts + jnp.float32(0.001 * rep), val)
+        np.asarray(jnp.sum(o[3]) + jnp.sum(o[2]))
+
+    t0 = time.perf_counter()
+    run(-1)  # compile+warm
+    warm = time.perf_counter() - t0
+    times = []
+    for rep in range(5):
+        t0 = time.perf_counter()
+        run(rep)
+        times.append((time.perf_counter() - t0) * 1e3)
+    print(f"preprocess[{engine}]: median {statistics.median(times):.1f} ms "
+          f"(runs {[round(t) for t in times]}, warm/compile {warm:.1f} s)",
+          flush=True)
+
+# Rescue-pass cost at 1M (bench config 3b shape).
+theta = rng.uniform(0, 2 * np.pi, 1 << 20)
+zz = rng.uniform(-80, 80, 1 << 20)
+cloud = np.stack([80 * np.cos(theta), zz, 80 * np.sin(theta) + 500],
+                 1).astype(np.float32)
+cloud += rng.normal(0, 0.5, cloud.shape).astype(np.float32)
+pts1m = jax.device_put(jnp.asarray(cloud))
+jax.block_until_ready(pts1m)
+
+for rescue in (False, True):
+    def run_knn(rep):
+        out = brick_knn(pts1m + jnp.float32(0.001 * rep), 20,
+                        exclude_self=True, rescue=rescue,
+                        return_dropped=True)
+        np.asarray(jnp.sum(out[0]))
+        return out[3]
+
+    run_knn(-1)
+    times = []
+    for rep in range(3):
+        t0 = time.perf_counter()
+        nd = run_knn(rep)
+        times.append((time.perf_counter() - t0) * 1e3)
+    print(f"brick_knn[rescue={rescue}]: median "
+          f"{statistics.median(times):.0f} ms, dropped={int(nd)}",
+          flush=True)

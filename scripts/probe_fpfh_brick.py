@@ -1,0 +1,153 @@
+"""Stage-isolation probe for the brick FPFH cost on TPU: which part of
+the 2.7 s (vs 0.7 s gather) is the money — brick gathers, pair d2+mask,
+Darboux trig, or the one-hot histogram? Variants run the real layout
+with later stages replaced by cheap reductions. Measure-first harness;
+run alone."""
+
+import statistics
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import features_brick as fb  # noqa: E402
+from structured_light_for_3d_model_replication_tpu.ops import features  # noqa: E402
+from structured_light_for_3d_model_replication_tpu.ops.brickknn import (  # noqa: E402
+    _sorted_segments,
+)
+
+rng = np.random.default_rng(0)
+
+
+def view(i):
+    u = rng.normal(size=(8192, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    r = 80 + 8 * np.sin(4 * u[:, 0] + i) * np.cos(3 * u[:, 1])
+    p = u * r[:, None] + np.asarray([0.0, 10.0, 500.0])
+    return p.astype(np.float32)
+
+
+pts = jax.device_put(jnp.asarray(np.stack([view(i) for i in range(24)])))
+nrm = pts / jnp.linalg.norm(pts, axis=-1, keepdims=True)  # fake but unit
+val = jnp.ones((24, 8192), bool)
+jax.block_until_ready((pts, nrm))
+RADIUS = 15.0
+
+
+def timeit(f, label):
+    def run(rep):
+        o = f(pts + jnp.float32(0.001 * rep), nrm, val)
+        np.asarray(sum(jnp.sum(x) for x in jax.tree.leaves(o)))
+
+    run(-1)
+    times = []
+    for rep in range(4):
+        t0 = time.perf_counter()
+        run(rep)
+        times.append((time.perf_counter() - t0) * 1e3)
+    print(f"{label}: median {statistics.median(times):.0f} ms "
+          f"({[round(t) for t in times]})", flush=True)
+
+
+def staged(stage, slots, chunk_rows=512):
+    """stage: 'sort' | 'gather' | 'mask' | 'spfh' | 'full'."""
+    S, M = slots, 1024
+
+    def one(p, nv, v):
+        n = p.shape[0]
+        cid = fb._cell_ids(p, v, jnp.float32(RADIUS))
+        (cid_s, pts_s, val_s, orig_s, _f, _r, ok, dest,
+         ucid) = _sorted_segments(p, v, cid, S, M)
+        if stage == "sort":
+            return (pts_s, dest)
+        nrm_s = nv[orig_s]
+
+        def brick(vals, fill, dtype):
+            shape = (M * S + 1,) + vals.shape[1:]
+            t = jnp.full(shape, fill, dtype).at[dest].set(vals)
+            return t[:-1].reshape((M, S) + vals.shape[1:])
+
+        bp = brick(pts_s, 0.0, jnp.float32)
+        bn = brick(nrm_s, 0.0, jnp.float32)
+        bv = brick(ok, False, bool)
+        bo = brick(orig_s, -1, jnp.int32)
+        pad = lambda t, fill: jnp.concatenate(
+            [t, jnp.full((1,) + t.shape[1:], fill, t.dtype)])
+        bppad, bnpad, bvpad, bopad = (pad(bp, 0.0), pad(bn, 0.0),
+                                      pad(bv, False), pad(bo, -1))
+        nbr = fb._row_neighbor_bricks(cid_s, ucid, M)
+
+        hi = jax.lax.Precision.HIGHEST
+        r2 = jnp.float32(RADIUS * RADIUS)
+
+        def chunkf(args):
+            q, qn, qo, qv, nb = args
+            c = q.shape[0]
+            kp = bppad[nb].reshape(c, 27 * S, 3)
+            kv = bvpad[nb].reshape(c, 27 * S)
+            ko = bopad[nb].reshape(c, 27 * S)
+            kn = bnpad[nb].reshape(c, 27 * S, 3)
+            if stage == "gather":
+                return (jnp.sum(kp, axis=(1, 2)) + jnp.sum(kn, axis=(1, 2))
+                        + jnp.sum(kv, axis=1) + jnp.sum(ko, axis=1))
+            q2 = jnp.sum(q * q, axis=-1, keepdims=True)
+            p2 = jnp.sum(kp * kp, axis=-1)
+            cross = jnp.einsum("cd,cnd->cn", q, kp, precision=hi)
+            d2 = q2 + p2 - 2.0 * cross
+            pair_ok = kv & (d2 <= r2) & (ko != qo[:, None]) & qv[:, None]
+            if stage == "mask":
+                return jnp.sum(pair_ok, axis=1) + jnp.sum(kn[..., 0], axis=1)
+            dvec = kp - q[:, None, :]
+            dist = jnp.sqrt(jnp.maximum(jnp.sum(dvec * dvec, -1), 1e-20))
+            dn = dvec / dist[..., None]
+            u = jnp.broadcast_to(qn[:, None, :], dvec.shape)
+            vv = jnp.cross(u, dn)
+            v_norm = jnp.linalg.norm(vv, axis=-1, keepdims=True)
+            vv = vv / jnp.where(v_norm > 1e-12, v_norm, 1.0)
+            w = jnp.cross(u, vv)
+            alpha = jnp.sum(vv * kn, axis=-1)
+            phi = jnp.sum(u * dn, axis=-1)
+            theta = jnp.arctan2(jnp.sum(w * kn, axis=-1),
+                                jnp.sum(u * kn, axis=-1))
+            bins = jnp.stack([fb._bin(alpha, -1.0, 1.0),
+                              fb._bin(phi, -1.0, 1.0),
+                              fb._bin(theta, -jnp.pi, jnp.pi)], axis=-1)
+            onehot = jax.nn.one_hot(bins, 11, dtype=jnp.float32)
+            onehot = onehot * pair_ok[..., None, None]
+            spfh = onehot.sum(axis=1).reshape(c, 33)
+            return spfh
+
+        padr = (-n) % chunk_rows
+
+        def padded(x, fill):
+            return jnp.concatenate(
+                [x, jnp.full((padr,) + x.shape[1:], fill, x.dtype)]
+            ) if padr else x
+
+        def chunked(x):
+            return x.reshape((-1, chunk_rows) + x.shape[1:])
+
+        out = jax.lax.map(chunkf, (chunked(padded(pts_s, 0.0)),
+                                   chunked(padded(nrm_s, 0.0)),
+                                   chunked(padded(orig_s, -1)),
+                                   chunked(padded(val_s, False)),
+                                   chunked(padded(nbr, M))))
+        return out
+
+    return jax.jit(jax.vmap(one))
+
+
+timeit(jax.jit(jax.vmap(
+    lambda p, nv, v: features.fpfh(p, nv, RADIUS, valid=v, max_nn=100))),
+    "gather-full (incl its knn)")
+for slots in (32, 48):
+    for stage in ("sort", "gather", "mask", "spfh"):
+        timeit(staged(stage, slots), f"brick[{stage},S={slots}]")
+timeit(jax.jit(jax.vmap(
+    lambda p, nv, v: fb.fpfh_brick(p, nv, RADIUS, valid=v, slots=32))),
+    "brick-full[S=32]")

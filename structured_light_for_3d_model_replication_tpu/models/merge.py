@@ -40,7 +40,14 @@ import numpy as np
 
 from ..io import ply as ply_io
 from ..io.layout import list_clouds
-from ..ops import features, pointcloud, posegraph, registration, segmentation
+from ..ops import (
+    features,
+    features_brick,
+    pointcloud,
+    posegraph,
+    registration,
+    segmentation,
+)
 from ..ops.knn import knn
 from ..ops.sor_normals import sor_normals as sor_normals_fused
 from ..utils.log import get_logger
@@ -64,6 +71,16 @@ class MergeParams:
     icp_iterations: int = 30
     fpfh_max_nn: int = 100
     normals_k: int = 30
+    # FPFH engine for the per-view preprocess: "gather" = neighbor-list
+    # form over the shared KNN sweep (`ops/features.py`), "brick" =
+    # sorted brick-layout form (`ops/features_brick.py`; with it the
+    # shared KNN shrinks to ``normals_k`` wide and ``fpfh_max_nn`` is
+    # unused — all in-radius pairs are histogrammed). "gather" stays the
+    # default: the XLA brick form MEASURED 2169 ms vs 556 ms at the
+    # 24×8192 ring shape on the tunneled v5e (round 5; stage breakdown
+    # in ops/features_brick.py's docstring) — the layout only pays off
+    # as a future Mosaic kernel.
+    fpfh_engine: str = "gather"
     final_nb_neighbors: int = 20      # final SOR (`server/processing.py:174`)
     final_std_ratio: float = 2.0
     loop_closure: bool = True         # pose-graph variant only
@@ -161,17 +178,31 @@ class _Padded:
 # ---------------------------------------------------------------------------
 
 
-def _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn):
+def _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn,
+                fpfh_engine="gather"):
     """`preprocess_point_cloud` (`server/processing.py:78-96`): voxel
     downsample, normals (radius 2·voxel ≈ k-NN PCA), FPFH at 5·voxel.
 
-    ONE shared KNN sweep feeds both normals (first ``normals_k`` columns)
-    and FPFH (all ``fpfh_max_nn``) — the two O(M²) sweeps were ~40 % of
-    the measured ring preprocess time. FPFH re-masks its pairs against
-    the normal-validity mask, so the only deviation from separate sweeps
-    is that a (rare) <3-neighbor point's slot is dropped rather than
-    replaced by a farther neighbor."""
+    "gather" engine: ONE shared KNN sweep feeds both normals (first
+    ``normals_k`` columns) and FPFH (all ``fpfh_max_nn``) — the two
+    O(M²) sweeps were ~40 % of the measured ring preprocess time. FPFH
+    re-masks its pairs against the normal-validity mask, so the only
+    deviation from separate sweeps is that a (rare) <3-neighbor point's
+    slot is dropped rather than replaced by a farther neighbor.
+
+    "brick" engine: the KNN sweep shrinks to ``normals_k`` wide (normals
+    only) and FPFH runs in the sorted brick layout
+    (`ops/features_brick.py`) with no neighbor lists at all."""
+    if fpfh_engine not in ("gather", "brick"):
+        raise ValueError(f"unknown fpfh_engine {fpfh_engine!r}")
     dpts, _, dvalid, _ = pointcloud.voxel_downsample(pts, voxel, valid=valid)
+    if fpfh_engine == "brick":
+        nb = knn(dpts, normals_k, points_valid=dvalid)
+        normals, nvalid = pointcloud.estimate_normals(
+            dpts, valid=dvalid, k=normals_k, neighbors=nb)
+        feat, fvalid = features_brick.fpfh_brick(
+            dpts, normals, 5.0 * voxel, valid=nvalid)
+        return dpts, dvalid & nvalid & fvalid, normals, feat
     k_shared = max(normals_k, fpfh_max_nn)
     nb = knn(dpts, k_shared, points_valid=dvalid)
     normals, nvalid = pointcloud.estimate_normals(dpts, valid=dvalid,
@@ -195,9 +226,9 @@ def register_pair(
     """
     v = params.voxel_size
     src = _preprocess(src_pts, src_valid, v, params.normals_k,
-                      params.fpfh_max_nn)
+                      params.fpfh_max_nn, params.fpfh_engine)
     dst = _preprocess(dst_pts, dst_valid, v, params.normals_k,
-                      params.fpfh_max_nn)
+                      params.fpfh_max_nn, params.fpfh_engine)
     return _register_preprocessed(src, dst, params, key=key)
 
 
@@ -303,7 +334,8 @@ def _ring_body(params: MergeParams, n: int, loop_closure: bool):
     def run(points, valid, keys):
         pre = jax.vmap(
             lambda p, v: _preprocess(p, v, params.voxel_size,
-                                     params.normals_k, params.fpfh_max_nn)
+                                     params.normals_k, params.fpfh_max_nn,
+                                     params.fpfh_engine)
         )(points, valid)
         xs = _edge_xs(pre, n, loop_closure, keys)
         eye = jnp.eye(4, dtype=jnp.float32)
@@ -428,12 +460,14 @@ def _axis_prior_pass(params: MergeParams, xs, outs):
 
 
 @functools.lru_cache(maxsize=None)
-def _preprocess_fn(voxel: float, normals_k: int, fpfh_max_nn: int):
+def _preprocess_fn(voxel: float, normals_k: int, fpfh_max_nn: int,
+                   fpfh_engine: str = "gather"):
     """Whole per-scan preprocess as one jitted program (same launch-count
     rationale as :func:`_edge_fn`)."""
 
     def run(pts, valid):
-        return _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn)
+        return _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn,
+                           fpfh_engine)
 
     return jax.jit(run)
 
@@ -486,7 +520,7 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
         # device array, and the single host sync happens at the
         # diagnostics below.
         prep = _preprocess_fn(params.voxel_size, params.normals_k,
-                              params.fpfh_max_nn)
+                              params.fpfh_max_nn, params.fpfh_engine)
         edge = _edge_fn(params)
         pre = [prep(points[i], valid[i]) for i in range(n)]
         hint = jnp.eye(4, dtype=jnp.float32)

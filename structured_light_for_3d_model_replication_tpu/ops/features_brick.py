@@ -1,0 +1,250 @@
+"""Brick-layout FPFH — the contiguous-memory engine for the ring preprocess.
+
+The gather-form FPFH (`ops/features.py`) is memory-bound on this backend:
+its two random row gathers (neighbor positions+normals, then neighbor
+SPFHs) move ~130 MB per 8k-point view at the TPU's pathological
+random-gather bandwidth (~12 GB/s effective, round-4 XProf), and the
+100-wide KNN sweep that feeds them exists only to produce those neighbor
+lists. This engine removes both costs with the layout trick of
+`ops/brickknn_pallas.py`, in pure XLA:
+
+1. quantize once into cells of edge = ``radius`` (so a query's full
+   neighbor ball is covered by its 3³ cell neighborhood), sort by packed
+   cell id, pack each occupied cell into a ``slots``-wide static brick;
+2. every query ROW (sorted order, no slot padding — a slot-overflow
+   point still queries, it just stops appearing as a candidate) gathers
+   its cell's 27 neighbor bricks as whole contiguous (S, ·) blocks;
+3. Darboux angles + histogram run over the (27·S) candidate lanes with a
+   radius mask — no per-pair index lists anywhere;
+4. the SPFH table is re-read brick-wise for the weighted FPFH
+   aggregation, again as whole bricks.
+
+**Round-5 measurement (tunneled v5e, 24×8192 ring shape): this XLA form
+LOSES — 2169 ms vs 556 ms for the gather engine — and is therefore NOT
+the default.** The stage probe (`scripts/probe_fpfh_brick.py`) shows
+why: with row-level queries the 27-brick gather alone is 1178 ms (each
+row materializes its own 27·S·8-value candidate copy ≈ 27 KB/row, 10×
+the gather engine's 2.8 KB/row neighbor rows), and cell-level queries
+would share those gathers across S rows but multiply the 864-lane
+Darboux/histogram work by the slot padding — the same 8.6× pair-work
+regression the round-4 windowed-FPFH analysis predicted. The layout only
+wins inside a Mosaic kernel that holds the 27 bricks in VMEM across a
+cell's queries and streams the histogram without materializing pair
+tensors; this module stays as the tested reference semantics for that
+kernel (CPU parity pinned in tests/test_features_brick.py).
+
+Semantic difference vs the gather engine, by design: the reference's
+``KDTreeSearchParamHybrid(radius, max_nn=100)`` caps each histogram at
+the 100 NEAREST in-radius neighbors — an efficiency bound on a CPU
+k-d tree (`server/processing.py:92-94`), not part of the FPFH
+definition. This engine histograms ALL in-radius pairs (up to the slot
+capacity), i.e. the textbook estimator; sub-histograms are L1-normalized
+either way, so descriptors agree closely (pinned in
+tests/test_features_brick.py) and the registration quality gates
+(ring-fitness floor in bench.py, ground-truth pose tests) hold
+end-to-end.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .brickknn import (
+    _BIG,
+    _BITS,
+    _floor_cell_edge,
+    _GRID_MAX,
+    _quantize_cells,
+    _sorted_segments,
+)
+from .features import FPFH_DIM, N_BINS, _bin
+
+__all__ = ["fpfh_brick"]
+
+
+def _cell_ids(points, valid, h):
+    """Packed cell id per point at cell edge ``h`` — the shared brickknn
+    quantize (floored so a wide cloud still fits the 10-bit grid; larger
+    cells stay exact here because the radius mask reapplies)."""
+    h, mins = _floor_cell_edge(points, valid, h)
+    return _quantize_cells(points, valid, h, mins)
+
+
+def _row_neighbor_bricks(cid_s, ucid, m_cells):
+    """(N, 27) brick index (m_cells = absent sentinel) for every sorted
+    ROW's 3³ cell neighborhood — per row, not per cell, so rows whose
+    cell fell past the brick budget still query their neighborhood."""
+    x = cid_s >> (2 * _BITS)
+    y = (cid_s >> _BITS) & _GRID_MAX
+    z = cid_s & _GRID_MAX
+    deltas = jnp.asarray([(dx, dy, dz) for dx in (-1, 0, 1)
+                          for dy in (-1, 0, 1) for dz in (-1, 0, 1)],
+                         jnp.int32)
+    nxyz = jnp.stack([x, y, z], -1)[:, None, :] + deltas[None]
+    in_grid = jnp.all((nxyz >= 0) & (nxyz <= _GRID_MAX), axis=-1) \
+        & (cid_s < _BIG)[:, None]
+    ncid = (nxyz[..., 0] << (2 * _BITS)) | (nxyz[..., 1] << _BITS) \
+        | nxyz[..., 2]
+    pos = jnp.searchsorted(ucid, jnp.where(in_grid, ncid, _BIG)
+                           ).astype(jnp.int32)
+    pos_c = jnp.minimum(pos, m_cells - 1)
+    return jnp.where(in_grid & (ucid[pos_c] == ncid), pos_c, m_cells)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("slots", "max_cells", "chunk_rows"))
+def fpfh_brick(
+    points: jnp.ndarray,
+    normals: jnp.ndarray,
+    radius: float,
+    valid: jnp.ndarray | None = None,
+    slots: int = 48,
+    max_cells: int = 1024,
+    chunk_rows: int = 512,
+):
+    """(N, 33) float32 FPFH descriptors (+ (N,) validity), brick layout.
+
+    ``slots`` bounds per-cell candidate capacity (at the ring shape —
+    3 mm voxel grid, 15 mm cells — a surface patch holds ~25 points, so
+    48 covers dense curvature; overflow thins candidates, never drops a
+    query). ``max_cells`` bounds the occupied-cell budget;
+    ``chunk_rows`` is the lax.map tile that keeps the (rows, 27·S)
+    broadcast intermediates inside a sane working set under the ring
+    program's 24-view vmap.
+    """
+    n = points.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, dtype=bool)
+    pts = jnp.asarray(points, jnp.float32)
+    nrm = jnp.asarray(normals, jnp.float32)
+    r2 = jnp.float32(radius * radius)
+    S, M = slots, max_cells
+    hi = jax.lax.Precision.HIGHEST
+
+    cid = _cell_ids(pts, valid, jnp.float32(radius))
+    (cid_s, pts_s, val_s, orig_s, _first, _rank, ok, dest,
+     ucid) = _sorted_segments(pts, valid, cid, S, M)
+    nrm_s = nrm[orig_s]
+
+    # Brick tables (the trailing dump row absorbs overflow writes).
+    def brick(vals, fill, dtype):
+        shape = (M * S + 1,) + vals.shape[1:]
+        t = jnp.full(shape, fill, dtype).at[dest].set(vals)
+        return t[:-1].reshape((M, S) + vals.shape[1:])
+
+    bp = brick(pts_s, 0.0, jnp.float32)
+    bn = brick(nrm_s, 0.0, jnp.float32)
+    bv = brick(ok, False, bool)
+    bo = brick(orig_s, -1, jnp.int32)
+    pad = lambda t, fill: jnp.concatenate(
+        [t, jnp.full((1,) + t.shape[1:], fill, t.dtype)])
+    bppad, bnpad, bvpad, bopad = (pad(bp, 0.0), pad(bn, 0.0),
+                                  pad(bv, False), pad(bo, -1))
+
+    nbr = _row_neighbor_bricks(cid_s, ucid, M)  # (N, 27)
+
+    def pair_geometry(q, qo, qv, nb):
+        """Shared candidate geometry for both stages: positions d² and
+        the radius/self/validity pair mask over the 27·S lanes."""
+        c = q.shape[0]
+        kp = bppad[nb].reshape(c, 27 * S, 3)
+        kv = bvpad[nb].reshape(c, 27 * S)
+        ko = bopad[nb].reshape(c, 27 * S)
+        q2 = jnp.sum(q * q, axis=-1, keepdims=True)          # (c, 1)
+        p2 = jnp.sum(kp * kp, axis=-1)                        # (c, 27S)
+        cross = jnp.einsum("cd,cnd->cn", q, kp, precision=hi)
+        d2 = q2 + p2 - 2.0 * cross
+        pair_ok = kv & (d2 <= r2) & (ko != qo[:, None]) & qv[:, None]
+        return kp, d2, pair_ok
+
+    def spfh_chunk(args):
+        q, qn, qo, qv, nb = args
+        c = q.shape[0]
+        kp, d2, pair_ok = pair_geometry(q, qo, qv, nb)
+        kn = bnpad[nb].reshape(c, 27 * S, 3)
+
+        dvec = kp - q[:, None, :]
+        dist = jnp.sqrt(jnp.maximum(jnp.sum(dvec * dvec, axis=-1), 1e-20))
+        dn = dvec / dist[..., None]
+        u = jnp.broadcast_to(qn[:, None, :], dvec.shape)
+        v = jnp.cross(u, dn)
+        v_norm = jnp.linalg.norm(v, axis=-1, keepdims=True)
+        v = v / jnp.where(v_norm > 1e-12, v_norm, 1.0)
+        w = jnp.cross(u, v)
+
+        alpha = jnp.sum(v * kn, axis=-1)
+        phi = jnp.sum(u * dn, axis=-1)
+        theta = jnp.arctan2(jnp.sum(w * kn, axis=-1),
+                            jnp.sum(u * kn, axis=-1))
+        bins = jnp.stack([
+            _bin(alpha, -1.0, 1.0),
+            _bin(phi, -1.0, 1.0),
+            _bin(theta, -jnp.pi, jnp.pi),
+        ], axis=-1)  # (c, 27S, 3)
+        onehot = jax.nn.one_hot(bins, N_BINS, dtype=jnp.float32)
+        onehot = onehot * pair_ok[..., None, None]
+        spfh = onehot.sum(axis=1).reshape(c, FPFH_DIM)
+        cnt = jnp.sum(pair_ok, axis=1)
+        return spfh / jnp.maximum(cnt, 1)[:, None].astype(jnp.float32), cnt
+
+    # Chunked over sorted rows; every op inside is slot-count-free on the
+    # query side, so padding waste is zero whatever the cell occupancy.
+    pad_r = (-n) % chunk_rows
+
+    def padded(x, fill):
+        return jnp.concatenate(
+            [x, jnp.full((pad_r,) + x.shape[1:], fill, x.dtype)]
+        ) if pad_r else x
+
+    def chunked(x):
+        return x.reshape((-1, chunk_rows) + x.shape[1:])
+
+    q_r = chunked(padded(pts_s, 0.0))
+    qn_r = chunked(padded(nrm_s, 0.0))
+    qo_r = chunked(padded(orig_s, -1))
+    qv_r = chunked(padded(val_s, False))
+    nb_r = chunked(padded(nbr, M))
+
+    spfh_s, cnt_s = jax.lax.map(
+        spfh_chunk, (q_r, qn_r, qo_r, qv_r, nb_r))
+    spfh_s = spfh_s.reshape(-1, FPFH_DIM)[:n]
+    cnt_s = cnt_s.reshape(-1)[:n]
+
+    # SPFH brick table for the aggregation stage (same dump-row scatter).
+    bs = jnp.zeros((M * S + 1, FPFH_DIM), jnp.float32).at[dest].set(
+        jnp.where(ok[:, None], spfh_s, 0.0))
+    bspad = jnp.concatenate(
+        [bs[:-1].reshape(M, S, FPFH_DIM),
+         jnp.zeros((1, S, FPFH_DIM), jnp.float32)])
+
+    spfh_r = chunked(padded(spfh_s, 0.0))
+
+    def fpfh_chunk(args):
+        q, qo, qv, nb, own = args
+        c = q.shape[0]
+        _, d2, pair_ok = pair_geometry(q, qo, qv, nb)
+        ks = bspad[nb].reshape(c, 27 * S, FPFH_DIM)
+        dist = jnp.sqrt(jnp.maximum(d2, 1e-20))
+        wgt = jnp.where(pair_ok, 1.0 / jnp.maximum(dist, 1e-12), 0.0)
+        wsum = jnp.maximum(jnp.sum(wgt, axis=1), 1e-12)[:, None]
+        return own + jnp.einsum("cn,cnf->cf", wgt, ks,
+                                precision=hi) / wsum
+
+    f_s = jax.lax.map(fpfh_chunk, (q_r, qo_r, qv_r, nb_r, spfh_r))
+    f_s = f_s.reshape(-1, FPFH_DIM)[:n]
+
+    f3 = f_s.reshape(n, 3, N_BINS)
+    s = jnp.maximum(jnp.sum(f3, axis=-1, keepdims=True), 1e-12)
+    f_s = (100.0 * f3 / s).reshape(n, FPFH_DIM)
+
+    fv_s = val_s & (cnt_s >= 1)
+    f_s = jnp.where(fv_s[:, None], f_s, 0.0)
+
+    # Back to original row order (row scatter, unique destinations).
+    rows = jnp.where(orig_s >= 0, orig_s, n)
+    out_f = jnp.zeros((n + 1, FPFH_DIM), jnp.float32).at[rows].set(f_s)[:n]
+    out_v = jnp.zeros((n + 1,), bool).at[rows].set(fv_s)[:n]
+    return out_f, out_v

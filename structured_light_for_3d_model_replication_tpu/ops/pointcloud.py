@@ -157,7 +157,10 @@ def statistical_outlier_removal(
     they are excluded from the μ/σ statistics and removed. The approximate
     large-N engines can produce such rows (brick slot/budget overflow,
     `ops/brickknn.py`); giving them mean_d = 0 would instead make dropped
-    points unconditionally survive outlier removal."""
+    points unconditionally survive outlier removal. Exception: when EVERY
+    valid point is undecidable (e.g. a single-point cloud, where Open3D
+    keeps the point) there are no statistics at all to fail against, so
+    the whole valid set is kept rather than wiped."""
     n = points.shape[0]
     if valid is None:
         valid = jnp.ones(n, dtype=bool)
@@ -173,7 +176,8 @@ def statistical_outlier_removal(
     mu = jnp.sum(mean_d * vf) / nv
     var = jnp.sum((mean_d - mu) ** 2 * vf) / nv
     thresh = mu + std_ratio * jnp.sqrt(var)
-    return decidable & (mean_d <= thresh)
+    return jnp.where(jnp.any(decidable),
+                     decidable & (mean_d <= thresh), valid)
 
 
 @functools.partial(jax.jit, static_argnames=("min_neighbors",

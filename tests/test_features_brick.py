@@ -1,0 +1,134 @@
+"""Brick-layout FPFH engine (`ops/features_brick.py`) vs the gather
+engine (`ops/features.py`).
+
+On CPU the gather engine's KNN is exact, so when every point has fewer
+than ``max_nn`` in-radius neighbors the two engines compute the SAME
+estimator (all in-radius pairs) and must agree to float-accumulation
+order. When the 100-cap binds (dense cloud), the brick engine histograms
+all in-radius pairs instead of the nearest 100 — descriptors are
+L1-normalized so they stay close, pinned here as cosine similarity.
+"""
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.ops import features, pointcloud
+from structured_light_for_3d_model_replication_tpu.ops.features_brick import (
+    fpfh_brick,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _surface(rng, n, scale=100.0):
+    """Wavy open surface with analytic-ish normals via PCA."""
+    xy = rng.uniform(-scale, scale, (n, 2)).astype(np.float32)
+    z = 12.0 * np.sin(xy[:, 0] / 25.0) * np.cos(xy[:, 1] / 30.0)
+    pts = np.column_stack([xy, z]).astype(np.float32)
+    nrm, nv = pointcloud.estimate_normals(pts, k=12)
+    return pts, np.asarray(nrm), np.asarray(nv)
+
+
+def test_brick_matches_gather_when_cap_unbound(rng):
+    pts, nrm, nv = _surface(rng, 1500)
+    radius = 12.0  # ~<30 in-radius neighbors at this density
+
+    f_g, v_g = features.fpfh(pts, nrm, radius, valid=nv, max_nn=100)
+    f_b, v_b = fpfh_brick(pts, nrm, radius, valid=nv, slots=64)
+    f_g, v_g = np.asarray(f_g), np.asarray(v_g)
+    f_b, v_b = np.asarray(f_b), np.asarray(v_b)
+
+    assert (v_g == v_b).mean() > 0.995
+    both = v_g & v_b
+    # Same estimator: near-exact agreement (accumulation order only).
+    err = np.abs(f_g[both] - f_b[both]).max(axis=1)
+    assert np.median(err) < 1e-3
+    # A few boundary pairs may flip on radius-mask float ties; descriptors
+    # still essentially identical.
+    cos = np.sum(f_g[both] * f_b[both], axis=1) / np.maximum(
+        np.linalg.norm(f_g[both], axis=1) * np.linalg.norm(f_b[both],
+                                                           axis=1), 1e-9)
+    assert cos.min() > 0.999
+
+
+def test_brick_close_when_cap_binds(rng):
+    pts, nrm, nv = _surface(rng, 4000, scale=60.0)
+    radius = 15.0  # >100 in-radius neighbors for most points
+
+    f_g, v_g = features.fpfh(pts, nrm, radius, valid=nv, max_nn=100)
+    f_b, v_b = fpfh_brick(pts, nrm, radius, valid=nv, slots=64)
+    f_g, f_b = np.asarray(f_g), np.asarray(f_b)
+    both = np.asarray(v_g) & np.asarray(v_b)
+    assert both.mean() > 0.99
+    cos = np.sum(f_g[both] * f_b[both], axis=1) / np.maximum(
+        np.linalg.norm(f_g[both], axis=1) * np.linalg.norm(f_b[both],
+                                                           axis=1), 1e-9)
+    # All-in-radius vs nearest-100: same normalized shape.
+    assert np.mean(cos) > 0.99
+    assert np.min(cos) > 0.9
+
+
+def test_brick_rotation_invariance(rng):
+    pts, nrm, nv = _surface(rng, 1200)
+    theta = 0.7
+    R = np.array([[np.cos(theta), -np.sin(theta), 0],
+                  [np.sin(theta), np.cos(theta), 0],
+                  [0, 0, 1]], np.float32)
+    f0, v0 = fpfh_brick(pts, nrm, 12.0, valid=nv, slots=64)
+    f1, v1 = fpfh_brick(pts @ R.T, nrm @ R.T, 12.0, valid=nv, slots=64)
+    both = np.asarray(v0) & np.asarray(v1)
+    f0, f1 = np.asarray(f0)[both], np.asarray(f1)[both]
+    cos = np.sum(f0 * f1, axis=1) / np.maximum(
+        np.linalg.norm(f0, axis=1) * np.linalg.norm(f1, axis=1), 1e-9)
+    assert np.mean(cos) > 0.995
+
+
+def test_preprocess_brick_engine_wiring(rng):
+    """The merge-preprocess wiring of the non-default engine: the
+    normals_k-wide KNN feed, mask combination, and vmap compatibility
+    (the ring program vmaps _preprocess over views). Outputs must track
+    the gather-engine preprocess on the same views."""
+    import jax
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.models import merge
+
+    views = np.stack([_surface(rng, 900)[0] for _ in range(3)])
+    valid = np.ones(views.shape[:2], bool)
+    valid[:, -50:] = False
+
+    def run(engine):
+        f = jax.jit(jax.vmap(
+            lambda p, v: merge._preprocess(p, v, 8.0, 12, 100, engine)))
+        return f(jnp.asarray(views), jnp.asarray(valid))
+
+    dpts_g, val_g, nrm_g, feat_g = map(np.asarray, run("gather"))
+    dpts_b, val_b, nrm_b, feat_b = map(np.asarray, run("brick"))
+
+    np.testing.assert_array_equal(dpts_g, dpts_b)  # shared downsample
+    assert (val_g == val_b).mean() > 0.99
+    both = val_g & val_b
+    cos = np.sum(feat_g[both] * feat_b[both], axis=1) / np.maximum(
+        np.linalg.norm(feat_g[both], axis=1)
+        * np.linalg.norm(feat_b[both], axis=1), 1e-9)
+    assert np.mean(cos) > 0.98
+
+    with pytest.raises(ValueError, match="fpfh_engine"):
+        merge._preprocess(views[0], valid[0], 8.0, 12, 100, "Brick")
+
+
+def test_brick_handles_invalid_and_padding(rng):
+    pts, nrm, nv = _surface(rng, 800)
+    valid = nv.copy()
+    valid[::5] = False
+    f, v = fpfh_brick(pts, nrm, 12.0, valid=valid, slots=64)
+    f, v = np.asarray(f), np.asarray(v)
+    assert not v[::5].any()
+    assert (f[~v] == 0).all()
+    assert np.isfinite(f).all()
+    # Descriptors are L1-normalized to 100 per 11-bin block.
+    blocks = f[v].reshape(-1, 3, 11).sum(axis=-1)
+    np.testing.assert_allclose(blocks, 100.0, atol=1e-3)

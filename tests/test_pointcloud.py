@@ -62,6 +62,25 @@ def test_sor_matches_oracle(rng):
     assert keep[:10].sum() < 5  # most injected outliers rejected
 
 
+def test_sor_keeps_fully_undecidable_cloud(rng):
+    """When NO valid point has a valid neighbor there are no statistics to
+    fail against — the whole valid set must survive (Open3D keeps a
+    single point), not be wiped by the fail-conservative rule that only
+    makes sense for individually undecidable rows."""
+    pts = rng.normal(size=(40, 3)).astype(np.float32)
+    valid = np.zeros(40, bool)
+    valid[7] = True  # one valid point ⇒ zero valid neighbors anywhere
+    keep = np.asarray(pc.statistical_outlier_removal(
+        pts, valid=valid, nb_neighbors=10, std_ratio=2.0))
+    assert keep[7] and keep.sum() == 1
+
+    # With ≥2 valid points statistics exist again and both survive.
+    valid[23] = True
+    keep2 = np.asarray(pc.statistical_outlier_removal(
+        pts, valid=valid, nb_neighbors=10, std_ratio=2.0))
+    assert keep2.sum() == 2
+
+
 def test_radius_outlier_matches_oracle(rng):
     pts = rng.normal(size=(250, 3)).astype(np.float32)
     pts[:8] += 20.0

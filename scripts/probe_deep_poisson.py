@@ -1,0 +1,57 @@
+"""Validate the bench poisson_depth14/15_1M_dense configs: realistic-
+density band at depths 14-15 (small sphere + far anchors), coherent
+surface, analytic error. Mirrors bench.py's deep_poisson. Times here are
+indicative only (may run under CPU contention); the official record is
+the driver's bench run."""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    marching,
+    poisson_sparse,
+)
+
+
+def deep(depth, r_sphere):
+    n_pts = 1 << 20
+    u = np.random.default_rng(4).normal(size=(n_pts, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    pts_np = (u * r_sphere).astype(np.float32)
+    anchors = np.asarray(
+        [[s * 1000.0, t * 1000.0, v * 1000.0]
+         for s in (-1, 1) for t in (-1, 1) for v in (-1, 1)], np.float32)
+    pts_d = jax.device_put(jnp.asarray(np.vstack([pts_np, anchors])))
+    nrm_d = jax.device_put(jnp.asarray(np.vstack(
+        [u.astype(np.float32),
+         np.tile([1.0, 0.0, 0.0], (8, 1)).astype(np.float32)])))
+    jax.block_until_ready((pts_d, nrm_d))
+
+    t0 = time.perf_counter()
+    grid, nb = poisson_sparse.reconstruct_sparse(
+        pts_d, nrm_d, depth=depth, cg_iters=100, max_blocks=196_608)
+    np.asarray(jnp.sum(grid.chi))
+    wall = time.perf_counter() - t0
+    voxel = float(grid.scale)
+    mesh = marching.extract_sparse(grid)
+    rad = np.linalg.norm(mesh.vertices, axis=1)
+    shell = rad < 500.0
+    err = np.abs(rad[shell] - r_sphere)
+    print(f"depth {depth}: cold wall {wall:.1f}s, blocks {int(nb)}, "
+          f"voxel {voxel:.4f}, spacing "
+          f"{np.sqrt(4*np.pi*r_sphere**2/n_pts)/voxel:.2f} vox, faces "
+          f"{len(mesh.faces)}, shell {shell.mean():.3f}, err med "
+          f"{np.median(err)/voxel:.2f} vox p90 "
+          f"{np.percentile(err, 90)/voxel:.2f} vox", flush=True)
+
+
+deep(14, 50.0)
+deep(15, 25.0)

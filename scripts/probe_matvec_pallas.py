@@ -1,0 +1,103 @@
+"""On-TPU timing: Pallas stencil matvec vs the XLA matvec at the bench
+depth-10 shape, plus a full CG solve A/B. Run alone."""
+
+import statistics
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    poisson_pallas,
+    poisson_sparse as ps,
+    pointcloud,
+)
+
+rng = np.random.default_rng(0)
+n3 = 1 << 20
+theta = rng.uniform(0, 2 * np.pi, n3)
+zz = rng.uniform(-80, 80, n3)
+cloud = np.stack([80 * np.cos(theta), zz, 80 * np.sin(theta) + 500],
+                 1).astype(np.float32)
+cloud += rng.normal(0, 0.5, cloud.shape).astype(np.float32)
+pts = jax.device_put(jnp.asarray(cloud))
+nrm, _ = pointcloud.estimate_normals(pts, k=12)
+nrm = pointcloud.orient_normals(pts, nrm,
+                                jnp.asarray([0.0, 0.0, 500.0]), outward=True)
+valid = jnp.ones((n3,), bool)
+jax.block_until_ready(nrm)
+
+MAXB = 196_608
+(rhs, W, nbr, block_valid, *_rest) = ps._setup_sparse(
+    pts, nrm, valid, 1024, MAXB, jnp.float32(4.0))
+jax.block_until_ready(rhs)
+print("setup done", flush=True)
+x = rhs
+band = block_valid[:, None]
+
+
+def xla_mv(xx, Wa, nbra, bva):
+    return jnp.where(bva[:, None],
+                     -(ps._lap_band_flat(xx, nbra) - Wa * xx), 0.0)
+
+
+def pl_mv(xx, Wa, nbra, bva):
+    return poisson_pallas.matvec_pallas(xx, Wa, nbra, bva)
+
+
+def pl_mv16(xx, Wa, nbra, bva):
+    return poisson_pallas.matvec_pallas(xx, Wa, nbra, bva, cb=16)
+
+
+def pl_mv32(xx, Wa, nbra, bva):
+    return poisson_pallas.matvec_pallas(xx, Wa, nbra, bva, cb=32)
+
+
+# BURST timing: 8 chained applications per launch, one host pull — the
+# per-launch RTT (~110 ms) would otherwise dominate a single matvec.
+# Band state travels as ARGUMENTS: closure-captured device arrays bake
+# into the program as constants and the 385 MB W tensor overflows the
+# remote compile service (HTTP 413) — the documented axon failure mode.
+def burst(f):
+    @jax.jit
+    def g(xx, Wa, nbra, bva):
+        return jnp.sum(jax.lax.fori_loop(
+            0, 8, lambda i, v: f(v, Wa, nbra, bva) * 1e-3, xx))
+    return g
+
+
+def pl_v2(xx, Wa, nbra, bva):
+    return poisson_pallas.matvec_pallas_v2(xx, Wa, nbra, bva)
+
+
+def pl_v2_cb64(xx, Wa, nbra, bva):
+    return poisson_pallas.matvec_pallas_v2(xx, Wa, nbra, bva, cb=64)
+
+
+for label, f in (("xla", xla_mv), ("pallas-cb32", pl_mv32),
+                 ("pallas-v2-cb32", pl_v2), ("pallas-v2-cb64", pl_v2_cb64)):
+    g = burst(f)
+
+    def run(rep):
+        np.asarray(g(x + jnp.float32(1e-6 * rep), W, nbr, block_valid))
+
+    run(-1)
+    times = []
+    for rep in range(5):
+        t0 = time.perf_counter()
+        run(rep)
+        times.append((time.perf_counter() - t0) * 1e3)
+    med = statistics.median(times)
+    print(f"matvec[{label}]: {med / 8:.1f} ms/apply (burst8 median "
+          f"{med:.1f} ms, runs {[round(t) for t in times]})", flush=True)
+
+# Numerical check on device.
+a = np.asarray(jax.jit(xla_mv)(x, W, nbr, block_valid))
+b = np.asarray(jax.jit(pl_mv)(x, W, nbr, block_valid))
+print(f"max abs diff: {np.abs(a - b).max():.3e} "
+      f"(ref max {np.abs(a).max():.3e})", flush=True)

@@ -1,0 +1,51 @@
+"""Host-side: how often are a grid-step's CB neighbor slots (direction
+d) a contiguous run nbr[b,d] == nbr[0,d] + b? Decides whether
+run-coalesced range-DMAs can replace per-block DMAs in the Poisson
+stencil kernel. Uses the real depth-10 bench band."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    poisson_sparse as ps,
+    pointcloud,
+)
+
+rng = np.random.default_rng(0)
+n3 = 1 << 20
+theta = rng.uniform(0, 2 * np.pi, n3)
+zz = rng.uniform(-80, 80, n3)
+cloud = np.stack([80 * np.cos(theta), zz, 80 * np.sin(theta) + 500],
+                 1).astype(np.float32)
+cloud += rng.normal(0, 0.5, cloud.shape).astype(np.float32)
+pts = jax.device_put(jnp.asarray(cloud))
+nrm, _ = pointcloud.estimate_normals(pts, k=12)
+nrm = pointcloud.orient_normals(pts, nrm,
+                                jnp.asarray([0.0, 0.0, 500.0]), outward=True)
+valid = jnp.ones((n3,), bool)
+
+MAXB = 196_608
+(rhs, W, nbr, block_valid, *_rest) = ps._setup_sparse(
+    pts, nrm, valid, 1024, MAXB, jnp.float32(4.0))
+nbr = np.asarray(nbr)
+bv = np.asarray(block_valid)
+m = nbr.shape[0]
+print(f"blocks: {bv.sum()} valid of {m} budget")
+
+for CB in (8, 16, 32):
+    mp = (m // CB) * CB
+    nb = nbr[:mp].reshape(-1, CB, 6)
+    live = bv[:mp].reshape(-1, CB).any(axis=1)
+    base = nb[:, :1, :] + np.arange(CB)[None, :, None]
+    run = (nb == base).all(axis=1)           # (steps, 6)
+    # Also allow the all-absent step-direction (skippable entirely).
+    absent = (nb == m).all(axis=1)
+    hit = (run | absent)[live]
+    print(f"CB={CB:3d}: per-direction run|absent rate "
+          f"{np.round(hit.mean(axis=0), 3)}  overall {hit.mean():.3f} "
+          f"(live steps {live.sum()})")

@@ -1,0 +1,61 @@
+"""End-to-end TPU check after the round-5 Poisson changes: depth-10 @1M
+wall-clock (bench config 3c shape; was 5.90 s) and full-solve pallas-vs-
+XLA equivalence at depth 9. Run alone."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    poisson_sparse as ps,
+    pointcloud,
+)
+
+rng = np.random.default_rng(0)
+n3 = 1 << 20
+theta = rng.uniform(0, 2 * np.pi, n3)
+zz = rng.uniform(-80, 80, n3)
+cloud = np.stack([80 * np.cos(theta), zz, 80 * np.sin(theta) + 500],
+                 1).astype(np.float32)
+cloud += rng.normal(0, 0.5, cloud.shape).astype(np.float32)
+pts = jax.device_put(jnp.asarray(cloud))
+nrm, _ = pointcloud.estimate_normals(pts, k=12)
+nrm = pointcloud.orient_normals(pts, nrm,
+                                jnp.asarray([0.0, 0.0, 500.0]), outward=True)
+jax.block_until_ready(nrm)
+
+# Equivalence at depth 9 (both matvec paths on the REAL chip).
+sub = pts[: 200_000]
+subn = nrm[: 200_000]
+outs = {}
+for up in (False, True):
+    ps_cg = ps._cg_sparse
+    (rhs, W, nbr, bvalid, *_r) = ps._setup_sparse(
+        sub, subn, jnp.ones((200_000,), bool), 512, 65_536,
+        jnp.float32(4.0))
+    chi, iters = ps_cg(rhs, W, rhs, nbr, bvalid, 60, 3e-4, use_pallas=up)
+    outs[up] = (np.asarray(chi), int(iters))
+err = np.abs(outs[True][0] - outs[False][0]).max()
+ref = np.abs(outs[False][0]).max()
+print(f"depth-9 CG equivalence: max|Δchi| {err:.3e} (ref max {ref:.3e}), "
+      f"iters xla={outs[False][1]} pallas={outs[True][1]}", flush=True)
+
+def run(rep):
+    grid, nb = ps.reconstruct_sparse(
+        pts + jnp.float32(0.001 * rep), nrm, depth=10, cg_iters=100,
+        max_blocks=196_608)
+    np.asarray(jnp.sum(grid.chi))
+    return nb
+
+run(-1)
+for rep in range(2):
+    t0 = time.perf_counter()
+    nb = run(rep)
+    print(f"depth-10 @1M warm: {time.perf_counter() - t0:.2f} s "
+          f"({int(nb)} blocks)", flush=True)

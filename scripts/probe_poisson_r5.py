@@ -1,0 +1,221 @@
+"""Round-5 Poisson probes on TPU, at the bench config-3c shape
+(1M-point cylinder, depth 10, ~183k active blocks):
+
+  E0  baseline _lap_band_flat matvec (6 rolls + 6 halo matmuls)
+  E1  concatenated halo placement: one (M,384)@(384,512) matmul
+  E2  interior stencil as a SAME-padded 3x3x3 conv over (M,8,8,8)
+  E3  E1+E2 combined
+  E4  splat scatter-add vs double-float scan + unique-index scatter
+
+Measure-first harness; run alone (never with another TPU process)."""
+
+import statistics
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    poisson_sparse as ps,
+)
+from structured_light_for_3d_model_replication_tpu.ops import pointcloud  # noqa: E402
+
+BS = ps.BS
+hi = jax.lax.Precision.HIGHEST
+
+rng = np.random.default_rng(0)
+n3 = 1 << 20
+theta = rng.uniform(0, 2 * np.pi, n3)
+zz = rng.uniform(-80, 80, n3)
+cloud = np.stack([80 * np.cos(theta), zz, 80 * np.sin(theta) + 500],
+                 1).astype(np.float32)
+cloud += rng.normal(0, 0.5, cloud.shape).astype(np.float32)
+pts = jax.device_put(jnp.asarray(cloud))
+nrm, _ = pointcloud.estimate_normals(pts, k=12)
+nrm = pointcloud.orient_normals(pts, nrm,
+                                jnp.asarray([0.0, 0.0, 500.0]), outward=True)
+valid = jnp.ones((n3,), bool)
+jax.block_until_ready(nrm)
+
+MAXB = 196_608
+(rhs, W, nbr, block_valid, block_coords, density, flat, w, cfound,
+ origin, scale, n_blocks) = ps._setup_sparse(pts, nrm, valid, 1024, MAXB,
+                                             jnp.float32(4.0))
+jax.block_until_ready(rhs)
+print(f"setup done: active blocks {int(n_blocks)}", flush=True)
+m = MAXB
+x = rhs  # representative band field
+
+
+def timeit(f, label, reps=5):
+    def run(rep):
+        np.asarray(jnp.sum(f(x + jnp.float32(1e-6 * rep))))
+
+    run(-1)
+    times = []
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        run(rep)
+        times.append((time.perf_counter() - t0) * 1e3)
+    print(f"{label}: median {statistics.median(times):.1f} ms "
+          f"({[round(t, 1) for t in times]})", flush=True)
+
+
+# --- E0: baseline ---------------------------------------------------------
+timeit(jax.jit(lambda xx: ps._lap_band_flat(xx, nbr)), "E0 baseline matvec")
+
+# --- E1: concatenated halo matmul ----------------------------------------
+_PLACE_ALL = jnp.asarray(np.concatenate([ps._PLACE[d] for d in range(6)],
+                                        axis=0))  # (384, 512)
+
+
+def lap_e1(xx):
+    faces = xx[:, ps._FACES_ALL].reshape(m, 6, BS * BS)
+    fpad = jnp.concatenate([faces, jnp.zeros((1, 6, BS * BS), xx.dtype)])
+    acc = jnp.zeros_like(xx)
+    halos = []
+    for d in range(6):
+        delta, interior, *_ = ps._dir_consts(d)
+        acc = acc + jnp.roll(xx, -delta, axis=1) * interior
+        halos.append(fpad[:, ps._OPP[d], :][nbr[:, d]])
+    halo_all = jnp.concatenate(halos, axis=1)          # (M, 384)
+    acc = acc + jnp.matmul(halo_all, _PLACE_ALL, precision=hi)
+    return acc - 6.0 * xx
+
+
+timeit(jax.jit(lap_e1), "E1 concat-halo matvec")
+
+# --- E2: conv interior ----------------------------------------------------
+K = np.zeros((3, 3, 3), np.float32)
+K[0, 1, 1] = K[2, 1, 1] = K[1, 0, 1] = K[1, 2, 1] = K[1, 1, 0] = \
+    K[1, 1, 2] = 1.0
+KERN = jnp.asarray(K.reshape(3, 3, 3, 1, 1))
+
+
+def interior_conv(xx):
+    g = xx.reshape(m, BS, BS, BS, 1)
+    out = jax.lax.conv_general_dilated(
+        g, KERN, window_strides=(1, 1, 1), padding="SAME",
+        dimension_numbers=("NHWDC", "HWDIO", "NHWDC"),
+        precision=hi)
+    return out.reshape(m, BS ** 3)
+
+
+def lap_e2(xx):
+    faces = xx[:, ps._FACES_ALL].reshape(m, 6, BS * BS)
+    fpad = jnp.concatenate([faces, jnp.zeros((1, 6, BS * BS), xx.dtype)])
+    acc = interior_conv(xx)
+    for d in range(6):
+        halo = fpad[:, ps._OPP[d], :][nbr[:, d]]
+        acc = acc + jnp.matmul(halo, jnp.asarray(ps._PLACE[d]),
+                               precision=hi)
+    return acc - 6.0 * xx
+
+
+timeit(jax.jit(lap_e2), "E2 conv-interior matvec")
+
+
+# --- E3: both -------------------------------------------------------------
+def lap_e3(xx):
+    faces = xx[:, ps._FACES_ALL].reshape(m, 6, BS * BS)
+    fpad = jnp.concatenate([faces, jnp.zeros((1, 6, BS * BS), xx.dtype)])
+    halos = [fpad[:, ps._OPP[d], :][nbr[:, d]] for d in range(6)]
+    acc = interior_conv(xx) + jnp.matmul(
+        jnp.concatenate(halos, axis=1), _PLACE_ALL, precision=hi)
+    return acc - 6.0 * xx
+
+
+timeit(jax.jit(lap_e3), "E3 conv+concat matvec")
+
+# Equivalence check (E1/E2/E3 vs E0) on the real band field.
+ref = ps._lap_band_flat(x, nbr)
+for name, f in (("E1", lap_e1), ("E2", lap_e2), ("E3", lap_e3)):
+    got = jax.jit(f)(x)
+    err = float(jnp.max(jnp.abs(got - ref)))
+    den = float(jnp.max(jnp.abs(ref)))
+    print(f"{name} max abs err vs E0: {err:.3e} (ref max {den:.3e})",
+          flush=True)
+
+# --- E4: splat scatter vs double-float scan + unique scatter --------------
+# Stand-in contribution stream at the real shape: 8.4M sorted rows, ~4
+# rows per unique destination.
+NROWS = n3 * 8
+dest_np = np.sort(rng.integers(0, NROWS // 4, NROWS).astype(np.int64))
+dest_dev = jax.device_put(jnp.asarray(dest_np.astype(np.int32)))
+contrib_dev = jax.device_put(jnp.asarray(
+    rng.normal(size=(NROWS, 4)).astype(np.float32)))
+ACC_ROWS = NROWS // 4 + 1
+
+
+def splat_scatter(c):
+    acc = jnp.zeros((ACC_ROWS, 4), jnp.float32)
+    return acc.at[dest_dev].add(c, indices_are_sorted=True)
+
+
+def _two_sum(a, b):
+    s = a + b
+    bv = s - a
+    err = (a - (s - bv)) + (b - bv)
+    return s, err
+
+
+def _df_add(x, y):
+    """Double-float (hi, lo) addition — error-free-transform based;
+    associative to ~2^-48, good enough to recover exact-f32 segment sums
+    from prefix differences (the plain-f32 cumsum dedup measured a real
+    surface-error regression in round 4)."""
+    (xh, xl), (yh, yl) = x, y
+    s, e = _two_sum(xh, yh)
+    e = e + (xl + yl)
+    hi_ = s + e
+    lo_ = e - (hi_ - s)
+    return hi_, lo_
+
+
+def splat_scan(c):
+    pre_h, pre_l = jax.lax.associative_scan(
+        _df_add, (c, jnp.zeros_like(c)), axis=0)
+    last = jnp.concatenate([dest_dev[1:] != dest_dev[:-1],
+                            jnp.ones((1,), bool)])
+    # Segment sum = prefix[last] - prefix[previous last] in df arithmetic.
+    (idx,) = jnp.nonzero(last, size=ACC_ROWS - 1, fill_value=NROWS - 1)
+    seg_end_h = pre_h[idx]
+    seg_end_l = pre_l[idx]
+    prev_h = jnp.concatenate([jnp.zeros((1, 4)), seg_end_h[:-1]])
+    prev_l = jnp.concatenate([jnp.zeros((1, 4)), seg_end_l[:-1]])
+    seg = (seg_end_h - prev_h) + (seg_end_l - prev_l)
+    seg_dest = dest_dev[idx]
+    valid_seg = jnp.arange(ACC_ROWS - 1) < jnp.sum(last)
+    # Invalid (padding) segments route to a dump row past the slice; the
+    # real destinations are unique by construction.
+    out = jnp.zeros((ACC_ROWS + 1, 4), jnp.float32)
+    return out.at[jnp.where(valid_seg, seg_dest, ACC_ROWS)].set(
+        jnp.where(valid_seg[:, None], seg, 0.0))[:ACC_ROWS]
+
+
+def time_splat(f, label):
+    def run(rep):
+        np.asarray(jnp.sum(f(contrib_dev + jnp.float32(1e-6 * rep))))
+
+    run(-1)
+    times = []
+    for rep in range(3):
+        t0 = time.perf_counter()
+        run(rep)
+        times.append((time.perf_counter() - t0) * 1e3)
+    print(f"{label}: median {statistics.median(times):.1f} ms "
+          f"({[round(t, 1) for t in times]})", flush=True)
+
+
+time_splat(jax.jit(splat_scatter), "E4a sorted scatter-add (baseline)")
+time_splat(jax.jit(splat_scan), "E4b double-float scan + unique set")
+a = jax.jit(splat_scatter)(contrib_dev)
+b = jax.jit(splat_scan)(contrib_dev)
+err = float(jnp.max(jnp.abs(a - b)))
+print(f"E4 max abs err: {err:.3e} (acc max {float(jnp.max(jnp.abs(a))):.3e})",
+      flush=True)

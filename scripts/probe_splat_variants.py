@@ -1,0 +1,161 @@
+"""Splat accumulate variants at the TRUE production shapes (8.4M rows,
+100M-row output table), with the argsort cost measured separately:
+
+  A  unsorted scatter-add (the pre-r4 baseline)
+  B  argsort + sorted scatter-add (r4 shipped)
+  C  argsort + double-float prefix scan + compact + set   (r5 first cut)
+  D  argsort + segmented f32 scan + drop-mode unique set  (r5 proposal)
+
+Run alone."""
+
+import statistics
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    poisson_sparse as ps,
+    pointcloud,
+)
+
+rng = np.random.default_rng(0)
+n3 = 1 << 20
+theta = rng.uniform(0, 2 * np.pi, n3)
+zz = rng.uniform(-80, 80, n3)
+cloud = np.stack([80 * np.cos(theta), zz, 80 * np.sin(theta) + 500],
+                 1).astype(np.float32)
+cloud += rng.normal(0, 0.5, cloud.shape).astype(np.float32)
+pts = jax.device_put(jnp.asarray(cloud))
+nrm, _ = pointcloud.estimate_normals(pts, k=12)
+nrm = pointcloud.orient_normals(pts, nrm,
+                                jnp.asarray([0.0, 0.0, 500.0]), outward=True)
+jax.block_until_ready(nrm)
+
+# Real dest/contrib stream from the actual setup internals at depth 10.
+MAXB = 196_608
+R = 1024
+grid_pts, origin, scale = __import__(
+    "structured_light_for_3d_model_replication_tpu.ops.poisson",
+    fromlist=["poisson"]).normalize_points(pts, jnp.ones((n3,), bool), R)
+# Rebuild the splat inputs exactly as _setup_sparse does (narrow-key
+# depth) — cheapest is to call _setup_sparse and recompute dest/contrib
+# from its returned flat/w/cfound.
+(rhs, W, nbr, block_valid, block_coords, density, flat, w, cfound,
+ *_r) = ps._setup_sparse(pts, nrm, jnp.ones((n3,), bool), R, MAXB,
+                         jnp.float32(4.0))
+m = MAXB
+vals = jnp.concatenate([nrm, jnp.ones((n3, 1), jnp.float32)], -1)
+contrib = (w[..., None] * vals[:, None, :]).reshape(-1, 4)
+dest = jnp.where(cfound, flat, m * 512).reshape(-1)
+jax.block_until_ready((contrib, dest))
+OUT_ROWS = m * 512 + 1
+NR = dest.shape[0]
+print(f"rows {NR}, out table {OUT_ROWS}", flush=True)
+
+
+def timeit(f, label, reps=3):
+    def run(rep):
+        np.asarray(jnp.sum(f(contrib + jnp.float32(1e-6 * rep))))
+
+    run(-1)
+    ts = []
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        run(rep)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    print(f"{label}: median {statistics.median(ts):.0f} ms "
+          f"({[round(t) for t in ts]})", flush=True)
+    return statistics.median(ts)
+
+
+@jax.jit
+def sort_only(c):
+    return jnp.argsort(dest) + jnp.int32(jnp.sum(c[0]) * 0)
+
+
+@jax.jit
+def variant_a(c):
+    acc = jnp.zeros((OUT_ROWS, 4), jnp.float32)
+    return acc.at[dest].add(c)[:-1]
+
+
+@jax.jit
+def variant_b(c):
+    dorder = jnp.argsort(dest)
+    acc = jnp.zeros((OUT_ROWS, 4), jnp.float32)
+    return acc.at[dest[dorder]].add(c[dorder],
+                                    indices_are_sorted=True)[:-1]
+
+
+def _two_sum(a, b):
+    s = a + b
+    bv = s - a
+    return s, (a - (s - bv)) + (b - bv)
+
+
+def _df_add(x, y):
+    (xh, xl), (yh, yl) = x, y
+    s, e = _two_sum(xh, yh)
+    e = e + (xl + yl)
+    hi = s + e
+    return hi, e - (hi - s)
+
+
+@jax.jit
+def variant_c(c):
+    # The r5 first-cut (removed from poisson_sparse after this probe):
+    # double-float prefix scan + boundary diff + compacted set.
+    dorder = jnp.argsort(dest)
+    ds, cs = dest[dorder], c[dorder]
+    nrows = ds.shape[0]
+    pre_h, pre_l = jax.lax.associative_scan(
+        _df_add, (cs, jnp.zeros_like(cs)), axis=0)
+    last = jnp.concatenate([ds[1:] != ds[:-1], jnp.ones((1,), bool)])
+    (idx,) = jnp.nonzero(last, size=nrows, fill_value=nrows - 1)
+    seg_ok = jnp.arange(nrows) < jnp.sum(last)
+    end_h, end_l = pre_h[idx], pre_l[idx]
+    prev_h = jnp.concatenate([jnp.zeros_like(end_h[:1]), end_h[:-1]])
+    prev_l = jnp.concatenate([jnp.zeros_like(end_l[:1]), end_l[:-1]])
+    seg = (end_h - prev_h) + (end_l - prev_l)
+    seg_dest = jnp.where(seg_ok, ds[idx], OUT_ROWS - 1)
+    out = jnp.zeros((OUT_ROWS,) + cs.shape[1:], cs.dtype)
+    return out.at[seg_dest].set(jnp.where(seg_ok[:, None], seg, 0.0))[:-1]
+
+
+def _seg_add(x, y):
+    (xv, xf), (yv, yf) = x, y
+    return jnp.where(yf, yv, xv + yv), xf | yf
+
+
+@jax.jit
+def variant_d(c):
+    dorder = jnp.argsort(dest)
+    ds = dest[dorder]
+    cs = c[dorder]
+    first = jnp.concatenate([jnp.ones((1,), bool), ds[1:] != ds[:-1]])
+    seg, _ = jax.lax.associative_scan(
+        _seg_add, (cs, jnp.broadcast_to(first[:, None], cs.shape)), axis=0)
+    last = jnp.concatenate([ds[1:] != ds[:-1], jnp.ones((1,), bool)])
+    tgt = jnp.where(last, ds, OUT_ROWS)  # non-last -> out of range: drop
+    acc = jnp.zeros((OUT_ROWS, 4), jnp.float32)
+    return acc.at[tgt].set(jnp.where(last[:, None], seg, 0.0),
+                           mode="drop", unique_indices=True)[:-1]
+
+
+timeit(sort_only, "argsort alone")
+ta = timeit(variant_a, "A unsorted scatter-add")
+tb = timeit(variant_b, "B argsort + sorted scatter-add")
+tc = timeit(variant_c, "C df-scan + compact + set (current)")
+td = timeit(variant_d, "D segmented scan + drop set")
+
+ref = np.asarray(variant_b(contrib))
+for name, v in (("A", variant_a), ("C", variant_c), ("D", variant_d)):
+    got = np.asarray(v(contrib))
+    print(f"{name} max abs err vs B: {np.abs(got - ref).max():.3e} "
+          f"(ref max {np.abs(ref).max():.3e})", flush=True)

@@ -1,0 +1,71 @@
+"""XProf the depth-10 @1M sparse Poisson solve: where do the 5.06 s go
+after the round-5 splat + matvec work? Run alone."""
+
+import glob
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    poisson_sparse as ps,
+    pointcloud,
+)
+from structured_light_for_3d_model_replication_tpu.utils import trace  # noqa: E402
+
+rng = np.random.default_rng(0)
+n3 = 1 << 20
+theta = rng.uniform(0, 2 * np.pi, n3)
+zz = rng.uniform(-80, 80, n3)
+cloud = np.stack([80 * np.cos(theta), zz, 80 * np.sin(theta) + 500],
+                 1).astype(np.float32)
+cloud += rng.normal(0, 0.5, cloud.shape).astype(np.float32)
+pts = jax.device_put(jnp.asarray(cloud))
+nrm, _ = pointcloud.estimate_normals(pts, k=12)
+nrm = pointcloud.orient_normals(pts, nrm,
+                                jnp.asarray([0.0, 0.0, 500.0]), outward=True)
+jax.block_until_ready(nrm)
+
+
+def run(rep):
+    grid, nb = ps.reconstruct_sparse(
+        pts + jnp.float32(0.001 * rep), nrm, depth=10, cg_iters=100,
+        max_blocks=196_608)
+    np.asarray(jnp.sum(grid.chi))
+
+
+run(-1)
+with trace.device_trace("/tmp/xprof_poisson_r5"):
+    run(3)
+print("traced", flush=True)
+
+from xprof.convert import raw_to_tool_data as rtd  # noqa: E402
+
+f = glob.glob("/tmp/xprof_poisson_r5/plugins/profile/*/*.xplane.pb")
+data, _ = rtd.xspace_to_tool_data(f, "hlo_stats", {})
+d = json.loads(data)
+cols = [c["label"] if isinstance(c, dict) else c for c in d["cols"]]
+i_self = next(i for i, c in enumerate(cols) if "self" in c.lower()
+              and "us" in c.lower())
+i_src = next((i for i, c in enumerate(cols) if "source" in c.lower()), None)
+i_cat = next((i for i, c in enumerate(cols) if "category" in c.lower()), 1)
+i_prog = next((i for i, c in enumerate(cols) if "program" in c.lower()
+               or "module" in c.lower()), None)
+rows = []
+for r in d["rows"]:
+    c = r["c"] if isinstance(r, dict) else r
+    vals = [x.get("v") if isinstance(x, dict) else x for x in c]
+    rows.append(vals)
+rows.sort(key=lambda v: -(v[i_self] or 0))
+total = sum(v[i_self] or 0 for v in rows)
+print(f"total self time: {total/1e3:.1f} ms; top 35:")
+for v in rows[:35]:
+    src = (v[i_src] or "")[:68] if i_src is not None else ""
+    prog = (str(v[i_prog])[:20] if i_prog is not None else "")
+    print(f"  {v[i_self]/1e3:8.2f} ms  {str(v[i_cat])[:24]:24s} {prog:20s}"
+          f" {src}")

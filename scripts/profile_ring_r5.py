@@ -1,0 +1,103 @@
+"""Round-5 XProf profile of the fused ring registration tail at the
+bench shape — refreshes the r4 hotspot table (FPFH gathers ~260 ms,
+RANSAC ~250 ms, stratified searchsorted 165 ms, covariance ~130 ms,
+triangulate ~130 ms, ICP NN ~90 ms). Run alone on the TPU; parse with
+the hlo_stats recipe in .claude/skills/verify/SKILL.md."""
+
+import glob
+import json
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.config import ProjectorConfig  # noqa: E402
+from structured_light_for_3d_model_replication_tpu.models import (  # noqa: E402
+    merge,
+    scan360,
+    synthetic,
+)
+from structured_light_for_3d_model_replication_tpu.ops.patterns import (  # noqa: E402
+    pattern_stack_for,
+)
+from structured_light_for_3d_model_replication_tpu.ops.triangulate import (  # noqa: E402
+    make_calibration,
+)
+from structured_light_for_3d_model_replication_tpu.utils import trace  # noqa: E402
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/xprof_ring_r5"
+
+proj = ProjectorConfig()
+H, W = proj.height, proj.width
+cam_K, proj_K, R, T = synthetic.default_calibration(H, W, proj)
+calib = make_calibration(cam_K, proj_K, R, T, H, W,
+                         proj_width=proj.width, proj_height=proj.height)
+
+
+def bump(az_deg, y, r):
+    az = np.radians(az_deg)
+    return synthetic.Sphere(
+        (90.0 * np.sin(az), y, 500.0 + 90.0 * np.cos(az)), r, 0.75)
+
+
+scene = synthetic.Scene(wall_z=None, spheres=(
+    synthetic.Sphere((0.0, 10.0, 500.0), 80.0, 0.9),
+    bump(0, -40, 32), bump(60, 30, 26), bump(130, -10, 30),
+    bump(200, 55, 24), bump(270, -55, 28), bump(320, 20, 22)))
+frames = np.asarray(pattern_stack_for(proj))
+print("rendering 24 stops (untimed)...", flush=True)
+stacks_np = np.empty((24, frames.shape[0], H, W), np.uint8)
+for k in range(24):
+    sc = synthetic.rotated_scene(scene, k * 15.0)
+    shader = synthetic.FrameShader(sc, cam_K, proj_K, R, T, H, W, proj)
+    for f in range(frames.shape[0]):
+        stacks_np[k, f] = shader.shade(frames[f])
+params = scan360.Scan360Params(
+    merge=merge.MergeParams(voxel_size=3.0, final_max_points=131_072,
+                            step_deg=15.0),
+    method="sequential", fused=True, view_cap=16_384, stop_chunk=3,
+    output_cap=32_768)
+stacks_dev = jax.device_put(jnp.asarray(stacks_np))
+jax.block_until_ready(stacks_dev)
+
+
+def run(rep):
+    merged, poses, stats = scan360.scan_stacks_to_cloud(
+        stacks_dev, calib, proj.col_bits, proj.row_bits, params=params,
+        key=jax.random.PRNGKey(rep + 1), with_stats=True)
+    return merged
+
+
+print("warming...", flush=True)
+run(-1)
+print("tracing...", flush=True)
+with trace.device_trace(OUT):
+    m = run(7)
+print(f"traced: {len(m)} pts -> {OUT}", flush=True)
+
+from xprof.convert import raw_to_tool_data as rtd  # noqa: E402
+
+f = glob.glob(OUT + "/plugins/profile/*/*.xplane.pb")
+data, _ = rtd.xspace_to_tool_data(f, "hlo_stats", {})
+d = json.loads(data)
+cols = [c["label"] if isinstance(c, dict) else c for c in d["cols"]]
+i_self = next(i for i, c in enumerate(cols) if "self" in c.lower()
+              and "us" in c.lower())
+i_src = next((i for i, c in enumerate(cols) if "source" in c.lower()), None)
+i_cat = next((i for i, c in enumerate(cols) if "category" in c.lower()), 1)
+rows = []
+for r in d["rows"]:
+    c = r["c"] if isinstance(r, dict) else r
+    vals = [x.get("v") if isinstance(x, dict) else x for x in c]
+    rows.append(vals)
+rows.sort(key=lambda v: -(v[i_self] or 0))
+total = sum(v[i_self] or 0 for v in rows)
+print(f"\ntotal self time: {total/1e3:.1f} ms; top 30:")
+for v in rows[:30]:
+    src = (v[i_src] or "")[:60] if i_src is not None else ""
+    print(f"  {v[i_self]/1e3:8.2f} ms  {str(v[i_cat])[:28]:28s} {src}")

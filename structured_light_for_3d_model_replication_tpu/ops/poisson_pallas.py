@@ -1,0 +1,221 @@
+"""Pallas TPU kernel for the band-sparse screened-Poisson CG matvec.
+
+The XLA matvec (`poisson_sparse._lap_band_flat` − W·x) is ~35-40 ms per
+application at the 1M-point depth-10 shape (~183k active blocks) and the
+Jacobi-PCG applies it ~70 times — ~2.6 s of the 5.9 s solve. Its cost is
+pure memory choreography: six lane-rolls over the (M, 512) band, a
+(M, 6, 64) face extraction, six neighbor-row gathers and six one-hot
+placement matmuls, each materializing full-band intermediates (round-5
+probe: concatenating the placement matmuls or lowering the interior
+stencil to conv3d both measured level-or-worse — XLA has no cheaper
+schedule for this op graph).
+
+Two kernels, both measured at the 1M/depth-10 shape on the tunneled
+v5e (XLA baseline 52 ms/apply, burst-amortized):
+
+* ``matvec_pallas`` (v1) — whole-brick DMA: per block, six
+  ``make_async_copy`` reads of the neighbor (512,) rows (absent → zero
+  dump row), stencil + placement as masked lane-rolls in VMEM. In the
+  flat layout (idx = (ix·8+iy)·8+iz) every cross-brick face placement
+  is a roll — +x: roll(nb, 448) at ix=7, +y: roll(nb, 56) at iy=7, +z:
+  roll(nb, 7) at iz=7, mirrored negatives. Measured **DMA-ISSUE-bound**:
+  46.5 / 39.0 / 36.9 ms at cb = 8/16/32 (~1.2M tiny DMAs per matvec;
+  run-coalescing into range DMAs was probed and rejected — only 46 % of
+  8-windows are contiguous runs on the real band, 21 % along z).
+* ``matvec_pallas_v2`` — the production path (**31 ms/apply**): XLA
+  pre-extracts the (M, 6, 64) face tensor and row-gathers each block's
+  six halos (the part XLA is fine at), then ONE fused kernel pass does
+  interior rolls + halo placement (a (cb, 384) @ (384, 512) one-hot
+  MXU matmul at HIGHEST — exact) + screening + band mask, with no
+  manual DMA and single-streamed traffic. What v2 removes vs pure XLA
+  is the 6 separate full-band accumulator passes around the placement
+  matmuls.
+
+Same numerical contract as the XLA form (pinned by
+tests/test_poisson_pallas.py in interpret mode); the XLA path stays the
+oracle and CPU fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+BS = 8
+V = BS ** 3          # 512 voxels per block
+CB = 8               # blocks per grid step
+# Direction order matches poisson_sparse's neighbor-table columns
+# (+x, -x, +y, -y, +z, -z): (flat roll offset placing the neighbor's
+# opposite face onto our boundary, own-boundary axis, boundary value).
+_FACE_ROLLS = ((448, 0, BS - 1), (-448, 0, 0),
+               (56, 1, BS - 1), (-56, 1, 0),
+               (7, 2, BS - 1), (-7, 2, 0))
+_INTERIOR_DELTAS = (64, -64, 8, -8, 1, -1)
+
+
+def available() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _axis_coords(shape):
+    """(ix, iy, iz) int32 coordinate planes over the flat lane dim."""
+    flat = jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+    return flat // (BS * BS), (flat // BS) % BS, flat % BS
+
+
+def _interior_acc(x, coords):
+    """Σ_d roll(x, −δ_d)·interior_d — the in-brick 6-neighbor sum, the
+    stencil core shared by BOTH kernels (v1 whole-brick-DMA and v2
+    hybrid): one definition so they cannot silently diverge."""
+    acc = jnp.zeros_like(x)
+    for delta in _INTERIOR_DELTAS:
+        ax = (0 if abs(delta) == 64 else 1 if abs(delta) == 8 else 2)
+        interior = (coords[ax] < BS - 1) if delta > 0 else (coords[ax] > 0)
+        acc = acc + jnp.where(interior, jnp.roll(x, -delta, axis=1), 0.0)
+    return acc
+
+
+def _kernel(nbr_ref, x_ref, w_ref, bv_ref, x_hbm, out_ref, nbx, sem,
+            *, cb: int = CB):
+    # x_hbm is (M+1, 1, V): rank-3 so the tiled (sublane, lane) dims are
+    # taken WHOLE by each copy — slicing single rows of a rank-2 (M, V)
+    # array violates Mosaic's 8-sublane tiling ("slice shape along
+    # dimension 0 must be aligned to tiling"), the same layout trick as
+    # `brickknn_pallas`'s (M, 1, 128) candidate table.
+    for b in range(cb):
+        for d in range(6):
+            pltpu.make_async_copy(
+                x_hbm.at[nbr_ref[b, d]], nbx.at[b, d], sem.at[b, d]
+            ).start()
+
+    x = x_ref[...]                                   # (cb, V)
+    coords = _axis_coords((cb, V))
+    acc = _interior_acc(x, coords)
+
+    for b in range(cb):
+        for d in range(6):
+            pltpu.make_async_copy(
+                x_hbm.at[nbr_ref[b, d]], nbx.at[b, d], sem.at[b, d]
+            ).wait()
+    nb = nbx[...]                                    # (cb, 6, 1, V)
+    for d, (off, ax, wall) in enumerate(_FACE_ROLLS):
+        halo = jnp.roll(nb[:, d, 0, :], off, axis=1)
+        acc = acc + jnp.where(coords[ax] == wall, halo, 0.0)
+
+    out_ref[...] = bv_ref[...] * ((6.0 + w_ref[...]) * x - acc)
+
+
+def _kernel_v2(x_ref, w_ref, bv_ref, halo_ref, place_ref, out_ref, *,
+               cb: int):
+    x = x_ref[...]                                   # (cb, V)
+    acc = _interior_acc(x, _axis_coords((cb, V)))
+    # Halo placement: one (cb, 384) @ (384, 512) one-hot matmul on the
+    # MXU — exact at HIGHEST (one-hot rows), resident block constants.
+    acc = acc + jax.lax.dot_general(
+        halo_ref[...], place_ref[...], (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)
+    out_ref[...] = bv_ref[...] * ((6.0 + w_ref[...]) * x - acc)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "cb"))
+def matvec_pallas_v2(x, W, nbr, block_valid, interpret: bool = False,
+                     cb: int = 32):
+    """Hybrid form: XLA extracts the (M, 6, 64) face tensor and gathers
+    each block's six halo rows (cheap fused gathers), then a single
+    fused kernel pass does interior rolls + halo placement + screening —
+    no manual DMAs at all (v1's 6-DMAs-per-block form measured
+    DMA-issue-bound: 46.5/39.0/36.9 ms at cb 8/16/32 vs XLA's 51.4)."""
+    from .poisson_sparse import _FACES_ALL, _OPP, _PLACE
+
+    m = x.shape[0]
+    faces = x[:, jnp.asarray(_FACES_ALL)].reshape(m, 6, BS * BS)
+    fpad = jnp.concatenate([faces, jnp.zeros((1, 6, BS * BS), x.dtype)])
+    mq = jnp.minimum(nbr, m)  # absent -> zero dump row
+    halos = jnp.stack([fpad[:, _OPP[d], :][mq[:, d]] for d in range(6)],
+                      axis=1).reshape(m, 6 * BS * BS)
+    place_all = jnp.concatenate([jnp.asarray(_PLACE[d]) for d in range(6)],
+                                axis=0)                    # (384, 512)
+
+    mp = ((m + cb - 1) // cb) * cb
+    pad = mp - m
+
+    def padr(a):
+        return jnp.concatenate(
+            [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]) if pad else a
+
+    out = pl.pallas_call(
+        functools.partial(_kernel_v2, cb=cb),
+        grid=(mp // cb,),
+        in_specs=[
+            pl.BlockSpec((cb, V), lambda c: (c, 0)),
+            pl.BlockSpec((cb, V), lambda c: (c, 0)),
+            pl.BlockSpec((cb, 1), lambda c: (c, 0)),
+            pl.BlockSpec((cb, 6 * BS * BS), lambda c: (c, 0)),
+            pl.BlockSpec((6 * BS * BS, V), lambda c: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((cb, V), lambda c: (c, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, V), jnp.float32),
+        interpret=interpret,
+    )(padr(x), padr(W), padr(block_valid.astype(jnp.float32)[:, None]),
+      padr(halos), place_all)
+    return out[:m]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "cb"))
+def matvec_pallas(x, W, nbr, block_valid, interpret: bool = False,
+                  cb: int = CB):
+    """One screened-stencil matvec: ``bvalid·((6+W)x − neighbor_sum(x))``
+    — identical to ``-(lap_band(x) − W·x)`` masked to the band, i.e. the
+    operator `poisson_sparse._cg_sparse` applies each PCG iteration.
+
+    ``x``/``W`` are (M, 512) flat bricks, ``nbr`` (M, 6) neighbor slots
+    with M = absent, ``block_valid`` (M,) bool. M is padded to the CB
+    grid multiple here; the dump row serves absent neighbors.
+    """
+    m = x.shape[0]
+    mp = ((m + cb - 1) // cb) * cb
+    pad = mp - m
+    # Dump row (zeros) at index mp for absent/overflow neighbor slots.
+    xp = jnp.concatenate(
+        [x, jnp.zeros((pad + 1, V), x.dtype)])
+    wp = jnp.concatenate([W, jnp.zeros((pad, V), W.dtype)]) if pad else W
+    bv = jnp.concatenate(
+        [block_valid.astype(jnp.float32),
+         jnp.zeros((pad,), jnp.float32)]) if pad else \
+        block_valid.astype(jnp.float32)
+    nbp = jnp.where(nbr >= m, mp, nbr).astype(jnp.int32)
+    if pad:
+        nbp = jnp.concatenate(
+            [nbp, jnp.full((pad, 6), mp, jnp.int32)])
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, cb=cb),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(mp // cb,),
+            in_specs=[
+                pl.BlockSpec((cb, 6), lambda c: (c, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((cb, V), lambda c: (c, 0)),
+                pl.BlockSpec((cb, V), lambda c: (c, 0)),
+                pl.BlockSpec((cb, 1), lambda c: (c, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((cb, V), lambda c: (c, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((cb, 6, 1, V), jnp.float32),
+                pltpu.SemaphoreType.DMA((cb, 6)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((mp, V), jnp.float32),
+        interpret=interpret,
+    )(nbp, xp[:mp], wp, bv[:, None], xp.reshape(mp + 1, 1, V))
+    return out[:m]

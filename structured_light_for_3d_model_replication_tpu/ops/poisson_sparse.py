@@ -414,15 +414,18 @@ def _setup_sparse(points, normals, valid, resolution: int, max_blocks: int,
             + (intra[..., 0] * BS + intra[..., 1]) * BS + intra[..., 2])
     vals = jnp.concatenate([normals, jnp.ones((n, 1), jnp.float32)], -1)
     contrib = w[..., None] * vals[:, None, :]              # (N, 8, 4)
-    # Destination-sorted scatter-add: the unsorted 8.4M-row scatter was
-    # 0.68 s of the 1M-point setup; sorting contributions by destination
-    # first costs one argsort + gather and unlocks the sorted-indices
-    # scatter path.
+    # Plain UNSORTED scatter-add — the round-5 head-to-head at the true
+    # production shapes (8.4M rows into the 100M-row accumulator,
+    # scripts/probe_splat_variants.py) measured it FASTEST: unsorted add
+    # 806 ms, argsort+sorted add (the r4 form) 949 ms, double-float
+    # prefix scan + compact 1471 ms, segmented scan + drop-unique set
+    # 994 ms. At this table size every variant is dominated by the
+    # accumulator's init+write traffic, so the extra sort/scan passes
+    # only add cost — the scan trick that measured 371 vs 857 ms on a
+    # 2M-row table does NOT survive the real 100M-row one.
     dest = jnp.where(cfound, flat, m * BS**3).reshape(-1)
-    dorder = jnp.argsort(dest)
     acc = jnp.zeros((m * BS**3 + 1, 4), jnp.float32)
-    acc = acc.at[dest[dorder]].add(contrib.reshape(-1, 4)[dorder],
-                                   indices_are_sorted=True)[:-1]
+    acc = acc.at[dest].add(contrib.reshape(-1, 4))[:-1]
     V = acc[:, :3].reshape(m, BS ** 3, 3)
     density = acc[:, 3].reshape(m, BS**3)
 
@@ -534,9 +537,9 @@ def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
     return b, x0
 
 
-@functools.partial(jax.jit, static_argnames=("cg_iters",))
+@functools.partial(jax.jit, static_argnames=("cg_iters", "use_pallas"))
 def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int,
-               rtol=3e-4):
+               rtol=3e-4, use_pallas: bool | None = None):
     # rtol default is a PLAIN float (and matches the public 3e-4): a
     # jnp.float32 default would evaluate at import time and initialize
     # the XLA backend, breaking jax.distributed for multi-host users
@@ -554,13 +557,31 @@ def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int,
 
     ``cg_iters`` is the CAP; the residual stop (‖r‖ ≤ rtol·‖b‖, a
     ``lax.while_loop``) ends the solve as soon as the coarse-seeded x0
-    has been refined to tolerance. Returns (chi, iterations_used)."""
+    has been refined to tolerance. Returns (chi, iterations_used).
+
+    ``use_pallas``: None = the Mosaic one-pass stencil
+    (`ops/poisson_pallas.py`) on TPU backends, the XLA roll/face/matmul
+    form elsewhere (it remains the oracle — parity pinned in
+    tests/test_poisson_pallas.py)."""
+    from . import poisson_pallas
+
     band = block_valid[:, None]
     dinv = jnp.where(band, 1.0 / (6.0 + W), 0.0)
 
-    def matvec(xf):
-        out = _lap_band_flat(xf, nbr) - W * xf
-        return jnp.where(band, -out, 0.0)
+    if use_pallas is None:
+        use_pallas = poisson_pallas.available()
+    if use_pallas:
+        # v2 hybrid (XLA face/halo prep + fused roll/place kernel):
+        # 31 ms/apply vs 52 ms XLA at the 1M depth-10 shape — the pure
+        # whole-brick-DMA kernel (matvec_pallas) measured DMA-issue-bound
+        # at 35-46 ms (numbers in ops/poisson_pallas.py).
+        def matvec(xf):
+            return poisson_pallas.matvec_pallas_v2(xf, W, nbr,
+                                                   block_valid, cb=64)
+    else:
+        def matvec(xf):
+            out = _lap_band_flat(xf, nbr) - W * xf
+            return jnp.where(band, -out, 0.0)
 
     r0 = b - matvec(x0)
     z0 = dinv * r0

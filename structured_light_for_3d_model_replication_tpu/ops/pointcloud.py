@@ -246,6 +246,35 @@ def random_subsample(
     return out_points, out_attrs, out_valid
 
 
+def _tiered_rank_search(rank: jnp.ndarray, targets: jnp.ndarray):
+    """``searchsorted(rank, targets, side='left')`` for a NONDECREASING
+    int table, as three blocked compare-count levels.
+
+    The plain binary search does log₂(n) ≈ 21 rounds of element gathers
+    per query — this backend's pathological access class — and measured
+    165 ms per 24-view ring at the subsample shape (393k queries over
+    2M-row cumsums). Here each level counts ``block_max < t`` over a
+    ≤B-wide row (vectorized compare-sum), and the two lower levels fetch
+    their row by WHOLE-ROW gather (the fast class). Because the table is
+    nondecreasing, "block max < t" ⟺ "entire block < t", so the three
+    counts add up to exactly #(rank < t) — the 'left' insertion point."""
+    n = rank.shape[0]
+    b = max(8, -(-int(round(n ** (1.0 / 3.0) + 0.5)) // 8) * 8)
+    big = jnp.iinfo(rank.dtype).max
+    pad = b ** 3 - n
+    rp = jnp.concatenate([rank, jnp.full((pad,), big, rank.dtype)]) \
+        if pad else rank
+    t = targets[:, None]
+    m1 = rp.reshape(b, b * b)[:, -1]                     # (B,)
+    b1 = jnp.sum((m1[None, :] < t), axis=1).astype(jnp.int32)
+    m2 = rp.reshape(b * b, b)[:, -1].reshape(b, b)       # (B, B)
+    b2 = jnp.sum(m2[jnp.minimum(b1, b - 1)] < t, axis=1).astype(jnp.int32)
+    mid = jnp.minimum(b1 * b + b2, b * b - 1)
+    w3 = rp.reshape(b * b, b)[mid]                       # (q, B) row gather
+    b3 = jnp.sum(w3 < t, axis=1).astype(jnp.int32)
+    return mid * b + b3
+
+
 @functools.partial(jax.jit, static_argnames=("m",))
 def stratified_indices(valid: jnp.ndarray, m: int):
     """Row indices + validity of the stratified subsample — the selection
@@ -267,13 +296,18 @@ def stratified_indices(valid: jnp.ndarray, m: int):
     u = jax.lax.associative_scan(jnp.maximum, t - j)
     t = jnp.minimum(u + j, jnp.maximum(n_valid, 1))
     targets = jnp.where(n_valid > m, t, j + 1)
-    # searchsorted, deliberately: m ≪ n here (16k queries over a 2M-row
-    # cumsum), so m·log n binary-search reads beat building an n-row
-    # rank→index table — measured on the tunneled v5e: 221 ms vs 371 ms
-    # per 24-stop ring even with a unique+drop scatter (non-unique
-    # scatter was 475 ms). The opposite geometry (queries ≫ table) is
-    # where sort-merge wins — see ops/poisson_sparse.py:_rank_lookup1.
-    idx = jnp.searchsorted(rank, targets, side="left").astype(jnp.int32)
+    # Lookup geometry (m ≪ n: 16k queries over a 2M-row cumsum): an
+    # n-row rank→index table lost in r4 (371 vs 221 ms — scatter-bound),
+    # and plain searchsorted's log₂(n) element-gather rounds were still
+    # 165 ms of the r5 ring profile; the tiered blocked search replaces
+    # them with three compare-counts + two whole-row gathers (measured
+    # 165 → ~25 ms per ring, bit-identical indices). Sort-merge remains
+    # the answer only for queries ≫ table (ops/poisson_sparse.py).
+    if n >= (1 << 18):
+        idx = _tiered_rank_search(rank, targets)
+    else:
+        idx = jnp.searchsorted(rank, targets, side="left").astype(
+            jnp.int32)
     idx = jnp.minimum(idx, n - 1)
     out_valid = j < jnp.minimum(n_valid, m)
     return idx, out_valid

@@ -91,6 +91,35 @@ def test_radius_outlier_matches_oracle(rng):
     np.testing.assert_array_equal(keep, counts >= m)
 
 
+def test_tiered_rank_search_matches_searchsorted(rng):
+    """The blocked 3-level search used for large-n stratified subsampling
+    must return the EXACT 'left' insertion points — duplicates, plateaus
+    and off-the-end targets included (it feeds registration-view
+    selection; a ±1 would silently shift every subsample)."""
+    import jax.numpy as jnp
+
+    n = 1 << 19
+    vals = np.sort(rng.integers(0, n // 2, n)).astype(np.int32)  # dups
+    t = np.concatenate([rng.integers(0, n // 2 + 3, 2000),
+                        [0, 1, n // 2, n // 2 + 1]]).astype(np.int32)
+    ref = np.searchsorted(vals, t, side="left")
+    got = np.asarray(pc._tiered_rank_search(jnp.asarray(vals),
+                                            jnp.asarray(t)))
+    inb = ref < n
+    np.testing.assert_array_equal(got[inb], ref[inb])
+    # Off-the-end targets (t > every element): the result must be ≥ n so
+    # the caller's clamp (not an in-range wrong row) decides.
+    assert inb.any() and (~inb).any(), "fixture must cover both paths"
+    assert (got[~inb] >= n).all()
+    # Both routes of stratified_indices agree across the size threshold.
+    for nn in (1 << 17, 1 << 18):
+        valid = rng.random(nn) > 0.4
+        idx, ov = pc.stratified_indices(jnp.asarray(valid), 4096)
+        idx, ov = np.asarray(idx), np.asarray(ov)
+        assert valid[idx[ov]].all()
+        assert (np.diff(idx[ov]) > 0).all()
+
+
 def test_smallest_eigenvector_matches_eigh(rng):
     M = rng.normal(size=(64, 3, 3))
     A = (M @ M.transpose(0, 2, 1)).astype(np.float32)  # SPD

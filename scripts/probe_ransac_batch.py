@@ -1,0 +1,68 @@
+"""RANSAC hypothesis-batch sweep at the ring shape (23 vmapped edges,
+8192-pt clouds, 100k budget). r3 measured 2048→8192 as a win
+(step-chain bound); this asks whether 16384/32768 keep paying. Run
+alone."""
+
+import statistics
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from structured_light_for_3d_model_replication_tpu.models import merge  # noqa: E402
+from structured_light_for_3d_model_replication_tpu.ops import registration  # noqa: E402
+
+rng = np.random.default_rng(0)
+
+
+def view(i):
+    u = rng.normal(size=(8192, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    r = 80 + 8 * np.sin(4 * u[:, 0] + 0.3 * i) * np.cos(3 * u[:, 1])
+    p = u * r[:, None] + np.asarray([0.0, 10.0, 500.0])
+    th = np.radians(15.0 * i)
+    R = np.array([[np.cos(th), 0, np.sin(th)], [0, 1, 0],
+                  [-np.sin(th), 0, np.cos(th)]])
+    return (p @ R.T).astype(np.float32)
+
+
+pts = jax.device_put(jnp.asarray(np.stack([view(i) for i in range(24)])))
+val = jnp.ones((24, 8192), bool)
+pre = jax.jit(jax.vmap(
+    lambda p, v: merge._preprocess(p, v, 3.0, 30, 100)))(pts, val)
+dpts, dval, nrm, feat = jax.block_until_ready(pre)
+
+s_pts, s_val, s_feat = dpts[1:], dval[1:], feat[1:]
+d_pts, d_val, d_feat = dpts[:-1], dval[:-1], feat[:-1]
+
+for batch in (8192, 16384, 32768):
+    def edge(sp, sf, dp, df, sv, dv, key):
+        r = registration.ransac_feature_registration(
+            sp, sf, dp, df, distance_threshold=4.5,
+            src_valid=sv, dst_valid=dv, num_iterations=100_000,
+            batch=batch, key=key)
+        return r.transformation, r.fitness
+
+    f = jax.jit(jax.vmap(edge))
+
+    def run(rep):
+        keys = jax.random.split(jax.random.PRNGKey(rep + 7), 23)
+        T, fit = f(s_pts + jnp.float32(1e-4 * rep), s_feat, d_pts,
+                   d_feat, s_val, d_val, keys)
+        np.asarray(jnp.sum(T))
+        return fit
+
+    run(-1)
+    ts = []
+    for rep in range(3):
+        t0 = time.perf_counter()
+        fit = run(rep)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    print(f"batch={batch}: median {statistics.median(ts):.0f} ms "
+          f"({[round(t) for t in ts]}), min fitness "
+          f"{float(jnp.min(fit)):.3f}", flush=True)

@@ -1,0 +1,29 @@
+"""jaxlint — repo-native static analysis for JAX/TPU hazards.
+
+Usage::
+
+    python -m structured_light_for_3d_model_replication_tpu.analysis --check .
+
+The framework (:mod:`.core`) is AST-only and stdlib-only; the built-in
+rules (:mod:`.rules`) target the hazard classes this codebase has
+actually shipped: unguarded pallas imports, host syncs inside jit,
+implicit dtypes in the ops layer, ``static_argnames`` mistakes, jitted
+reads of mutable globals, and PRNG key reuse.  See ``docs/JAXLINT.md``
+for the workflow (running, suppressing, updating the baseline).
+"""
+
+from .core import (  # noqa: F401
+    BASELINE_NAME,
+    FileContext,
+    REGISTRY,
+    Rule,
+    Violation,
+    apply_baseline,
+    iter_python_files,
+    lint_file,
+    lint_path,
+    load_baseline,
+    make_baseline,
+    register,
+)
+from . import rules  # noqa: F401  (importing registers the built-in rules)

@@ -1,0 +1,166 @@
+"""jaxlint CLI.
+
+``python -m structured_light_for_3d_model_replication_tpu.analysis
+--check .`` lints every ``*.py`` under the given roots and exits 0 iff
+no violations beyond the committed baseline
+(``jaxlint_baseline.json`` at the first checked root) remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+from .core import (BASELINE_NAME, REGISTRY, apply_baseline, lint_path,
+                   load_baseline, make_baseline)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m structured_light_for_3d_model_replication_tpu"
+             ".analysis",
+        description="jaxlint: static analysis for JAX/TPU hazards "
+                    "(see docs/JAXLINT.md)")
+    p.add_argument("--check", nargs="+", metavar="PATH",
+                   help="files or directories to lint")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help=f"baseline file (default: <first PATH>/"
+                        f"{BASELINE_NAME} when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file (report everything)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to grandfather the current "
+                        "violations (keeps existing justifications)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the registered rules and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress per-violation output (summary only)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            print(f"{name}: {REGISTRY[name].description}")
+        return 0
+    if not args.check:
+        build_parser().print_usage(sys.stderr)
+        print("error: --check PATH is required (or --list-rules)",
+              file=sys.stderr)
+        return 2
+
+    roots = [Path(p) for p in args.check]
+    for root in roots:
+        if not root.exists():
+            print(f"error: no such path: {root}", file=sys.stderr)
+            return 2
+
+    baseline_path = (Path(args.baseline) if args.baseline else
+                     _default_baseline(roots[0]))
+    # Violation paths are reported — and matched against the baseline —
+    # relative to the baseline's directory, so a subtree invocation
+    # (`--check <pkg>/ops` from the repo root) still matches the repo
+    # baseline's repo-root-relative entry paths.
+    anchor = baseline_path.parent.resolve()
+
+    violations = []
+    covered = []   # anchored path prefixes this run actually linted
+    for root in roots:
+        vs = lint_path(root)
+        base = root.resolve()
+        is_file = base.is_file()
+        if is_file:
+            base = base.parent
+        try:
+            prefix = base.relative_to(anchor).as_posix()
+            if prefix == ".":
+                prefix = ""
+        except ValueError:
+            prefix = None    # root outside the anchor: keep root-relative
+        if prefix:
+            vs = [dataclasses.replace(v, path=f"{prefix}/{v.path}")
+                  for v in vs]
+        if prefix is not None:
+            covered.append(f"{prefix}/{root.name}".lstrip("/")
+                           if is_file else prefix)
+        violations.extend(vs)
+    baseline = None
+    if not args.no_baseline and baseline_path.exists() \
+            and not args.update_baseline:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as exc:
+            print(f"error: bad baseline {baseline_path}: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    if args.update_baseline:
+        old = None
+        if baseline_path.exists():
+            try:
+                old = load_baseline(baseline_path)
+            except (ValueError, json.JSONDecodeError) as exc:
+                print(f"error: bad baseline {baseline_path}: {exc}",
+                      file=sys.stderr)
+                return 2
+        doc = make_baseline(violations, old)
+        if old is not None:
+            # A subtree run sees only its own violations — keep old
+            # entries for paths this run did not lint, or a scoped
+            # --update-baseline would silently drop the rest of the
+            # repo's grandfathered entries.
+            def _was_linted(path: str) -> bool:
+                return any(c == "" or path == c or path.startswith(c + "/")
+                           for c in covered)
+            kept = [e for e in old.get("entries", [])
+                    if not _was_linted(e["path"])]
+            doc["entries"] = sorted(kept + doc["entries"],
+                                    key=lambda e: (e["path"], e["rule"]))
+        baseline_path.write_text(json.dumps(doc, indent=2) + "\n",
+                                 encoding="utf-8")
+        n_gf = sum(e["count"] for e in doc["entries"])
+        print(f"jaxlint: wrote {baseline_path} grandfathering "
+              f"{n_gf} violation(s) in "
+              f"{len(doc['entries'])} (file, rule) group(s)")
+        if n_gf < len(violations):
+            print(f"jaxlint: {len(violations) - n_gf} parse-error "
+                  "violation(s) NOT baselined (unparseable files always "
+                  "fail the gate — fix them)", file=sys.stderr)
+        return 0
+
+    new, grandfathered, stale = apply_baseline(violations, baseline)
+
+    if not args.quiet:
+        for v in new:
+            print(v.format())
+        for path, rule, have, allowed in stale:
+            print(f"jaxlint: stale baseline entry {path} [{rule}]: "
+                  f"allows {allowed}, found {have} — ratchet it down with "
+                  f"--update-baseline", file=sys.stderr)
+
+    summary = (f"jaxlint: {len(new)} new violation(s), "
+               f"{grandfathered} grandfathered, "
+               f"{len(REGISTRY)} rules")
+    print(summary, file=sys.stderr if new else sys.stdout)
+    return 1 if new else 0
+
+
+def _default_baseline(root: Path) -> Path:
+    """Nearest baseline at or ABOVE the checked root, so subtree
+    invocations honor the committed repo baseline; falls back to
+    ``<root>/jaxlint_baseline.json`` when none exists up the tree."""
+    base = (root if root.is_dir() else root.parent).resolve()
+    for d in (base, *base.parents):
+        cand = d / BASELINE_NAME
+        if cand.exists():
+            return cand
+    return base / BASELINE_NAME
+
+
+if __name__ == "__main__":
+    sys.exit(main())

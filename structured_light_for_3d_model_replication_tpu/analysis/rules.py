@@ -1,0 +1,555 @@
+"""jaxlint built-in rules — the six hazard classes this repo has hit.
+
+Every rule is lexical (pure AST, no type inference), so each one states
+its exact heuristic and the known blind spots.  False positives are the
+suppression comment's job (`# jaxlint: disable=RULE` with a
+justification); systemic exceptions belong in the rule's path scoping,
+not in per-line noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, register
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'jax.random.uniform' for a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def jit_decorator_call(dec: ast.AST) -> ast.Call | None:
+    """The ast.Call carrying jit kwargs for ``@jax.jit(...)`` or
+    ``@functools.partial(jax.jit, ...)`` decorators, else None."""
+    if isinstance(dec, ast.Call):
+        f = dotted(dec.func)
+        if f in _JIT_NAMES:
+            return dec
+        if f in ("functools.partial", "partial") and dec.args \
+                and dotted(dec.args[0]) in _JIT_NAMES:
+            return dec
+    return None
+
+
+def is_jitted(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if dotted(dec) in _JIT_NAMES or jit_decorator_call(dec) is not None:
+            return True
+    return False
+
+
+def walk_functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: unguarded pallas imports
+# ---------------------------------------------------------------------------
+
+_PALLAS_PREFIX = "jax.experimental.pallas"
+
+
+@register
+class PallasImportRule(Rule):
+    """Pallas must stay an optional dependency of every dispatch path.
+
+    Round-5 regression: ``ops/poisson_sparse.py`` imported
+    ``poisson_pallas`` (→ ``jax.experimental.pallas.tpu``) inside the CG
+    hot path even when ``use_pallas`` resolved False, making CPU-only
+    deployments depend on pallas importability.  The repo convention:
+    ``*_pallas.py`` kernel modules are the only files that import pallas
+    at module scope; every other file imports a kernel module lazily,
+    inside an ``if``-gated (backend check) or ``try``-guarded branch.
+    Tests are exempt (they pin kernel parity in interpret mode and may
+    import kernels directly), as are ``scripts/`` (operator-run TPU
+    probes/benches that only ever execute on TPU hosts).
+    """
+
+    name = "pallas-import"
+    description = ("unguarded import of jax.experimental.pallas or a "
+                   "*_pallas kernel module outside a gated branch")
+    exempt_parts = ("tests", "scripts")
+    exempt_suffixes = ("_pallas.py",)
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        yield from self._visit(ctx, ctx.tree, guarded=False)
+
+    def _visit(self, ctx, node, guarded):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.Import, ast.ImportFrom)):
+                for target in self._pallas_targets(child):
+                    if not guarded:
+                        v = self.report(
+                            ctx, child,
+                            f"unguarded import of {target!r}: import pallas"
+                            " kernel modules lazily inside a TPU-gated `if`"
+                            " (e.g. `if tpu_backend(): from . import"
+                            " x_pallas`) or a try/except so non-TPU"
+                            " deployments never touch pallas"
+                            " (*_pallas.py kernel modules are exempt)")
+                        if v:
+                            yield v
+                continue
+            # An `if`/`try` anywhere up the chain counts as the gate; a
+            # function body RESETS the flag (its statements execute at
+            # call time, not under the enclosing branch).
+            if isinstance(child, (ast.If, ast.Try)):
+                child_guarded = True
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                child_guarded = False
+            else:
+                child_guarded = guarded
+            yield from self._visit(ctx, child, child_guarded)
+
+    @staticmethod
+    def _pallas_targets(node):
+        def is_pallas_name(modname: str) -> bool:
+            return (modname == _PALLAS_PREFIX
+                    or modname.startswith(_PALLAS_PREFIX + ".")
+                    or modname.split(".")[-1].endswith("_pallas"))
+
+        hits = []
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if is_pallas_name(alias.name):
+                    hits.append(alias.name)
+        else:
+            mod = node.module or ""
+            if mod and is_pallas_name(mod):
+                hits.append("." * node.level + mod)
+            else:
+                for alias in node.names:
+                    if alias.name == "pallas" and mod == "jax.experimental":
+                        hits.append(_PALLAS_PREFIX)
+                    elif alias.name.endswith("_pallas"):
+                        prefix = "." * node.level + (mod + "." if mod else "")
+                        hits.append(prefix + alias.name)
+        return hits
+
+
+# ---------------------------------------------------------------------------
+# Rule 2: host syncs inside jitted functions
+# ---------------------------------------------------------------------------
+
+
+@register
+class HostSyncInJitRule(Rule):
+    """Host-sync calls inside ``@jax.jit`` bodies either crash at trace
+    time (``.item()`` / ``float()`` on a tracer raise ConcretizationError)
+    or, when they slip through on a concrete leaf, silently serialize
+    dispatch — the one-stray-host-sync stall class from the Gaussian-SDF
+    SLAM pipelining analysis.  Heuristics: ``float()``/``int()`` are only
+    flagged on computed arguments (calls / subscripts / attributes) —
+    bare names are usually static python scalars, which are legal; numpy
+    conversions are only flagged on non-literal arguments (converting a
+    literal list builds a trace-time constant, which is fine).
+    """
+
+    name = "host-sync-in-jit"
+    description = ("host-sync call (.item(), float()/int() on arrays, "
+                   "np.asarray, block_until_ready) inside a jitted "
+                   "function")
+
+    _NP_MODS = ("np", "numpy", "onp")
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        seen: set[tuple[int, int]] = set()
+        for fn in walk_functions(ctx.tree):
+            if not is_jitted(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                msg = self._classify(node)
+                if msg:
+                    seen.add(key)
+                    v = self.report(ctx, node, msg + f" inside jitted "
+                                    f"function {fn.name}()")
+                    if v:
+                        yield v
+
+    def _classify(self, node: ast.Call) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args:
+                return (".item() forces a device→host transfer (and raises"
+                        " on tracers)")
+            if f.attr == "block_until_ready":
+                return "block_until_ready() stalls dispatch"
+            base = dotted(f.value)
+            if base in self._NP_MODS and f.attr in ("asarray", "array"):
+                arg = node.args[0] if node.args else None
+                if arg is not None and not isinstance(
+                        arg, (ast.Constant, ast.List, ast.Tuple,
+                              ast.ListComp)):
+                    return (f"{base}.{f.attr}() of a (possibly traced)"
+                            " array pulls it to host — use jnp, or hoist"
+                            " the conversion out of the jitted body")
+        name = dotted(f)
+        if name in ("jax.block_until_ready", "jax.device_get"):
+            return f"{name}() stalls dispatch"
+        if isinstance(f, ast.Name) and f.id in ("float", "int") \
+                and len(node.args) == 1 and not node.keywords:
+            if isinstance(node.args[0], (ast.Call, ast.Subscript,
+                                         ast.Attribute)):
+                return (f"{f.id}() on a computed value concretizes it"
+                        " (raises on tracers; host-syncs on device"
+                        " leaves)")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Rule 3: implicit dtype in ops/
+# ---------------------------------------------------------------------------
+
+
+@register
+class ImplicitDtypeRule(Rule):
+    """``jnp.asarray``/``jnp.array`` without an explicit dtype takes the
+    weak-type / x64-flag dependent default, and dtype drift across the
+    ops layer is how mixed-precision bugs enter kernels (the fpfh_brick
+    ring regression).  Scoped to ``ops/`` — the numerical kernel layer
+    where every array's dtype is part of the contract."""
+
+    name = "implicit-dtype"
+    description = ("jnp.asarray/jnp.array without an explicit dtype in "
+                   "ops/ (weak-type / x64 drift)")
+    path_filter = ("ops/",)
+
+    _FUNCS = {"jnp.asarray", "jnp.array",
+              "jax.numpy.asarray", "jax.numpy.array"}
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name not in self._FUNCS:
+                continue
+            has_dtype = (len(node.args) >= 2
+                         or any(k.arg == "dtype" for k in node.keywords))
+            if not has_dtype:
+                v = self.report(
+                    ctx, node,
+                    f"{name}() without an explicit dtype in ops/ — the "
+                    "result dtype then depends on weak-type promotion and "
+                    "the x64 flag; pass the intended dtype")
+                if v:
+                    yield v
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: static_argnames hygiene
+# ---------------------------------------------------------------------------
+
+
+@register
+class StaticArgnamesRule(Rule):
+    """``static_argnames`` entries that don't name a parameter are
+    silently ignored by jax (the argument traces instead — recompile per
+    call or tracer leak); static parameters with unhashable defaults
+    raise only on the first defaulted call."""
+
+    name = "static-argnames"
+    description = ("static_argnames naming a missing parameter, or a "
+                   "static parameter with an unhashable default")
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for fn in walk_functions(ctx.tree):
+            for dec in fn.decorator_list:
+                call = jit_decorator_call(dec)
+                if call is None:
+                    continue
+                kw = next((k for k in call.keywords
+                           if k.arg == "static_argnames"), None)
+                if kw is None:
+                    continue
+                names = self._literal_names(kw.value)
+                if names is None:
+                    continue        # dynamic expression — cannot check
+                params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                          + fn.args.kwonlyargs)]
+                defaults = self._default_map(fn)
+                for name in names:
+                    if name not in params:
+                        v = self.report(
+                            ctx, dec,
+                            f"static_argnames entry {name!r} is not a "
+                            f"parameter of {fn.name}() — jax ignores it "
+                            "and the argument traces (recompile/tracer "
+                            "hazard)")
+                        if v:
+                            yield v
+                        continue
+                    default = defaults.get(name)
+                    if default is not None \
+                            and self._unhashable(default):
+                        v = self.report(
+                            ctx, dec,
+                            f"static parameter {name!r} of {fn.name}() has "
+                            "an unhashable default (static args are dict "
+                            "keys in the jit cache) — use a hashable "
+                            "default (tuple/None) instead")
+                        if v:
+                            yield v
+
+    @staticmethod
+    def _literal_names(node) -> list[str] | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    out.append(elt.value)
+                else:
+                    return None
+            return out
+        return None
+
+    @staticmethod
+    def _default_map(fn) -> dict[str, ast.expr]:
+        pos = fn.args.posonlyargs + fn.args.args
+        out: dict[str, ast.expr] = {}
+        for arg, default in zip(pos[len(pos) - len(fn.args.defaults):],
+                                fn.args.defaults):
+            out[arg.arg] = default
+        for arg, default in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if default is not None:
+                out[arg.arg] = default
+        return out
+
+    @staticmethod
+    def _unhashable(node: ast.expr) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            return name in ("list", "dict", "set", "bytearray",
+                            "jnp.array", "jnp.asarray", "np.array",
+                            "np.asarray", "jnp.zeros", "jnp.ones",
+                            "np.zeros", "np.ones")
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Rule 5: jitted functions closing over module-level mutables
+# ---------------------------------------------------------------------------
+
+
+@register
+class MutableGlobalRule(Rule):
+    """A jitted function reading a module-level list/dict/set bakes the
+    traced value into the compiled program: later mutations are silently
+    invisible, and writing traced values INTO the global leaks tracers
+    across traces.  Tuples and scalars are fine (immutable); so is
+    reading mutable globals from untraced helpers."""
+
+    name = "mutable-global"
+    description = ("jitted function reads a module-level mutable "
+                   "(list/dict/set) global")
+
+    _MUTABLE_CALLS = {"list", "dict", "set", "bytearray", "defaultdict",
+                      "collections.defaultdict", "OrderedDict",
+                      "collections.OrderedDict"}
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        mutable: dict[str, int] = {}
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                targets = [t for t in stmt.targets
+                           if isinstance(t, ast.Name)]
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            if self._is_mutable(value):
+                for t in targets:
+                    mutable[t.id] = stmt.lineno
+        if not mutable:
+            return
+        for fn in walk_functions(ctx.tree):
+            if not is_jitted(fn):
+                continue
+            local = self._local_bindings(fn)
+            reported: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in mutable \
+                        and node.id not in local \
+                        and node.id not in reported:
+                    reported.add(node.id)
+                    v = self.report(
+                        ctx, node,
+                        f"jitted function {fn.name}() reads module-level "
+                        f"mutable global {node.id!r} (defined at line "
+                        f"{mutable[node.id]}) — its value is baked in at "
+                        "trace time and later mutations are invisible "
+                        "(tracer-leak risk if written); pass it as an "
+                        "argument or freeze it to a tuple")
+                    if v:
+                        yield v
+
+    def _is_mutable(self, node: ast.expr) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            return dotted(node.func) in self._MUTABLE_CALLS
+        return False
+
+    @staticmethod
+    def _local_bindings(fn) -> set[str]:
+        names = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                 + fn.args.kwonlyargs)}
+        if fn.args.vararg:
+            names.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            names.add(fn.args.kwarg.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Store):
+                names.add(node.id)
+        return names
+
+
+# ---------------------------------------------------------------------------
+# Rule 6: PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+@register
+class KeyReuseRule(Rule):
+    """A PRNG key consumed by two ``jax.random`` sampling calls in the
+    same scope without an intervening ``split`` yields IDENTICAL random
+    streams — RANSAC hypothesis batches that silently sample the same
+    triplets.  Lexical scope walk: reassignment (including from
+    ``split``) resets a key; passing a key to a non-``jax.random`` call
+    does not count (the callee may split).  Blind spots: reuse across
+    exclusive ``if`` branches false-positives, loop-carried reuse
+    false-negatives."""
+
+    name = "key-reuse"
+    description = ("jax.random key consumed by two sampling calls with "
+                   "no split in between")
+
+    _SAFE = {"split", "fold_in", "PRNGKey", "key", "key_data",
+             "wrap_key_data", "clone"}
+    _RANDOM_MODS = ("jax.random", "random", "jrandom", "jr")
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        out: list[Violation] = []
+        self._run_body(ctx, ctx.tree.body, {}, out)
+        for fn in walk_functions(ctx.tree):
+            self._run_body(ctx, fn.body, {}, out)
+        yield from out
+
+    # -- scope interpreter --------------------------------------------------
+
+    def _run_body(self, ctx, stmts, counts, out):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue    # separate scope, visited on its own
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                if stmt.value is not None:
+                    self._consume(ctx, stmt.value, counts, out)
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                self._bind(targets, stmt.value, counts)
+                continue
+            # Generic statement: consume its immediate expressions, reset
+            # any Name stores (for-targets, with-aliases), then recurse
+            # into nested statement bodies.
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._consume(ctx, child, counts, out)
+                elif isinstance(child, ast.withitem):
+                    self._consume(ctx, child.context_expr, counts, out)
+                    if child.optional_vars is not None:
+                        self._bind([child.optional_vars], None, counts)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._bind([stmt.target], None, counts)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    self._run_body(ctx, sub, counts, out)
+            for handler in getattr(stmt, "handlers", []):
+                self._run_body(ctx, handler.body, counts, out)
+
+    def _bind(self, targets, value, counts):
+        names = []
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in t.elts
+                             if isinstance(e, ast.Name))
+        for name in names:
+            counts.pop(name, None)      # any rebind resets the key state
+        if value is not None and self._makes_key(value):
+            for name in names:
+                counts[name] = 0
+
+    def _makes_key(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Subscript):
+            return self._makes_key(node.value)
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted(node.func)
+        if not name or "." not in name:
+            return False
+        mod, _, fn = name.rpartition(".")
+        return mod in self._RANDOM_MODS and fn in self._SAFE
+
+    def _consume(self, ctx, expr, counts, out):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if not name or "." not in name:
+                continue
+            mod, _, fn = name.rpartition(".")
+            if mod not in self._RANDOM_MODS or fn in self._SAFE:
+                continue
+            args = list(node.args) + [k.value for k in node.keywords]
+            for arg in args:
+                if isinstance(arg, ast.Name) and arg.id in counts:
+                    counts[arg.id] += 1
+                    if counts[arg.id] >= 2:
+                        v = self.report(
+                            ctx, node,
+                            f"PRNG key {arg.id!r} is consumed by "
+                            f"jax.random.{fn}() after an earlier sampling "
+                            "call in the same scope with no split in "
+                            "between — both calls draw IDENTICAL "
+                            "randomness; jax.random.split() the key first")
+                        if v:
+                            out.append(v)

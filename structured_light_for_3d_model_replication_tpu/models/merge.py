@@ -81,6 +81,14 @@ class MergeParams:
     # in ops/features_brick.py's docstring) — the layout only pays off
     # as a future Mosaic kernel.
     fpfh_engine: str = "gather"
+    # Brick-engine ring shape (``fpfh_engine="brick"`` only): per-cell
+    # candidate slots and the occupied-cell budget of
+    # `ops/features_brick.fpfh_brick`. When the cloud outgrows them the
+    # engine thins candidates instead of failing — the overflow count is
+    # returned by fpfh_brick and logged by the eager preprocess path —
+    # so these are the knobs to raise when that warning fires.
+    fpfh_slots: int = 48
+    fpfh_max_cells: int = 1024
     final_nb_neighbors: int = 20      # final SOR (`server/processing.py:174`)
     final_std_ratio: float = 2.0
     loop_closure: bool = True         # pose-graph variant only
@@ -179,7 +187,7 @@ class _Padded:
 
 
 def _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn,
-                fpfh_engine="gather"):
+                fpfh_engine="gather", fpfh_slots=48, fpfh_max_cells=1024):
     """`preprocess_point_cloud` (`server/processing.py:78-96`): voxel
     downsample, normals (radius 2·voxel ≈ k-NN PCA), FPFH at 5·voxel.
 
@@ -192,7 +200,13 @@ def _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn,
 
     "brick" engine: the KNN sweep shrinks to ``normals_k`` wide (normals
     only) and FPFH runs in the sorted brick layout
-    (`ops/features_brick.py`) with no neighbor lists at all."""
+    (`ops/features_brick.py`) with no neighbor lists at all.
+
+    The 5th output is the brick engine's candidate-overflow count
+    (always 0 for "gather"): eager callers get a log.warning here, and
+    jitted callers (`_preprocess_fn`) must surface the returned count
+    themselves once it is concrete — under a trace no host warning can
+    fire."""
     if fpfh_engine not in ("gather", "brick"):
         raise ValueError(f"unknown fpfh_engine {fpfh_engine!r}")
     dpts, _, dvalid, _ = pointcloud.voxel_downsample(pts, voxel, valid=valid)
@@ -200,16 +214,19 @@ def _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn,
         nb = knn(dpts, normals_k, points_valid=dvalid)
         normals, nvalid = pointcloud.estimate_normals(
             dpts, valid=dvalid, k=normals_k, neighbors=nb)
-        feat, fvalid = features_brick.fpfh_brick(
-            dpts, normals, 5.0 * voxel, valid=nvalid)
-        return dpts, dvalid & nvalid & fvalid, normals, feat
+        feat, fvalid, n_overflow = features_brick.fpfh_brick(
+            dpts, normals, 5.0 * voxel, valid=nvalid,
+            slots=fpfh_slots, max_cells=fpfh_max_cells)
+        features_brick.emit_overflow_warning(n_overflow, jnp.sum(nvalid))
+        return dpts, dvalid & nvalid & fvalid, normals, feat, n_overflow
     k_shared = max(normals_k, fpfh_max_nn)
     nb = knn(dpts, k_shared, points_valid=dvalid)
     normals, nvalid = pointcloud.estimate_normals(dpts, valid=dvalid,
                                                   k=normals_k, neighbors=nb)
     feat, fvalid = features.fpfh(dpts, normals, 5.0 * voxel, valid=nvalid,
                                  max_nn=fpfh_max_nn, neighbors=nb)
-    return dpts, dvalid & nvalid & fvalid, normals, feat
+    return (dpts, dvalid & nvalid & fvalid, normals, feat,
+            jnp.zeros((), jnp.int32))
 
 
 def register_pair(
@@ -226,10 +243,12 @@ def register_pair(
     """
     v = params.voxel_size
     src = _preprocess(src_pts, src_valid, v, params.normals_k,
-                      params.fpfh_max_nn, params.fpfh_engine)
+                      params.fpfh_max_nn, params.fpfh_engine,
+                      params.fpfh_slots, params.fpfh_max_cells)
     dst = _preprocess(dst_pts, dst_valid, v, params.normals_k,
-                      params.fpfh_max_nn, params.fpfh_engine)
-    return _register_preprocessed(src, dst, params, key=key)
+                      params.fpfh_max_nn, params.fpfh_engine,
+                      params.fpfh_slots, params.fpfh_max_cells)
+    return _register_preprocessed(src[:4], dst[:4], params, key=key)
 
 
 @functools.lru_cache(maxsize=None)
@@ -335,9 +354,16 @@ def _ring_body(params: MergeParams, n: int, loop_closure: bool):
         pre = jax.vmap(
             lambda p, v: _preprocess(p, v, params.voxel_size,
                                      params.normals_k, params.fpfh_max_nn,
-                                     params.fpfh_engine)
+                                     params.fpfh_engine, params.fpfh_slots,
+                                     params.fpfh_max_cells)
         )(points, valid)
-        xs = _edge_xs(pre, n, loop_closure, keys)
+        # pre[4] (per-stop fpfh overflow counts) is dropped here: the
+        # fused one-launch ring keeps the (T, fit, rmse, info) contract
+        # that scan360's fused tail consumes, so it trades the overflow
+        # channel for launch count — the default "loop" strategy and
+        # eager register_pair surface it (same discipline as brick_knn's
+        # drop count under a fused program).
+        xs = _edge_xs(pre[:4], n, loop_closure, keys)
         eye = jnp.eye(4, dtype=jnp.float32)
         outs = jax.vmap(lambda s_p, s_v, s_f, d_p, d_v, d_n, d_f, k:
                         body(s_p, s_v, s_f, d_p, d_v, d_n, d_f, k, eye)
@@ -461,13 +487,14 @@ def _axis_prior_pass(params: MergeParams, xs, outs):
 
 @functools.lru_cache(maxsize=None)
 def _preprocess_fn(voxel: float, normals_k: int, fpfh_max_nn: int,
-                   fpfh_engine: str = "gather"):
+                   fpfh_engine: str = "gather", fpfh_slots: int = 48,
+                   fpfh_max_cells: int = 1024):
     """Whole per-scan preprocess as one jitted program (same launch-count
     rationale as :func:`_edge_fn`)."""
 
     def run(pts, valid):
         return _preprocess(pts, valid, voxel, normals_k, fpfh_max_nn,
-                           fpfh_engine)
+                           fpfh_engine, fpfh_slots, fpfh_max_cells)
 
     return jax.jit(run)
 
@@ -520,9 +547,11 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
         # device array, and the single host sync happens at the
         # diagnostics below.
         prep = _preprocess_fn(params.voxel_size, params.normals_k,
-                              params.fpfh_max_nn, params.fpfh_engine)
+                              params.fpfh_max_nn, params.fpfh_engine,
+                              params.fpfh_slots, params.fpfh_max_cells)
         edge = _edge_fn(params)
-        pre = [prep(points[i], valid[i]) for i in range(n)]
+        pre_full = [prep(points[i], valid[i]) for i in range(n)]
+        pre = [p[:4] for p in pre_full]
         hint = jnp.eye(4, dtype=jnp.float32)
         outs = []
         for i in range(1, n):
@@ -547,6 +576,13 @@ def register_sequence(points: jnp.ndarray, valid: jnp.ndarray,
             xs = _edge_xs(pre_stacked, n, loop_closure, keys)
             Ts, fit, rmse, infos = _axis_pass_fn(params)(
                 xs, (Ts, fit, rmse, infos))
+        # prep is jitted, so _preprocess's own eager overflow warning was
+        # silenced at trace time — surface the now-concrete per-stop
+        # counts. Deferred until after edge dispatch so the async chain
+        # stays intact; the host pull lands with the diagnostics sync
+        # just below.
+        for p in pre_full:
+            features_brick.emit_overflow_warning(p[4], jnp.sum(p[1]))
     else:
         raise ValueError(f"unknown ring strategy {strategy!r}")
     fit_np = np.asarray(fit)

@@ -1,9 +1,14 @@
 """Jitted compute kernels (the TPU replacement for the reference's NumPy/Open3D)."""
 
+# decode_pallas (and the other *_pallas kernel modules) are NOT imported
+# eagerly: they import jax.experimental.pallas at module scope, and the
+# ops layer must stay importable on backends without pallas.  Dispatchers
+# (decode.decode_maps, pointcloud._self_knn, ...) import them lazily
+# behind a tpu_backend() gate — enforced by the `pallas-import` jaxlint
+# rule (python -m structured_light_for_3d_model_replication_tpu.analysis).
 from . import (  # noqa: F401
     cluster,
     decode,
-    decode_pallas,
     features,
     gridknn,
     knn,

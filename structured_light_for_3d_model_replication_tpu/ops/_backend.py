@@ -1,0 +1,25 @@
+"""Backend gate shared by every pallas dispatcher.
+
+The ``*_pallas.py`` kernel modules import ``jax.experimental.pallas``
+at module scope — they are the ONLY files allowed to (enforced by the
+``pallas-import`` jaxlint rule).  Dispatchers must decide whether the
+kernel path applies WITHOUT importing the kernel module, so that
+CPU-only deployments never depend on pallas importability; this helper
+is that decision, split out so it carries no pallas dependency itself.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["tpu_backend"]
+
+
+def tpu_backend() -> bool:
+    """True on TPU-family backends.
+
+    'axon' is the tunneled dev-TPU platform name in this environment —
+    ``jax.default_backend()`` reports it instead of 'tpu' (the round-3
+    lesson: never feature-gate on the literal 'tpu' alone).
+    """
+    return jax.default_backend() in ("tpu", "axon")

@@ -45,6 +45,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from . import _backend
 from .gridknn import _estimate_cell_size
 from ..utils.log import get_logger
 
@@ -332,7 +333,12 @@ def brick_knn(
     on TPU backends when ``slots==32`` and ``k<=32``, XLA elsewhere;
     True forces it in interpret mode off-TPU (tests). The kernel clears
     the low 10 mantissa bits of returned d² (≤ 2⁻¹³ relative); the XLA
-    path is exact.
+    path is exact. With ``rescue``, the d² precision is therefore MIXED
+    on the pallas path: rescued rows are re-solved by the exact XLA
+    sweep and carry full-precision d², while every non-rescued row keeps
+    the kernel's truncated values — don't diff d² across the two row
+    classes at tighter than 2⁻¹³ relative (neighbor INDICES are
+    unaffected).
 
     ``return_dropped``: also return the scalar count of points lost to
     slot/budget overflow (they report all-False ``neighbor_valid`` rows)
@@ -355,18 +361,27 @@ def brick_knn(
     if max_cells is None:
         max_cells = n // 8 + 1024
 
-    from . import brickknn_pallas
-
-    kernel_fits = (slots == S_PALLAS and k <= brickknn_pallas.MAX_K
-                   and n <= brickknn_pallas.MAX_N)
+    # Resolve the engine BEFORE importing the kernel module: CPU-only
+    # deployments (use_pallas=False, or None off-TPU) must never import
+    # brickknn_pallas → jax.experimental.pallas (pallas-import rule).
+    # Truthy (not just `is True`) so np.True_/1 keep the documented
+    # unfit-shape ValueError instead of silently falling back to XLA.
+    forced = use_pallas is not None and bool(use_pallas)
     if use_pallas is None:
-        use_pallas = brickknn_pallas.available() and kernel_fits
-    elif use_pallas and not kernel_fits:
-        raise ValueError(
-            f"use_pallas=True but the Mosaic brick kernel requires "
-            f"slots={S_PALLAS}, k<={brickknn_pallas.MAX_K} and "
-            f"n<={brickknn_pallas.MAX_N} (got slots={slots}, k={k}, "
-            f"n={n})")
+        use_pallas = _backend.tpu_backend()
+    if use_pallas:
+        from . import brickknn_pallas
+
+        kernel_fits = (slots == S_PALLAS and k <= brickknn_pallas.MAX_K
+                       and n <= brickknn_pallas.MAX_N)
+        if not kernel_fits:
+            if forced:
+                raise ValueError(
+                    f"use_pallas=True but the Mosaic brick kernel requires "
+                    f"slots={S_PALLAS}, k<={brickknn_pallas.MAX_K} and "
+                    f"n<={brickknn_pallas.MAX_N} (got slots={slots}, k={k}, "
+                    f"n={n})")
+            use_pallas = False  # auto mode: fall back to the XLA path
     if use_pallas:
         d, i, v, n_dropped = brickknn_pallas.brick_knn_pallas(
             points, points_valid, k, exclude_self,

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import _backend
 from .brickknn import _grid_cells, _sorted_segments
 from ..utils.log import get_logger
 
@@ -65,7 +66,7 @@ _BIG = 1 << 30
 
 
 def available() -> bool:
-    return jax.default_backend() in ("tpu", "axon")
+    return _backend.tpu_backend()
 
 
 def _kernel(nbr_ref, nnb_ref, q_ref, bpc_hbm, d_ref, i_ref,

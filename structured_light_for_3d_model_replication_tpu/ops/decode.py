@@ -24,6 +24,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from . import _backend
 from .patterns import gray_to_binary
 from ..config import DecodeConfig
 
@@ -144,11 +145,10 @@ def decode_stack(
     kernel, ops/decode_pallas.py), or "auto" (pallas on TPU backends).
     """
     if backend == "auto":
-        # Mosaic kernels are TPU-only; 'axon' is the tunneled-TPU platform
-        # name in the dev environment. Anything else (cpu, gpu, ...) takes
-        # the portable XLA path.
-        backend = ("pallas" if jax.default_backend() in ("tpu", "axon")
-                   else "xla")
+        # Mosaic kernels are TPU-only (the shared _backend gate knows the
+        # tunneled-TPU platform names). Anything else (cpu, gpu, ...)
+        # takes the portable XLA path.
+        backend = "pallas" if _backend.tpu_backend() else "xla"
     _check_frames(stack, col_bits, row_bits)
     white, black = stack[0], stack[1]
     if backend == "pallas":

@@ -61,8 +61,11 @@ from .brickknn import (
     _sorted_segments,
 )
 from .features import FPFH_DIM, N_BINS, _bin
+from ..utils.log import get_logger
 
-__all__ = ["fpfh_brick"]
+log = get_logger(__name__)
+
+__all__ = ["fpfh_brick", "emit_overflow_warning"]
 
 
 def _cell_ids(points, valid, h):
@@ -105,7 +108,8 @@ def fpfh_brick(
     max_cells: int = 1024,
     chunk_rows: int = 512,
 ):
-    """(N, 33) float32 FPFH descriptors (+ (N,) validity), brick layout.
+    """(N, 33) float32 FPFH descriptors, (N,) validity, and the scalar
+    overflow count, in brick layout.
 
     ``slots`` bounds per-cell candidate capacity (at the ring shape —
     3 mm voxel grid, 15 mm cells — a surface patch holds ~25 points, so
@@ -114,6 +118,15 @@ def fpfh_brick(
     ``chunk_rows`` is the lax.map tile that keeps the (rows, 27·S)
     broadcast intermediates inside a sane working set under the ring
     program's 24-view vmap.
+
+    The third return value counts valid points lost to slot/cell-budget
+    overflow: they still receive a descriptor (overflow never drops a
+    QUERY row) but stop appearing as candidates in their neighbors'
+    histograms, silently thinning descriptors when the cloud outgrows
+    the (slots, max_cells) ring shape.  Same channel discipline as
+    ``brick_knn``'s drop count: in-graph scalar for traced callers,
+    :func:`emit_overflow_warning` for eager ones (no host callbacks
+    from jitted code — see brickknn._emit_drop_warning for why).
     """
     n = points.shape[0]
     if valid is None:
@@ -247,4 +260,22 @@ def fpfh_brick(
     rows = jnp.where(orig_s >= 0, orig_s, n)
     out_f = jnp.zeros((n + 1, FPFH_DIM), jnp.float32).at[rows].set(f_s)[:n]
     out_v = jnp.zeros((n + 1,), bool).at[rows].set(fv_s)[:n]
-    return out_f, out_v
+    # Valid rows whose brick slot was thinned away (candidate-side loss).
+    n_overflow = jnp.sum(val_s & ~ok)
+    return out_f, out_v, n_overflow
+
+
+def emit_overflow_warning(n_overflow, n_total) -> None:
+    """Surface candidate thinning at runtime — EAGER calls only (under a
+    jit the count is a tracer and nothing is staged; traced consumers
+    read the returned count instead)."""
+    if isinstance(n_overflow, jax.core.Tracer):
+        return
+    no = int(n_overflow)
+    if no > 0:
+        log.warning(
+            "fpfh_brick thinned %d/%d points out of the candidate set "
+            "(cell-slot overflow or cell budget); their neighbors' "
+            "descriptors are computed from fewer pairs — raise "
+            "`slots`/`max_cells` (MergeParams.fpfh_slots/fpfh_max_cells) "
+            "for full coverage", no, int(n_total))

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from . import _backend
+
 _TQ = 1024      # queries per grid step: (TQ, KC) f32 distance tile in VMEM
 _KC = 1024      # keys per chunk
 # Index bits packed into the low distance mantissa (see kernel): bounds the
@@ -42,7 +44,7 @@ _IDX_MASK = (1 << _IDX_BITS) - 1
 
 def available() -> bool:
     """Mosaic kernels are TPU-only ('axon' = the tunneled dev TPU)."""
-    return jax.default_backend() in ("tpu", "axon")
+    return _backend.tpu_backend()
 
 
 def max_keys() -> int:

@@ -27,6 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from . import _backend
 from .brickknn import brick_knn
 from .gridknn import grid_knn
 from .knn import check_neighbors, knn
@@ -59,11 +60,16 @@ def _self_knn(points, k, valid, exclude_self, method="auto"):
             # high-recall engine costs ~1.2× Morton at 1M/k=20 (was
             # 4.9× in XLA), so recall ≥ 0.99 is the large-N default on
             # TPU when the kernel's k/n caps hold; elsewhere Morton
-            # (~0.93) remains the cheap default.
-            from . import brickknn_pallas as _bkp
+            # (~0.93) remains the cheap default.  The kernel module is
+            # imported only behind the backend gate — off-TPU this path
+            # must not depend on pallas importability.
+            if _backend.tpu_backend():
+                from . import brickknn_pallas as _bkp
 
-            method = ("rescue" if _bkp.available() and k <= _bkp.MAX_K
-                      and n <= _bkp.MAX_N else "morton")
+                method = ("rescue" if k <= _bkp.MAX_K and n <= _bkp.MAX_N
+                          else "morton")
+            else:
+                method = "morton"
     if method == "morton":
         return morton_knn(points, k, points_valid=valid,
                           exclude_self=exclude_self)
@@ -259,7 +265,14 @@ def _tiered_rank_search(rank: jnp.ndarray, targets: jnp.ndarray):
     nondecreasing, "block max < t" ⟺ "entire block < t", so the three
     counts add up to exactly #(rank < t) — the 'left' insertion point."""
     n = rank.shape[0]
-    b = max(8, -(-int(round(n ** (1.0 / 3.0) + 0.5)) // 8) * 8)
+    # Smallest multiple-of-8 block edge with b³ ≥ n. An explicit guard
+    # rather than a float cube root: `int(round(n ** (1/3) + 0.5))` sits
+    # one float-rounding away from undershooting on large exact cubes,
+    # and an undershot b makes `pad` negative → silent truncation of the
+    # rank table. Compile-time only (n is a static shape).
+    b = 8
+    while b ** 3 < n:
+        b += 8
     big = jnp.iinfo(rank.dtype).max
     pad = b ** 3 - n
     rp = jnp.concatenate([rank, jnp.full((pad,), big, rank.dtype)]) \

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import _backend
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -62,7 +63,7 @@ _INTERIOR_DELTAS = (64, -64, 8, -8, 1, -1)
 
 
 def available() -> bool:
-    return jax.default_backend() in ("tpu", "axon")
+    return _backend.tpu_backend()
 
 
 def _axis_coords(shape):
@@ -136,12 +137,13 @@ def matvec_pallas_v2(x, W, nbr, block_valid, interpret: bool = False,
     from .poisson_sparse import _FACES_ALL, _OPP, _PLACE
 
     m = x.shape[0]
-    faces = x[:, jnp.asarray(_FACES_ALL)].reshape(m, 6, BS * BS)
+    faces = x[:, jnp.asarray(_FACES_ALL, jnp.int32)].reshape(m, 6, BS * BS)
     fpad = jnp.concatenate([faces, jnp.zeros((1, 6, BS * BS), x.dtype)])
     mq = jnp.minimum(nbr, m)  # absent -> zero dump row
     halos = jnp.stack([fpad[:, _OPP[d], :][mq[:, d]] for d in range(6)],
                       axis=1).reshape(m, 6 * BS * BS)
-    place_all = jnp.concatenate([jnp.asarray(_PLACE[d]) for d in range(6)],
+    place_all = jnp.concatenate([jnp.asarray(_PLACE[d], jnp.float32)
+                                 for d in range(6)],
                                 axis=0)                    # (384, 512)
 
     mp = ((m + cb - 1) // cb) * cb

@@ -42,6 +42,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from . import _backend
 from . import poisson as dense_poisson
 from ..utils.log import get_logger
 
@@ -221,9 +222,12 @@ for _ax, (_coord, _stride) in enumerate(
 
 def _dir_consts(d):
     delta, interior, at_face, src, fmap, pos, src64, fmap64 = _DIRS[d]
-    return (delta, jnp.asarray(interior), jnp.asarray(at_face),
-            jnp.asarray(src), jnp.asarray(fmap), jnp.asarray(pos),
-            jnp.asarray(src64), jnp.asarray(fmap64))
+    return (delta,
+            jnp.asarray(interior, jnp.float32),
+            jnp.asarray(at_face, jnp.float32),
+            jnp.asarray(src, jnp.int32), jnp.asarray(fmap, jnp.int32),
+            jnp.asarray(pos, jnp.int32), jnp.asarray(src64, jnp.int32),
+            jnp.asarray(fmap64, jnp.int32))
 
 
 # The halo a direction-d neighbor supplies is ITS face on the opposite
@@ -268,7 +272,8 @@ def _neighbor_sum(x, nbr, dirichlet=None):
             have = (nbr[:, d] < m)[:, None]
             dvals = jnp.take(dirichlet[:, d], fmap64, axis=1)
             halo = jnp.where(have, halo, dvals)
-        acc = acc + jnp.matmul(halo, jnp.asarray(_PLACE[d]), precision=hi)
+        acc = acc + jnp.matmul(halo, jnp.asarray(_PLACE[d], jnp.float32),
+                               precision=hi)
     return acc
 
 
@@ -297,7 +302,9 @@ def _div_band_flat(Vflat, nbr):
             delta, interior, _, _, _, _, _, _ = _dir_consts(d)
             out = out + sign * (jnp.roll(x, -delta, axis=1) * interior)
             halo = fpad[:, _OPP[d], :][nbr[:, d]]
-            out = out + sign * jnp.matmul(halo, jnp.asarray(_PLACE[d]),
+            out = out + sign * jnp.matmul(halo,
+                                          jnp.asarray(_PLACE[d],
+                                                      jnp.float32),
                                           precision=hi)
     return out
 
@@ -486,6 +493,8 @@ def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
     R, Rc = resolution, coarse_resolution
     cr = (Rc - 1.0) / (R - 1.0)
     # Block footprint spans 9·cr coarse cells (+1 for floor straddle).
+    # int() runs on a trace-time python float (cr derives from the two
+    # STATIC resolution args), never a tracer. # jaxlint: disable=host-sync-in-jit
     W = int(_np.floor(9.0 * cr + 1.0)) + 2
     m = block_coords.shape[0]
     coarse_flat = coarse_chi.reshape(-1)
@@ -563,14 +572,18 @@ def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int,
     (`ops/poisson_pallas.py`) on TPU backends, the XLA roll/face/matmul
     form elsewhere (it remains the oracle — parity pinned in
     tests/test_poisson_pallas.py)."""
-    from . import poisson_pallas
-
     band = block_valid[:, None]
     dinv = jnp.where(band, 1.0 / (6.0 + W), 0.0)
 
+    # Resolve the engine from the backend alone so the kernel module (and
+    # with it jax.experimental.pallas) is only imported on the path that
+    # uses it — CPU-only deployments must never touch pallas (round-5
+    # advisor finding; enforced by the `pallas-import` jaxlint rule).
     if use_pallas is None:
-        use_pallas = poisson_pallas.available()
+        use_pallas = _backend.tpu_backend()
     if use_pallas:
+        from . import poisson_pallas
+
         # v2 hybrid (XLA face/halo prep + fused roll/place kernel):
         # 31 ms/apply vs 52 ms XLA at the 1M depth-10 shape — the pure
         # whole-brick-DMA kernel (matvec_pallas) measured DMA-issue-bound

@@ -31,7 +31,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from . import nn_pallas
+from . import _backend
 from .knn import knn
 
 
@@ -43,14 +43,20 @@ def _nn1(moved, dst_pts, dst_valid, src_valid, table=None):
     key exists. ``table`` optionally reuses a precomputed
     ``nn_pallas.key_table`` when the same keys are swept repeatedly."""
     n = dst_pts.shape[0]
-    if nn_pallas.available() and n <= nn_pallas.max_keys():
-        if table is None:
-            table = nn_pallas.key_table(dst_pts, dst_valid)
-        d2, idx = nn_pallas.nearest_one(moved, *table)
-        found = jnp.isfinite(d2)
-        if src_valid is not None:
-            found = found & src_valid
-        return idx, found, jnp.where(jnp.isfinite(d2), d2, jnp.inf)
+    if _backend.tpu_backend():
+        # Kernel module imported only on the TPU path: nn_pallas imports
+        # jax.experimental.pallas at module scope, and CPU deployments
+        # must not depend on pallas importability (pallas-import rule).
+        from . import nn_pallas
+
+        if n <= nn_pallas.max_keys():
+            if table is None:
+                table = nn_pallas.key_table(dst_pts, dst_valid)
+            d2, idx = nn_pallas.nearest_one(moved, *table)
+            found = jnp.isfinite(d2)
+            if src_valid is not None:
+                found = found & src_valid
+            return idx, found, jnp.where(jnp.isfinite(d2), d2, jnp.inf)
     d2, idx, nbv = knn(dst_pts, 1, queries=moved,
                        points_valid=dst_valid, queries_valid=src_valid,
                        q_tile=min(4096, max(256, moved.shape[0])),
@@ -500,10 +506,14 @@ def icp(
         mults = jnp.asarray(schedule, jnp.float32)
 
     # The key side is constant across iterations: build the kernel table
-    # once (a transpose + squared norms), not per sweep.
-    table = (nn_pallas.key_table(dst_pts, dst_valid)
-             if nn_pallas.available()
-             and dst_pts.shape[0] <= nn_pallas.max_keys() else None)
+    # once (a transpose + squared norms), not per sweep.  Lazy gated
+    # import — see _nn1.
+    table = None
+    if _backend.tpu_backend():
+        from . import nn_pallas
+
+        if dst_pts.shape[0] <= nn_pallas.max_keys():
+            table = nn_pallas.key_table(dst_pts, dst_valid)
 
     def correspondences(T, pts, valid, m2=1.0):
         moved = transform_points(T, pts)
@@ -532,6 +542,8 @@ def icp(
 
     T = init.astype(jnp.float32)
     if warmup_subsample > 1 and max_iterations >= 8:
+        # int() runs on a static python scalar (max_iterations is a
+        # static argname), never a tracer. # jaxlint: disable=host-sync-in-jit
         n_warm = int(round(0.8 * max_iterations))
         T, _ = jax.lax.scan(
             make_step(src_pts[::warmup_subsample],

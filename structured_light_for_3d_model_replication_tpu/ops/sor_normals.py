@@ -173,8 +173,8 @@ def _sor_normals_impl(points, valid, std_ratio, nb_neighbors: int,
         cnt = jnp.maximum(jnp.sum(W, axis=2), 1.0)        # (C, B)
         s1 = jnp.einsum("cbn,cni->cbi", W, kp, precision=hi)
         # Six unique second moments of the window points.
-        ii = jnp.asarray([0, 0, 0, 1, 1, 2])
-        jj = jnp.asarray([0, 1, 2, 1, 2, 2])
+        ii = jnp.asarray([0, 0, 0, 1, 1, 2], jnp.int32)
+        jj = jnp.asarray([0, 1, 2, 1, 2, 2], jnp.int32)
         op = kp[..., ii] * kp[..., jj]                    # (C, 3B, 6)
         s2 = jnp.einsum("cbn,cnu->cbu", W, op, precision=hi)
         mu_n = s1 / cnt[..., None]

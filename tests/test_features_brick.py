@@ -37,7 +37,7 @@ def test_brick_matches_gather_when_cap_unbound(rng):
     radius = 12.0  # ~<30 in-radius neighbors at this density
 
     f_g, v_g = features.fpfh(pts, nrm, radius, valid=nv, max_nn=100)
-    f_b, v_b = fpfh_brick(pts, nrm, radius, valid=nv, slots=64)
+    f_b, v_b, _ = fpfh_brick(pts, nrm, radius, valid=nv, slots=64)
     f_g, v_g = np.asarray(f_g), np.asarray(v_g)
     f_b, v_b = np.asarray(f_b), np.asarray(v_b)
 
@@ -59,7 +59,7 @@ def test_brick_close_when_cap_binds(rng):
     radius = 15.0  # >100 in-radius neighbors for most points
 
     f_g, v_g = features.fpfh(pts, nrm, radius, valid=nv, max_nn=100)
-    f_b, v_b = fpfh_brick(pts, nrm, radius, valid=nv, slots=64)
+    f_b, v_b, _ = fpfh_brick(pts, nrm, radius, valid=nv, slots=64)
     f_g, f_b = np.asarray(f_g), np.asarray(f_b)
     both = np.asarray(v_g) & np.asarray(v_b)
     assert both.mean() > 0.99
@@ -77,8 +77,8 @@ def test_brick_rotation_invariance(rng):
     R = np.array([[np.cos(theta), -np.sin(theta), 0],
                   [np.sin(theta), np.cos(theta), 0],
                   [0, 0, 1]], np.float32)
-    f0, v0 = fpfh_brick(pts, nrm, 12.0, valid=nv, slots=64)
-    f1, v1 = fpfh_brick(pts @ R.T, nrm @ R.T, 12.0, valid=nv, slots=64)
+    f0, v0, _ = fpfh_brick(pts, nrm, 12.0, valid=nv, slots=64)
+    f1, v1, _ = fpfh_brick(pts @ R.T, nrm @ R.T, 12.0, valid=nv, slots=64)
     both = np.asarray(v0) & np.asarray(v1)
     f0, f1 = np.asarray(f0)[both], np.asarray(f1)[both]
     cos = np.sum(f0 * f1, axis=1) / np.maximum(
@@ -105,8 +105,11 @@ def test_preprocess_brick_engine_wiring(rng):
             lambda p, v: merge._preprocess(p, v, 8.0, 12, 100, engine)))
         return f(jnp.asarray(views), jnp.asarray(valid))
 
-    dpts_g, val_g, nrm_g, feat_g = map(np.asarray, run("gather"))
-    dpts_b, val_b, nrm_b, feat_b = map(np.asarray, run("brick"))
+    dpts_g, val_g, nrm_g, feat_g, over_g = map(np.asarray, run("gather"))
+    dpts_b, val_b, nrm_b, feat_b, over_b = map(np.asarray, run("brick"))
+
+    assert (over_g == 0).all()        # gather engine never thins
+    assert (over_b == 0).all()        # ample default ring shape
 
     np.testing.assert_array_equal(dpts_g, dpts_b)  # shared downsample
     assert (val_g == val_b).mean() > 0.99
@@ -124,7 +127,7 @@ def test_brick_handles_invalid_and_padding(rng):
     pts, nrm, nv = _surface(rng, 800)
     valid = nv.copy()
     valid[::5] = False
-    f, v = fpfh_brick(pts, nrm, 12.0, valid=valid, slots=64)
+    f, v, _ = fpfh_brick(pts, nrm, 12.0, valid=valid, slots=64)
     f, v = np.asarray(f), np.asarray(v)
     assert not v[::5].any()
     assert (f[~v] == 0).all()
@@ -132,3 +135,18 @@ def test_brick_handles_invalid_and_padding(rng):
     # Descriptors are L1-normalized to 100 per 11-bin block.
     blocks = f[v].reshape(-1, 3, 11).sum(axis=-1)
     np.testing.assert_allclose(blocks, 100.0, atol=1e-3)
+
+
+def test_brick_overflow_count(rng):
+    pts, nrm, nv = _surface(rng, 1500)
+
+    _, _, n_over = fpfh_brick(pts, nrm, 12.0, valid=nv, slots=64)
+    assert int(n_over) == 0  # ample ring shape: nothing thinned
+
+    # Starve the per-cell slots: candidates get thinned (count > 0) but
+    # every valid query still receives a descriptor.
+    f, v, n_over = fpfh_brick(pts, nrm, 12.0, valid=nv, slots=8,
+                              max_cells=64)
+    assert int(n_over) > 0
+    assert int(n_over) <= int(nv.sum())
+    assert np.isfinite(np.asarray(f)).all()

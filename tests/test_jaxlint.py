@@ -1,0 +1,443 @@
+"""jaxlint (`analysis/`) — every rule gets a triggering, a clean, and a
+suppressed fixture; the CLI gate is pinned end-to-end (nonzero on any
+injected fixture, zero on the repo at HEAD modulo the committed
+baseline).
+
+Fixtures are SOURCE STRINGS linted from a temp tree — the linter parses
+this test file too, and string constants are invisible to its AST walk.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.analysis import (
+    REGISTRY,
+    apply_baseline,
+    lint_file,
+    make_baseline,
+)
+from structured_light_for_3d_model_replication_tpu.analysis.__main__ import (
+    main as jaxlint_main,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+EXPECTED_RULES = {
+    "pallas-import", "host-sync-in-jit", "implicit-dtype",
+    "static-argnames", "mutable-global", "key-reuse",
+}
+
+# rule → (rel_path, triggering source, clean source, suppressed source).
+# The rel_path matters: implicit-dtype only fires under ops/, and
+# *_pallas.py / tests/ are exempt from pallas-import.
+FIXTURES = {
+    "pallas-import": (
+        "ops/mod.py",
+        """
+        from . import decode_pallas
+        """,
+        """
+        from ._backend import tpu_backend
+
+        def dispatch(x):
+            if tpu_backend():
+                from . import decode_pallas
+                return decode_pallas.run(x)
+            return x
+        """,
+        """
+        from . import decode_pallas  # jaxlint: disable=pallas-import -- parity harness
+        """,
+    ),
+    "host-sync-in-jit": (
+        "ops/mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum().item()
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum()
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum().item()  # jaxlint: disable=host-sync-in-jit
+        """,
+    ),
+    "implicit-dtype": (
+        "ops/mod.py",
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x)
+        """,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x, jnp.float32)
+        """,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x)  # jaxlint: disable=implicit-dtype
+        """,
+    ),
+    "static-argnames": (
+        "ops/mod.py",
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("kk",))
+        def f(k):
+            return k
+        """,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def f(x, k=3):
+            return x
+        """,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("kk",))  # jaxlint: disable=static-argnames
+        def f(k):
+            return k
+        """,
+    ),
+    "mutable-global": (
+        "ops/mod.py",
+        """
+        import jax
+
+        _CACHE = {}
+
+        @jax.jit
+        def f(x):
+            return x + len(_CACHE)
+        """,
+        """
+        import jax
+
+        _SHAPES = (8, 128)
+
+        @jax.jit
+        def f(x):
+            return x + _SHAPES[0]
+        """,
+        """
+        import jax
+
+        _CACHE = {}
+
+        @jax.jit
+        def f(x):
+            return x + len(_CACHE)  # jaxlint: disable=mutable-global
+        """,
+    ),
+    "key-reuse": (
+        "ops/mod.py",
+        """
+        import jax
+
+        def f():
+            key = jax.random.PRNGKey(0)
+            a = jax.random.uniform(key, (3,))
+            b = jax.random.normal(key, (3,))
+            return a + b
+        """,
+        """
+        import jax
+
+        def f():
+            key = jax.random.PRNGKey(0)
+            k1, k2 = jax.random.split(key)
+            a = jax.random.uniform(k1, (3,))
+            b = jax.random.normal(k2, (3,))
+            return a + b
+        """,
+        """
+        import jax
+
+        def f():
+            key = jax.random.PRNGKey(0)
+            a = jax.random.uniform(key, (3,))
+            b = jax.random.normal(key, (3,))  # jaxlint: disable=key-reuse
+            return a + b
+        """,
+    ),
+}
+
+
+def _lint(tmp_path: Path, rel_path: str, source: str):
+    path = tmp_path / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    return lint_file(path, rel_path)
+
+
+def test_registry_has_the_six_rules():
+    assert EXPECTED_RULES <= set(REGISTRY)
+    assert set(FIXTURES) == EXPECTED_RULES
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_RULES))
+def test_rule_triggers(rule, tmp_path):
+    rel_path, bad, _, _ = FIXTURES[rule]
+    hits = [v for v in _lint(tmp_path, rel_path, bad) if v.rule == rule]
+    assert hits, f"{rule} fixture did not trigger"
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_RULES))
+def test_rule_clean_fixture(rule, tmp_path):
+    rel_path, _, good, _ = FIXTURES[rule]
+    hits = [v for v in _lint(tmp_path, rel_path, good) if v.rule == rule]
+    assert not hits, f"{rule} fired on the clean fixture: {hits}"
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_RULES))
+def test_rule_suppression_comment(rule, tmp_path):
+    rel_path, _, _, suppressed = FIXTURES[rule]
+    hits = [v for v in _lint(tmp_path, rel_path, suppressed)
+            if v.rule == rule]
+    assert not hits, f"disable={rule} comment was not honored: {hits}"
+
+
+def test_suppression_on_preceding_comment_line(tmp_path):
+    src = """
+    import jax.numpy as jnp
+
+    def f(x):
+        # jaxlint: disable=implicit-dtype -- dtype probe
+        return jnp.asarray(x)
+    """
+    assert not _lint(tmp_path, "ops/mod.py", src)
+
+
+def test_implicit_dtype_scoped_to_ops(tmp_path):
+    _, bad, _, _ = FIXTURES["implicit-dtype"]
+    assert not _lint(tmp_path, "models/mod.py", bad)
+
+
+def test_pallas_import_exemptions(tmp_path):
+    _, bad, _, _ = FIXTURES["pallas-import"]
+    assert not _lint(tmp_path, "ops/mod_pallas.py", bad)
+    assert not _lint(tmp_path, "tests/test_mod.py", bad)
+    assert not _lint(tmp_path, "scripts/probe_mod.py", bad)
+
+
+def test_static_argnames_unhashable_default(tmp_path):
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def f(x, k=[1, 2]):
+        return x
+    """
+    hits = _lint(tmp_path, "ops/mod.py", src)
+    assert any(v.rule == "static-argnames" and "unhashable" in v.message
+               for v in hits)
+
+
+def test_parse_error_is_reported(tmp_path):
+    hits = _lint(tmp_path, "ops/mod.py", "def f(:\n")
+    assert [v.rule for v in hits] == ["parse-error"]
+
+
+def test_unreadable_file_is_reported_not_raised(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_bytes(b"x = 'caf\xe9'\n")  # not utf-8
+    hits = lint_file(path, "mod.py")
+    assert [v.rule for v in hits] == ["parse-error"]
+    assert "could not read" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# Baseline machinery
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_grandfathers_then_ratchets(tmp_path):
+    rel_path, bad, _, _ = FIXTURES["implicit-dtype"]
+    violations = _lint(tmp_path, rel_path, bad)
+    doc = make_baseline(violations)
+
+    new, grandfathered, stale = apply_baseline(violations, doc)
+    assert not new and grandfathered == len(violations) and not stale
+
+    # One MORE violation than baselined → the whole group surfaces.
+    extra = bad + "\n\ndef g(y):\n    return jnp.array(y)\n"
+    more = _lint(tmp_path, rel_path + "x", extra)  # fresh file name
+    doc2 = {"entries": [{"path": rel_path + "x", "rule": "implicit-dtype",
+                         "count": 1}]}
+    new, grandfathered, stale = apply_baseline(more, doc2)
+    assert len(new) == len(more) and grandfathered == 0
+
+    # Fixing violations leaves a STALE entry (ratchet-down signal).
+    new, grandfathered, stale = apply_baseline([], doc)
+    assert not new and stale
+
+
+def test_make_baseline_keeps_justifications(tmp_path):
+    rel_path, bad, _, _ = FIXTURES["implicit-dtype"]
+    violations = _lint(tmp_path, rel_path, bad)
+    old = make_baseline(violations)
+    old["entries"][0]["justification"] = "intentional dtype probe"
+    doc = make_baseline(violations, old)
+    assert doc["entries"][0]["justification"] == "intentional dtype probe"
+
+
+# ---------------------------------------------------------------------------
+# CLI gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_RULES))
+def test_cli_exits_nonzero_on_injected_fixture(rule, tmp_path, capsys):
+    rel_path, bad, _, _ = FIXTURES[rule]
+    path = tmp_path / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(bad), encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_exits_zero_on_clean_tree(tmp_path, capsys):
+    (tmp_path / "ops").mkdir()
+    (tmp_path / "ops" / "mod.py").write_text("x = 1\n", encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_update_baseline_roundtrip(tmp_path, capsys):
+    rel_path, bad, _, _ = FIXTURES["host-sync-in-jit"]
+    path = tmp_path / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(bad), encoding="utf-8")
+
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 1
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--update-baseline"]) == 0
+    baseline = tmp_path / "jaxlint_baseline.json"
+    assert baseline.exists()
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 0
+    # --no-baseline reports everything again.
+    assert jaxlint_main(["--check", str(tmp_path), "-q",
+                         "--no-baseline"]) == 1
+
+    # A NEW violation on top of the grandfathered one fails the gate.
+    extra = textwrap.dedent(bad) + (
+        "\n@jax.jit\ndef g(x):\n    return x.mean().item()\n")
+    path.write_text(extra, encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_update_baseline_rejects_corrupt_baseline(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text("x = 1\n", encoding="utf-8")
+    (tmp_path / "jaxlint_baseline.json").write_text("{not json",
+                                                    encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--update-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_update_baseline_cannot_grandfather_parse_errors(tmp_path,
+                                                             capsys):
+    (tmp_path / "mod.py").write_text("def f(:\n", encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--update-baseline"]) == 0
+    doc = json.loads((tmp_path / "jaxlint_baseline.json").read_text(
+        encoding="utf-8"))
+    assert not doc["entries"]  # parse-error is never baselined …
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 1  # … gate holds
+    capsys.readouterr()
+
+
+def test_cli_subtree_check_honors_ancestor_baseline(tmp_path, capsys):
+    """The default baseline resolves UPWARD from the checked root, and
+    violation paths are matched relative to its directory — so a subtree
+    invocation still honors the committed repo baseline."""
+    rel_path, bad, _, _ = FIXTURES["implicit-dtype"]
+    path = tmp_path / "pkg" / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(bad), encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--update-baseline"]) == 0
+
+    assert jaxlint_main(["--check", str(tmp_path / "pkg"), "-q"]) == 0
+    # New violations in the subtree still fail the subtree run.
+    (path.parent / "extra.py").write_text(
+        "import jax.numpy as jnp\n\ndef g(y):\n    return jnp.array(y)\n",
+        encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path / "pkg"), "-q"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_subtree_update_keeps_unlinted_entries(tmp_path, capsys):
+    _, bad, _, _ = FIXTURES["implicit-dtype"]
+    for sub in ("a", "b"):
+        path = tmp_path / sub / "ops" / "mod.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(bad), encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--update-baseline"]) == 0
+
+    # Fix only a/, then ratchet from a SUBTREE run: b/'s entry survives.
+    (tmp_path / "a" / "ops" / "mod.py").write_text("x = 1\n",
+                                                   encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path / "a"),
+                         "--update-baseline"]) == 0
+    doc = json.loads((tmp_path / "jaxlint_baseline.json").read_text(
+        encoding="utf-8"))
+    assert [e["path"] for e in doc["entries"]] == ["b/ops/mod.py"]
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert jaxlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in EXPECTED_RULES:
+        assert rule in out
+
+
+# ---------------------------------------------------------------------------
+# Self-check: the repo at HEAD is clean modulo the committed baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_modulo_baseline(capsys):
+    rc = jaxlint_main(["--check", str(REPO_ROOT)])
+    out = capsys.readouterr()
+    assert rc == 0, f"jaxlint found new violations:\n{out.out}{out.err}"
+
+
+def test_committed_baseline_entries_are_justified():
+    baseline = REPO_ROOT / "jaxlint_baseline.json"
+    data = json.loads(baseline.read_text(encoding="utf-8"))
+    for entry in data["entries"]:
+        just = entry.get("justification", "")
+        assert just and not just.startswith("TODO"), (
+            f"baseline entry {entry['path']} [{entry['rule']}] needs a "
+            "real justification (see docs/JAXLINT.md)")

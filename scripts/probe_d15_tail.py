@@ -1,0 +1,65 @@
+"""Reproduce and bisect the BENCH r5 depth-15 p90 error tail (4.63 vox
+vs 0.29 median) at the full 1M-point bench shape, isolating the coarse
+grid as the variable. Jacobi preconditioner on both runs so the only
+difference is `coarse_depth`; extraction via the DEVICE path
+(`ops/marching_jax.py`) — 13.8M faces, which also exercises it at
+production scale.
+
+Measured on this config (CPU, 2026-08):
+    coarse 128³ (ratio 256): err med 0.33  p90 9.25  max 24.5 vox
+    coarse 256³ (ratio 128): err med 0.13  p90 0.32  max  1.3 vox
+— the tail is the unresolved coarse Dirichlet halo across the thin
+band; `reconstruct_sparse` now auto-raises the coarse grid so the
+coarse/fine ratio stays ≤ 128 (see docs/MESHING.md).
+"""
+
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    marching_jax,
+    poisson_sparse,
+)
+
+
+def main():
+    n_pts = 1 << 20
+    u = np.random.default_rng(4).normal(size=(n_pts, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    r_sphere = 25.0
+    anchors = np.asarray(
+        [[s * 1000.0, t * 1000.0, v * 1000.0]
+         for s in (-1, 1) for t in (-1, 1) for v in (-1, 1)], np.float32)
+    pts = jnp.asarray(np.vstack([(u * r_sphere).astype(np.float32),
+                                 anchors]))
+    nrm = jnp.asarray(np.vstack(
+        [u.astype(np.float32),
+         np.tile([1.0, 0.0, 0.0], (8, 1)).astype(np.float32)]))
+
+    for cd in (7, 8):
+        t0 = time.time()
+        grid, nb = poisson_sparse.reconstruct_sparse(
+            pts, nrm, depth=15, cg_iters=100, max_blocks=131_072,
+            coarse_depth=cd, preconditioner="jacobi")
+        solve_s = time.time() - t0
+        voxel = float(grid.scale)
+        t0 = time.time()
+        mesh = marching_jax.extract_sparse_jax(grid)
+        ext_s = time.time() - t0
+        rad = np.linalg.norm(mesh.vertices, axis=1)
+        shell = rad < 500.0
+        err = np.abs(rad[shell] - r_sphere) / voxel
+        print(f"coarse_depth={cd}: solve {solve_s:.0f}s extract "
+              f"{ext_s:.0f}s blocks {int(nb)} faces {len(mesh.faces)} "
+              f"shell {shell.mean():.3f} err med {np.median(err):.2f} "
+              f"p90 {np.percentile(err, 90):.2f} "
+              f"p99 {np.percentile(err, 99):.2f} max {err.max():.1f} vox",
+              flush=True)
+        del grid, mesh
+
+
+if __name__ == "__main__":
+    main()

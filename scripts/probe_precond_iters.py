@@ -1,0 +1,59 @@
+"""Measure fine-band PCG iteration counts per preconditioner on one
+shared system (no per-variant re-setup). Iteration counts are
+platform-independent — this is how the additive/vcycle defaults in
+`ops/poisson_sparse.PoissonParams` were picked; wall-clock per variant
+is hardware-specific and belongs to the driver's bench run.
+
+Measured here (depth-9 sphere, 37.9k blocks, rtol 3e-4):
+jacobi 65 · vcycle 28 · chebyshev 18 · additive 26 at its tuned
+default (ω=2, ci=4; the sweep below shows the plateau — ω∈[2,4] and
+ci∈[4,8] all land 26-28, ω=1 costs 35, unmasked costs +6-9 more).
+"""
+
+import sys
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from structured_light_for_3d_model_replication_tpu.ops import (  # noqa: E402
+    poisson as dense_poisson,
+    poisson_sparse as ps,
+)
+
+
+def main(depth=9, coarse_depth=7, n=60_000):
+    rng = np.random.default_rng(1)
+    u = rng.normal(size=(n, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    pts = jnp.asarray((u * 50.0).astype(np.float32))
+    nrm = jnp.asarray(u.astype(np.float32))
+    valid = jnp.ones(pts.shape[0], bool)
+    R, Rc = 2 ** depth, 2 ** coarse_depth
+    (rhs, W, nbr, bvalid, bcoords, *_rest) = ps._setup_sparse(
+        pts, nrm, valid, R, 49_152, jnp.float32(4.0))
+    print("blocks", int(_rest[-1]), flush=True)
+    coarse = dense_poisson._solve(pts, nrm, valid, Rc, 300,
+                                  jnp.float32(4.0), rtol=3e-4)
+    b, x0 = ps._prolong_band(coarse.chi, rhs, nbr, bvalid, bcoords, R, Rc)
+    coarse_W = dense_poisson.screen_weights(coarse.density,
+                                            jnp.float32(4.0))
+
+    _, it_j = ps._cg_sparse(b, W, x0, nbr, bvalid, 300, jnp.float32(3e-4))
+    print(f"jacobi: iters {int(it_j)}", flush=True)
+    for pre in ("vcycle", "chebyshev"):
+        _, it = ps._pcg_sparse(b, W, x0, nbr, bvalid, bcoords, coarse_W,
+                               R, Rc, 300, rtol=jnp.float32(3e-4),
+                               precond=pre)
+        print(f"{pre}: iters {int(it)}", flush=True)
+    for om in (1.0, 2.0, 3.0):
+        for ci in (4, 8, 16):
+            _, it = ps._pcg_sparse(
+                b, W, x0, nbr, bvalid, bcoords, coarse_W, R, Rc, 300,
+                rtol=jnp.float32(3e-4), precond="additive",
+                precond_coarse_iters=ci, smooth_omega=jnp.float32(om))
+            print(f"additive om={om} ci={ci}: iters {int(it)}", flush=True)
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:]))

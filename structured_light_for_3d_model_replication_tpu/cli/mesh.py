@@ -39,6 +39,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drop the dominant RANSAC plane first")
     p.add_argument("--remove-outliers", action="store_true",
                    help="statistical outlier removal first (20, 2.0)")
+    p.add_argument("--preconditioner",
+                   choices=("additive", "vcycle", "chebyshev", "jacobi"),
+                   default="additive",
+                   help="fine-band CG preconditioner of the deep (sparse) "
+                        "Poisson path (docs/MESHING.md)")
+    p.add_argument("--extraction", choices=("auto", "host", "device"),
+                   default="auto",
+                   help="iso-surface extractor: device marching on TPU "
+                        "backends (auto), or force either engine")
     return p
 
 
@@ -56,7 +65,8 @@ def main(argv=None) -> int:
     mesh = meshing.reconstruct_stl(
         cloud, args.output, mode=args.mode, depth=args.depth,
         quantile_trim=args.trim, orientation_mode=args.orientation,
-        radii_multipliers=args.radii)
+        radii_multipliers=args.radii,
+        preconditioner=args.preconditioner, extraction=args.extraction)
     print(f"{args.input}: {len(cloud)} pts -> {args.output} "
           f"({len(mesh.vertices)} verts, {len(mesh.faces)} faces)",
           file=sys.stderr)

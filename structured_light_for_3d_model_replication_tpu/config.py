@@ -140,6 +140,13 @@ class MeshConfig:
     density_trim_quantile: float = 0.02
     normal_orientation: str = "radial"  # "radial" | "tangent" | "camera"
     bpa_radius_multipliers: tuple = (1.0, 2.0, 4.0)
+    # Deep (sparse, depth > 8) path defaults, recorded here like every
+    # other MeshConfig field (this dataclass documents the meshing knob
+    # surface; the LIVE knobs are mesh_from_cloud(preconditioner=,
+    # extraction=) and `cli mesh --preconditioner/--extraction`). See
+    # ops/poisson_sparse.PoissonParams / ops/marching.extract_sparse.
+    poisson_preconditioner: str = "additive"  # | vcycle|chebyshev|jacobi
+    extraction_engine: str = "auto"  # "auto" | "host" | "device"
 
 
 @dataclasses.dataclass(frozen=True)

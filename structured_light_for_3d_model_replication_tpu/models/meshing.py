@@ -82,6 +82,9 @@ def mesh_from_cloud(
     camera: np.ndarray | None = None,
     radii_multipliers: str = "1,2,4",
     cg_iters: int = 300,
+    preconditioner: str = "additive",
+    extraction: str = "auto",
+    max_blocks: int | None = None,
 ) -> TriangleMesh:
     """Poisson-mesh a cloud (the body of `reconstruct_stl` / `mesh_360`).
 
@@ -93,9 +96,22 @@ def mesh_from_cloud(
     (`ops/poisson_sparse.py`), covering the reference octree's full
     acceptance envelope (default depth 10, `server/processing.py:293`;
     ≤ 16 accepted, > 16 rejected, `server/processing.py:207-208`).
+
+    ``preconditioner`` forwards to the sparse solver's fine-band CG
+    (`"additive"` two-level multigrid default; `"vcycle"`,
+    `"chebyshev"`, `"jacobi"` — see ``ops.poisson_sparse.PoissonParams``)
+    and ``extraction`` picks the iso-surface extractor (`"auto"` =
+    device marching on TPU backends, host NumPy oracle elsewhere — see
+    ``ops.marching.extract_sparse``); ``max_blocks`` overrides the
+    solver's band budget (None = its default, with its own
+    overflow-retry). All three only apply to the deep (sparse) path;
+    the dense ≤ 8 path is untouched.
     """
     if mode not in ("watertight", "surface"):
         raise ValueError(f"unknown mesh mode {mode!r}")
+    if extraction not in ("auto", "host", "device"):
+        # Fail BEFORE the multi-second solve, not in the extractor after.
+        raise ValueError(f"unknown extraction engine {extraction!r}")
     pts = np.asarray(cloud.points, np.float32)
     if pts.shape[0] < 16:
         raise ValueError(f"too few points to mesh ({pts.shape[0]})")
@@ -115,11 +131,14 @@ def mesh_from_cloud(
     if int(depth) > 8:
         # Block-budget overflow (→ dropped blocks → holes) is detected and
         # handled INSIDE reconstruct_sparse before the solve runs.
+        kw = {} if max_blocks is None else {"max_blocks": int(max_blocks)}
         grid, n_blocks = poisson_sparse.reconstruct_sparse(
-            pts, normals, depth=int(depth), cg_iters=cg_iters)
+            pts, normals, depth=int(depth), cg_iters=cg_iters,
+            preconditioner=preconditioner, **kw)
         log.info("sparse Poisson depth=%d: %d active blocks", int(depth),
                  int(n_blocks))
-        mesh = marching.extract_sparse(grid, quantile_trim=trim)
+        mesh = marching.extract_sparse(grid, quantile_trim=trim,
+                                       engine=extraction)
     else:
         grid = poisson.reconstruct(pts, normals, depth=int(depth),
                                    cg_iters=cg_iters)

@@ -1,11 +1,15 @@
-"""Iso-surface extraction: vectorized marching tetrahedra.
+"""Iso-surface extraction: vectorized marching tetrahedra (host oracle).
 
 Companion to :mod:`.poisson` — turns the device-computed implicit grid into a
 triangle mesh. Extraction output size is data-dependent (anathema to XLA's
-static shapes), so this stage runs on host as **vectorized NumPy over the
-active cells only**: the device hands back a dense (R,R,R) field, the host
-finds sign-change cells with one comparison pass, and all triangle math is
-batched array ops — no Python per-cell loop.
+static shapes), so this stage historically ran on host as **vectorized NumPy
+over the active cells only**: the device hands back a dense (R,R,R) field,
+the host finds sign-change cells with one comparison pass, and all triangle
+math is batched array ops — no Python per-cell loop. The band-sparse
+variant now also has a DEVICE path (:mod:`.marching_jax`, prefix-sum
+compaction to bounded static capacities) selected via
+``extract_sparse(engine=...)``; this module's NumPy form stays the oracle
+every device result is pinned against (tests/test_marching_jax.py).
 
 Marching *tetrahedra* (6 tets per cube) instead of classic marching cubes:
 no 256-case tables to get wrong, no ambiguous cases, and the per-tet logic
@@ -195,7 +199,8 @@ class _SparseSampler:
         return np.where(idx >= 0, vals, self.fill)
 
 
-def extract_sparse(grid, quantile_trim: float = 0.0) -> TriangleMesh:
+def extract_sparse(grid, quantile_trim: float = 0.0,
+                   engine: str = "auto") -> TriangleMesh:
     """SparsePoissonGrid → welded TriangleMesh in world coordinates.
 
     The band-sparse sibling of :func:`extract`: marches only the active
@@ -205,7 +210,24 @@ def extract_sparse(grid, quantile_trim: float = 0.0) -> TriangleMesh:
     corners clamp to the block face (equal-value cells produce no
     crossings — the band is dilated a full block past the samples, so the
     surface cannot reach it).
+
+    ``engine`` selects the extractor: ``"host"`` — this module's NumPy
+    path (the oracle); ``"device"`` — the jitted on-device path
+    (:func:`..ops.marching_jax.extract_sparse_jax`, needs ``grid.nbr``);
+    ``"auto"`` — device on TPU backends when the grid carries its
+    neighbor table, host otherwise (CPU stays on the oracle: the XLA
+    gather form has no advantage there and NumPy is the reference).
     """
+    if engine not in ("auto", "host", "device"):
+        raise ValueError(f"unknown extraction engine {engine!r}")
+    if engine != "host":
+        from . import _backend
+        if engine == "device" or (grid.nbr is not None
+                                  and _backend.tpu_backend()):
+            from . import marching_jax
+
+            return marching_jax.extract_sparse_jax(
+                grid, quantile_trim=quantile_trim)
     valid = np.asarray(grid.block_valid)
     # Brick fields arrive FLAT (M, BS³) — the TPU-tiling-friendly layout
     # (see SparsePoissonGrid) — and get their 3-D shape back on host.

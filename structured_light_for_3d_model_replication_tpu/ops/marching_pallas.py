@@ -1,0 +1,73 @@
+"""Pallas TPU kernel for the marching-tets active-cell classification.
+
+The XLA form of the classify pass (`marching_jax._phase_corners`) builds
+the (M, 729) inside mask and then eight (M, 512) gathered corner views,
+OR-ing and AND-ing them into any/all — ~17 full-band boolean
+intermediates materialized in HBM. This kernel fuses the whole pass into
+one streamed read of the corner frame: ``inside`` never leaves VMEM, and
+the 8-corner any/all reduction is three lane-roll combines per output
+(the corner offsets +1 voxel per axis are flat-index shifts of +1, +9,
++81 on the (9, 9, 9) frame — the same roll-in-flat-space idiom as
+`ops/poisson_pallas.py`). Positions whose shifted read would wrap out of
+the frame are never consumed: the cell outputs live at coordinates ≤ 7
+per axis, and every intermediate they touch stays in-frame.
+
+The kernel returns the any/all planes on the FULL 729 frame (f32 0/1);
+the dispatcher gathers the 512 cell positions — keeping the kernel free
+of the non-affine 729→512 index map.
+
+Numerical contract pinned vs the XLA form in interpret mode by
+tests/test_marching_jax.py; the XLA path stays the oracle and CPU
+fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import _backend
+
+_NC = 729            # (9, 9, 9) corner frame, flat
+_SHIFTS = (1, 9, 81)  # +z, +y, +x neighbor in flat frame coords
+
+
+def available() -> bool:
+    return _backend.tpu_backend()
+
+
+def _kernel(d_ref, any_ref, all_ref):
+    ins = (d_ref[...] > 0.0).astype(jnp.float32)      # (cb, 729)
+    a = ins
+    b = ins
+    for s in _SHIFTS:
+        a = jnp.maximum(a, jnp.roll(a, -s, axis=1))
+        b = jnp.minimum(b, jnp.roll(b, -s, axis=1))
+    any_ref[...] = a
+    all_ref[...] = b
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "cb"))
+def classify_pallas(d, interpret: bool = False, cb: int = 64):
+    """``d`` = corner frame minus iso, (M, 729) float32. Returns
+    (any_in, all_in) as f32 0/1 planes over the same frame: position p
+    holds the max/min of ``d > 0`` over the 8 cell corners at p — valid
+    wherever p's coordinates are ≤ 7 per axis (the cell positions)."""
+    m = d.shape[0]
+    mp = ((m + cb - 1) // cb) * cb
+    if mp != m:
+        d = jnp.concatenate([d, jnp.zeros((mp - m, _NC), d.dtype)])
+    any_f, all_f = pl.pallas_call(
+        _kernel,
+        grid=(mp // cb,),
+        in_specs=[pl.BlockSpec((cb, _NC), lambda c: (c, 0))],
+        out_specs=[pl.BlockSpec((cb, _NC), lambda c: (c, 0)),
+                   pl.BlockSpec((cb, _NC), lambda c: (c, 0))],
+        out_shape=[jax.ShapeDtypeStruct((mp, _NC), jnp.float32),
+                   jax.ShapeDtypeStruct((mp, _NC), jnp.float32)],
+        interpret=interpret,
+    )(d)
+    return any_f[:m], all_f[:m]

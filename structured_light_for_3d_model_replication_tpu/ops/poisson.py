@@ -135,6 +135,18 @@ def divergence(V: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+def screen_weights(density, screen):
+    """Normalized-density screen ``screen · density / mean(nonzero)`` —
+    resolution-agnostic. THE recipe for every screened-Poisson operator
+    in the package: the dense solve below, the band solve's fine screen,
+    and the two-level preconditioner's coarse operator
+    (`poisson_sparse._pcg_sparse`) must all normalize identically or the
+    preconditioner silently stops matching the operator it corrects."""
+    wmean = jnp.sum(density) / jnp.maximum(
+        jnp.sum((density > 0).astype(jnp.float32)), 1.0)
+    return screen * density / jnp.maximum(wmean, 1e-12)
+
+
 @functools.partial(jax.jit, static_argnames=("resolution", "cg_iters"))
 def _solve(points, normals, valid, resolution: int, cg_iters: int,
            screen: float, rtol=3e-4):
@@ -146,10 +158,7 @@ def _solve(points, normals, valid, resolution: int, cg_iters: int,
     V, density = vw[..., :3], vw[..., 3]
     rhs = divergence(V)
 
-    # Screen weight: normalized density, so `screen` is resolution-agnostic.
-    wmean = jnp.sum(density) / jnp.maximum(
-        jnp.sum((density > 0).astype(jnp.float32)), 1.0)
-    W = screen * density / jnp.maximum(wmean, 1e-12)
+    W = screen_weights(density, screen)
 
     def A(x):
         return laplacian(x) - W * x

@@ -77,6 +77,74 @@ class SparsePoissonGrid(NamedTuple):
     origin: jnp.ndarray        # (3,) world position of voxel (0,0,0) center
     scale: jnp.ndarray         # () world size of one fine voxel
     resolution: int            # static: fine voxels per axis
+    # Face-neighbor slot table (M, 6), columns +x,-x,+y,-y,+z,-z, value M
+    # for "absent" — produced by setup anyway, carried so the DEVICE
+    # marching extractor (`ops/marching_jax.py`) can assemble cross-block
+    # corner values without re-deriving the block index. Optional (None)
+    # so hand-built grids in tests stay constructible.
+    nbr: jnp.ndarray | None = None
+
+
+class PoissonParams(NamedTuple):
+    """Hashable knob set for :func:`reconstruct_sparse`.
+
+    ``preconditioner`` selects the fine-band CG preconditioner:
+
+    * ``"additive"`` (default) — additive two-level: scaled Jacobi on the
+      band PLUS a band-masked coarse correction on the SAME dense coarse
+      grid the solve already uses for its Dirichlet seed, moved through
+      the separable restriction/prolongation machinery of
+      :func:`_prolong_band`. ZERO fine matvecs per application — the only
+      band matvec per outer iteration is CG's own ``A·p`` — so the total
+      fine-band traffic is ~iteration-count matvecs: measured 26 vs 65
+      Jacobi iterations at the 37.9k-block depth-9 probe shape, ~2.5×
+      less band traffic.
+    * ``"vcycle"`` — multiplicative two-level V-cycle: damped-Jacobi
+      pre/post smoothing wrapped around the same masked coarse
+      correction. Few iterations (28 vs 65 at the probe shape) but 2
+      extra band matvecs per application (~3 total per iteration) — the
+      right choice when outer-loop reductions, not matvecs, dominate.
+    * ``"chebyshev"`` — degree-``cheby_degree`` Chebyshev polynomial of
+      the Jacobi-scaled band operator; no coarse traffic, linear and
+      symmetric. Fewer iterations than Jacobi at the same matvec count —
+      useful when the coarse grid is unavailable or mistrusted.
+    * ``"jacobi"`` — the original diagonal preconditioner, kept verbatim
+      (:func:`_cg_sparse`) as the oracle/fallback path.
+    """
+
+    depth: int = 10
+    cg_iters: int = 200
+    screen: float = 4.0
+    max_blocks: int = 131_072
+    # None = depth-aware default: 7 (128³), auto-raised so the
+    # coarse/fine resolution ratio stays ≤ 128 through depth 15 (capped
+    # at 8 = 256³ dense, so depth 16 runs at ratio 256 and WARNS). At
+    # ratio 256 (depth 15 over a 128³ coarse grid) the band is ~0.05
+    # coarse cells thick and the folded Dirichlet halo inherits the
+    # coarse blob's surface error wholesale — the measured p90 =
+    # 4.63-voxel error tail of BENCH r5's depth-15 row, gone at ratio
+    # 128 (depth 14, p90 0.29, same cloud density).
+    coarse_depth: int | None = None
+    coarse_iters: int = 300
+    rtol: float = 3e-4
+    preconditioner: str = "additive"
+    # Two-level internals, None = per-scheme measured defaults (resolved
+    # in _pcg_sparse). ``smooth_omega`` is scheme-dependent BY ROLE: for
+    # "vcycle" it is the damped-Jacobi smoothing weight (must stay < 1;
+    # 0.8 measured best), for "additive" it is the diagonal branch's
+    # WEIGHT against the coarse correction — the ω/γ balance of the two
+    # summed terms, optimum ≥ 2 (37.9k-block sweep: ω=1→35 iters,
+    # ω=2→30, plateau 26-28 over ω∈[2,4]). ``precond_coarse_iters`` is
+    # the fixed coarse-level PCG count (fixed => deterministic cost; the
+    # slight nonlinearity it leaves is absorbed by the flexible CG);
+    # additive measured best at 4, vcycle at 8.
+    smooth_omega: float | None = None
+    precond_coarse_iters: int | None = None
+    # Chebyshev internals: polynomial degree and the spectral bounds of
+    # the Jacobi-scaled operator (eigenvalues of D⁻¹A lie in (0, 2]).
+    cheby_degree: int = 4
+    cheby_lmin: float = 0.06
+    cheby_lmax: float = 2.0
 
 
 def _pack(bc: jnp.ndarray) -> jnp.ndarray:
@@ -438,9 +506,7 @@ def _setup_sparse(points, normals, valid, resolution: int, max_blocks: int,
 
     rhs = _div_band_flat(V, nbr)
 
-    wmean = jnp.sum(density) / jnp.maximum(
-        jnp.sum((density > 0).astype(jnp.float32)), 1.0)
-    W = screen * density / jnp.maximum(wmean, 1e-12)
+    W = dense_poisson.screen_weights(density, screen)
 
     return (rhs, W, nbr, block_valid, block_coords, density,
             flat, w, cfound, origin, scale, n_blocks)
@@ -474,6 +540,45 @@ def _extended_index_maps():
 _INTERIOR_IDX, _FACE_IDX = _extended_index_maps()
 
 
+def _coarse_ratio_width(resolution: int, coarse_resolution: int):
+    """(cr, W): fine→coarse coordinate ratio and the static coarse
+    neighborhood width covering one block's footprint. ``int()`` runs on
+    a trace-time python float (both resolutions are STATIC), never a
+    tracer. # jaxlint: disable=host-sync-in-jit"""
+    cr = (coarse_resolution - 1.0) / (resolution - 1.0)
+    # Block footprint spans 9·cr coarse cells (+1 for floor straddle).
+    W = int(_np.floor(9.0 * cr + 1.0)) + 2
+    return cr, W
+
+
+def _sep_weights(bcc, e, cr, Rc: int, W: int):
+    """Separable per-axis interpolation data for a chunk of blocks.
+
+    ``bcc`` (C, 3) block coords, ``e`` (E,) per-axis fine offsets within
+    the block (−1 and 8 are the halo planes). Every extended position
+    interpolates the coarse field at ``t = clip(fine_coord · cr)``; the
+    weights factor per axis, so ONE (E, W) weight matrix per axis plus a
+    (W, W, W) gathered coarse neighborhood per block reproduce the
+    trilinear gather exactly. Returns (wgt (C, 3, E, W), flat_idx
+    (C, W, W, W) int32 into the flat coarse grid)."""
+    iota = jnp.arange(W, dtype=jnp.int32)
+    g = bcc[:, :, None].astype(jnp.float32) * BS + e[None, None, :]
+    t = jnp.clip(g * cr, 0.0, Rc - 1 - 1e-4)           # (C, 3, E)
+    c0 = jnp.clip(jnp.floor(t[:, :, 0]).astype(jnp.int32), 0, Rc - W)
+    tl = t - c0[:, :, None].astype(jnp.float32)        # ∈ [0, W-1)
+    i0 = jnp.clip(jnp.floor(tl).astype(jnp.int32), 0, W - 2)
+    f = tl - i0.astype(jnp.float32)
+    wgt = (jnp.where(iota == i0[..., None], 1.0 - f[..., None], 0.0)
+           + jnp.where(iota == i0[..., None] + 1, f[..., None], 0.0))
+    ix = jnp.clip(c0[:, 0, None] + iota, 0, Rc - 1)
+    iy = jnp.clip(c0[:, 1, None] + iota, 0, Rc - 1)
+    iz = jnp.clip(c0[:, 2, None] + iota, 0, Rc - 1)
+    flat_idx = ((ix[:, :, None, None] * Rc
+                 + iy[:, None, :, None]) * Rc
+                + iz[:, None, None, :])
+    return wgt, flat_idx
+
+
 @functools.partial(jax.jit, static_argnames=("resolution",
                                              "coarse_resolution", "chunk"))
 def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
@@ -491,11 +596,7 @@ def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
     loads, the measured 14 s of the round-2 solve). W is the static
     neighborhood width covering the block's coarse footprint."""
     R, Rc = resolution, coarse_resolution
-    cr = (Rc - 1.0) / (R - 1.0)
-    # Block footprint spans 9·cr coarse cells (+1 for floor straddle).
-    # int() runs on a trace-time python float (cr derives from the two
-    # STATIC resolution args), never a tracer. # jaxlint: disable=host-sync-in-jit
-    W = int(_np.floor(9.0 * cr + 1.0)) + 2
+    cr, W = _coarse_ratio_width(R, Rc)
     m = block_coords.shape[0]
     coarse_flat = coarse_chi.reshape(-1)
 
@@ -505,26 +606,11 @@ def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
         bc = jnp.concatenate(
             [bc, jnp.zeros((m_pad - m, 3), bc.dtype)])
 
-    iota = jnp.arange(W, dtype=jnp.int32)
-
     def per_chunk(bcc):
         C = bcc.shape[0]
         e = jnp.arange(_E, dtype=jnp.float32) - 1.0        # halo..halo
-        g = bcc[:, :, None].astype(jnp.float32) * BS + e[None, None, :]
-        t = jnp.clip(g * cr, 0.0, Rc - 1 - 1e-4)           # (C, 3, 10)
-        c0 = jnp.clip(jnp.floor(t[:, :, 0]).astype(jnp.int32), 0, Rc - W)
-        tl = t - c0[:, :, None].astype(jnp.float32)        # ∈ [0, W-1)
-        i0 = jnp.clip(jnp.floor(tl).astype(jnp.int32), 0, W - 2)
-        f = tl - i0.astype(jnp.float32)
-        wgt = (jnp.where(iota == i0[..., None], 1.0 - f[..., None], 0.0)
-               + jnp.where(iota == i0[..., None] + 1, f[..., None], 0.0))
         # (C, 3, 10, W) separable weights; (C, W, W, W) coarse values.
-        ix = jnp.clip(c0[:, 0, None] + iota, 0, Rc - 1)
-        iy = jnp.clip(c0[:, 1, None] + iota, 0, Rc - 1)
-        iz = jnp.clip(c0[:, 2, None] + iota, 0, Rc - 1)
-        flat_idx = ((ix[:, :, None, None] * Rc
-                     + iy[:, None, :, None]) * Rc
-                    + iz[:, None, None, :])
+        wgt, flat_idx = _sep_weights(bcc, e, cr, Rc, W)
         G = coarse_flat[flat_idx.reshape(C, -1)].reshape(C, W, W, W)
         E3 = jnp.einsum("cxi,cyj,czk,cijk->cxyz",
                         wgt[:, 0], wgt[:, 1], wgt[:, 2], G)
@@ -622,6 +708,274 @@ def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int,
     return jnp.where(band, chi, 0.0), iters  # (M, BS³) flat
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "resolution", "coarse_resolution", "cg_iters", "use_pallas",
+    "precond", "precond_coarse_iters", "cheby_degree", "chunk"))
+def _pcg_sparse(b, W, x0, nbr, block_valid, block_coords, coarse_W,
+                resolution: int, coarse_resolution: int, cg_iters: int,
+                rtol=3e-4, use_pallas: bool | None = None,
+                precond: str = "additive",
+                precond_coarse_iters: int | None = None,
+                smooth_omega=None, cheby_lmin=0.06, cheby_lmax=2.0,
+                cheby_degree: int = 4, chunk: int = 8192):
+    """Flexible PCG with a two-level (additive or V-cycle) or Chebyshev
+    preconditioner.
+
+    The Jacobi path (:func:`_cg_sparse`) converges but spends 62-71 fine
+    matvecs at the 1M depth-10 shape: the diagonal removes the screening
+    term's density variation and nothing else, so the SMOOTH error modes
+    of the Laplacian decay one grid-sweep per iteration. The two-level
+    schemes kill exactly those modes on the dense coarse grid the solve
+    already owns (the Dirichlet-seed grid), through the same separable
+    restriction/prolongation machinery as :func:`_prolong_band`.
+
+    ``precond="additive"`` (default): ``M⁻¹r = ω·D⁻¹r + P·Mc⁻¹·Pᵀ·r`` —
+    the Jacobi term and the coarse correction applied to the SAME
+    residual and summed. No fine matvec inside the preconditioner at
+    all, so total band traffic ≈ the iteration count — measured 26 vs 65
+    Jacobi iterations at the 37.9k-block depth-9 probe shape
+    (ω=2, 4 coarse iters; scripts/probe_precond_iters.py), with the
+    coarse PCG (a 128³ dense grid, ~2% of the band's cells at 1M)
+    almost free.
+
+    ``precond="vcycle"``: one damped-Jacobi pre-smooth, the coarse
+    correction, one post-smooth (multiplicative). Few iterations
+    (28 at the probe shape) but each application costs 2 extra band
+    matvecs, so it only wins when the outer loop, not the matvec,
+    dominates.
+
+    Both two-level schemes MASK the coarse solve to the band footprint
+    (coarse cells the restriction writes to, plus nothing else): a
+    fixed-iteration coarse PCG spends its whole budget on the region
+    that feeds back through prolongation instead of converging empty
+    space — and the mask IS the fine problem's real boundary (the band
+    edge is Dirichlet, folded into ``b``). Masked vs unmasked additive
+    at the probe shape: 30 vs 36 iterations (ω=2), 35 vs 44 (ω=1).
+
+    The coarse correction solves the fine ERROR equation, so the coarse
+    operator must match the fine one's scaling: the unscaled 7-point
+    Laplacian represents ``h²∇²`` at each level, hence restriction
+    carries a ``cr = h_f/h_c`` factor (full-weighting ``Pᵀ/ratio³``
+    times the ``ratio²`` operator rescale) and the coarse screen is the
+    coarse grid's own normalized density screen amplified by ``ratio²``
+    (the same per-level screen scaling as Kazhdan's screened-Poisson
+    multigrid).
+
+    The fixed-iteration coarse PCG makes the preconditioner slightly
+    nonlinear, so the outer loop uses the Polak-Ribière (flexible) beta
+    — identical to Fletcher-Reeves for an exactly linear M, and immune
+    to the drift otherwise. The stopping rule (‖r‖ ≤ rtol·‖b‖) and
+    returned (chi, iterations) contract match :func:`_cg_sparse`.
+
+    ``precond="chebyshev"``: degree-``cheby_degree`` Chebyshev
+    semi-iteration on the Jacobi-scaled band operator over
+    ``[cheby_lmin, cheby_lmax]`` — linear, symmetric, no coarse traffic;
+    each application costs ``cheby_degree - 1`` band matvecs.
+    """
+    R, Rc = resolution, coarse_resolution
+    band = block_valid[:, None]
+    dinv = jnp.where(band, 1.0 / (6.0 + W), 0.0)
+
+    # Per-scheme measured defaults (PoissonParams docstring): the SAME
+    # knob plays a different role per scheme — additive's ω weights the
+    # diagonal branch against the coarse one (optimum ≥ 2), vcycle's ω
+    # damps the Jacobi smoother (must stay < 1).
+    if precond_coarse_iters is None:
+        precond_coarse_iters = 4 if precond == "additive" else 8
+    if smooth_omega is None:
+        smooth_omega = 2.0 if precond == "additive" else 0.8
+
+    # Same lazy kernel-module gate as _cg_sparse (pallas-import rule).
+    if use_pallas is None:
+        use_pallas = _backend.tpu_backend()
+    if use_pallas:
+        from . import poisson_pallas
+
+        def matvec(xf):
+            return poisson_pallas.matvec_pallas_v2(xf, W, nbr,
+                                                   block_valid, cb=64)
+    else:
+        def matvec(xf):
+            out = _lap_band_flat(xf, nbr) - W * xf
+            return jnp.where(band, -out, 0.0)
+
+    if precond == "chebyshev":
+        # Chebyshev semi-iteration for A z ≈ r on the Jacobi-scaled
+        # operator: fixed degree, fixed coefficients — a polynomial in A,
+        # hence exactly linear and symmetric.
+        theta = 0.5 * (cheby_lmax + cheby_lmin)
+        delta = 0.5 * (cheby_lmax - cheby_lmin)
+
+        def apply_M(r):
+            z = (1.0 / theta) * dinv * r
+
+            # Three-term recurrence (z_{k-1}, z_k) with the standard
+            # rho update; degree-1 is the scaled-Jacobi seed above.
+            def chb3(_i, st):
+                z_prev, z_c, rho_o = st
+                rho = 1.0 / (2.0 * theta / delta - rho_o)
+                resid = dinv * (r - matvec(z_c))
+                z_n = z_c + rho * ((2.0 / delta) * resid
+                                   + rho_o * (z_c - z_prev))
+                return z_c, z_n, rho
+
+            _, z, _ = jax.lax.fori_loop(
+                0, cheby_degree - 1, chb3,
+                (jnp.zeros_like(z), z, delta / theta))
+            return jnp.where(band, z, 0.0)
+
+    elif precond in ("vcycle", "additive"):
+        cr, Wn = _coarse_ratio_width(R, Rc)
+        crf = jnp.float32(cr)
+        m = block_coords.shape[0]
+        m_pad = ((m + chunk - 1) // chunk) * chunk
+        bc = block_coords
+        if m_pad != m:
+            bc = jnp.concatenate(
+                [bc, jnp.zeros((m_pad - m, 3), bc.dtype)])
+        n_chunks = m_pad // chunk
+        bc_ch = bc.reshape(n_chunks, chunk, 3)
+        # Precompute the separable transfer data once per solve — the
+        # interior 8 positions only (the preconditioner never touches
+        # the halo planes; the Dirichlet fold lives in b already).
+        e_int = jnp.arange(BS, dtype=jnp.float32)
+
+        # ratio² screen amplification: the coarse operator acts on the
+        # fine error equation multiplied through by (h_c/h_f)².
+        ratio2 = jnp.float32(((R - 1.0) / (Rc - 1.0)) ** 2)
+        Wc = coarse_W * ratio2
+        dinv_c = 1.0 / (6.0 + Wc)
+
+        def restrict(rf):
+            """Band residual (M, BS³) → coarse grid (Rc³,): Pᵀ·cr,
+            chunked scan so the transient 3-D views stay one chunk
+            long (the (…, 8, 8) TPU-tile padding note up top)."""
+            rf_p = jnp.concatenate(
+                [rf, jnp.zeros((m_pad - m, BS ** 3), rf.dtype)]) \
+                if m_pad != m else rf
+            rf_ch = rf_p.reshape(n_chunks, chunk, BS ** 3)
+
+            def step(acc, ch):
+                bcc, rc_ = ch
+                wgt, flat_idx = _sep_weights(bcc, e_int, cr, Rc, Wn)
+                r3 = rc_.reshape(chunk, BS, BS, BS)
+                G = jnp.einsum("cxi,cyj,czk,cxyz->cijk",
+                               wgt[:, 0], wgt[:, 1], wgt[:, 2], r3)
+                acc = acc.at[flat_idx.reshape(-1)].add(
+                    G.reshape(-1) * crf)
+                return acc, None
+
+            acc0 = jnp.zeros((Rc ** 3,), jnp.float32)
+            acc, _ = jax.lax.scan(step, acc0, (bc_ch, rf_ch))
+            return acc
+
+        def prolong(ec_flat):
+            """Coarse correction (Rc³,) → band interiors (M, BS³)."""
+            def step(_c, bcc):
+                wgt, flat_idx = _sep_weights(bcc, e_int, cr, Rc, Wn)
+                G = ec_flat[flat_idx.reshape(chunk, -1)].reshape(
+                    chunk, Wn, Wn, Wn)
+                E3 = jnp.einsum("cxi,cyj,czk,cijk->cxyz",
+                                wgt[:, 0], wgt[:, 1], wgt[:, 2], G)
+                return _c, E3.reshape(chunk, BS ** 3)
+
+            _, out = jax.lax.scan(step, 0, bc_ch)
+            return out.reshape(m_pad, BS ** 3)[:m]
+
+        # Band footprint on the coarse grid: cells the restriction of a
+        # band-supported field can reach (one restrict of all-ones).
+        # Fixing the coarse PCG to this region (zero-Dirichlet outside)
+        # spends its whole fixed budget on cells that feed back through
+        # prolongation — measured 6-9 iterations cheaper than unmasked
+        # at every (ω, ci) point of the probe sweep (docstring above).
+        cmask = (restrict(jnp.broadcast_to(
+            band.astype(jnp.float32), (m, BS ** 3))) > 0.0).astype(
+            jnp.float32).reshape(Rc, Rc, Rc)
+
+        def matvec_c(xc):
+            return cmask * -(dense_poisson.laplacian(xc) - Wc * xc)
+
+        def coarse_solve(rc):
+            """Fixed-iteration Jacobi-PCG on the masked coarse grid
+            (4-8 iters at 128³ — a sliver of one band matvec of traffic
+            at the 1M depth-10 shape; MORE coarse iterations measured
+            strictly worse, ci=4 < 8 < 16 in outer-iteration count).
+            Fixed count keeps cost deterministic; the flexible outer
+            beta absorbs the nonlinearity of truncation."""
+            r = cmask * rc.reshape(Rc, Rc, Rc)
+            x = jnp.zeros_like(r)
+            z = dinv_c * r
+            p = z
+            rz = jnp.vdot(r, z)
+
+            def step(_i, st):
+                x, r, p, rz = st
+                Ap = matvec_c(p)
+                alpha = rz / jnp.maximum(jnp.vdot(p, Ap), 1e-30)
+                x = x + alpha * p
+                r = r - alpha * Ap
+                z = dinv_c * r
+                rz_new = jnp.vdot(r, z)
+                beta = rz_new / jnp.maximum(rz, 1e-30)
+                return x, r, z + beta * p, rz_new
+
+            x, _, _, _ = jax.lax.fori_loop(
+                0, precond_coarse_iters, step, (x, r, p, rz))
+            return (cmask * x).reshape(-1)
+
+        om = smooth_omega
+
+        if precond == "additive":
+            def apply_M(r):
+                # Jacobi term + coarse correction of the SAME residual,
+                # summed: no fine matvec inside the preconditioner.
+                ec = coarse_solve(restrict(r))
+                z = om * dinv * r + jnp.where(band, prolong(ec), 0.0)
+                return jnp.where(band, z, 0.0)
+        else:
+            def apply_M(r):
+                # Pre-smooth from zero (free of matvecs), coarse-correct,
+                # post-smooth — the symmetric two-grid preconditioner.
+                z = om * dinv * r
+                rr = r - matvec(z)
+                ec = coarse_solve(restrict(rr))
+                z = z + jnp.where(band, prolong(ec), 0.0)
+                z = z + om * dinv * (r - matvec(z))
+                return jnp.where(band, z, 0.0)
+
+    else:
+        raise ValueError(f"unknown preconditioner {precond!r}")
+
+    r0 = b - matvec(x0)
+    z0 = apply_M(r0)
+    rz0 = jnp.vdot(r0, z0)
+    tol2 = rtol * rtol * jnp.vdot(b, b)
+
+    def cond(state):
+        _, _, _, _, _, rs, it = state
+        return (it < cg_iters) & (rs > tol2)
+
+    def body(state):
+        x, r, p, z, rz, _, it = state
+        Ap = matvec(p)
+        alpha = rz / jnp.maximum(jnp.vdot(p, Ap), 1e-30)
+        x = x + alpha * p
+        r_new = r - alpha * Ap
+        z_new = apply_M(r_new)
+        rz_new = jnp.vdot(r_new, z_new)
+        # Polak-Ribière (flexible) beta: subtracts the stale-direction
+        # component a variable M injects; equals FR when M is linear.
+        beta = (rz_new - jnp.vdot(r_new, z)) / jnp.maximum(rz, 1e-30)
+        p = z_new + beta * p
+        return (x, r_new, p, z_new, rz_new, jnp.vdot(r_new, r_new),
+                it + 1)
+
+    chi, _, _, _, _, _, iters = jax.lax.while_loop(
+        cond, body, (x0, r0, z0, z0, rz0, jnp.vdot(r0, r0),
+                     jnp.int32(0)))
+    return jnp.where(band, chi, 0.0), iters
+
+
 @jax.jit
 def _iso_sparse(chi, density, flat, w, cfound, valid):
     """Density-weighted mean of chi at the samples (8 trilinear corners
@@ -634,10 +988,16 @@ def _iso_sparse(chi, density, flat, w, cfound, valid):
     return jnp.sum(chi_pts * den_pts) / jnp.maximum(jnp.sum(den_pts), 1e-12)
 
 
-def reconstruct_sparse(points, normals, valid=None, depth: int = 10,
-                       cg_iters: int = 200, screen: float = 4.0,
-                       max_blocks: int = 131_072, coarse_depth: int = 7,
-                       coarse_iters: int = 300, rtol: float = 3e-4):
+def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
+                       cg_iters: int | None = None,
+                       screen: float | None = None,
+                       max_blocks: int | None = None,
+                       coarse_depth: int | None = None,
+                       coarse_iters: int | None = None,
+                       rtol: float | None = None,
+                       preconditioner: str | None = None,
+                       params: PoissonParams | None = None,
+                       with_stats: bool = False):
     """Band-sparse screened Poisson at depth 9-16 (module docstring).
 
     Matches the reference's octree-Poisson acceptance envelope: default
@@ -663,7 +1023,47 @@ def reconstruct_sparse(points, normals, valid=None, depth: int = 10,
     (median 0.014 ≈ 6% of a voxel, p90 0.037 — discretization-limited),
     while the iteration count drops 75 → 61 → 50; 3e-4 keeps a 2×
     margin above the loosest tolerance that still matched.
+
+    ``preconditioner`` selects the fine CG's preconditioner (see
+    :class:`PoissonParams`): ``"additive"`` (default — additive
+    two-level geometric multigrid over the coarse seed grid, ≤ half the
+    Jacobi iteration count at the same rtol with no extra band matvec
+    per iteration), ``"vcycle"`` (multiplicative), ``"chebyshev"``, or
+    ``"jacobi"`` (the original path, bit-for-bit untouched). ``params``
+    bundles every knob as one hashable object; :class:`PoissonParams` is
+    the SINGLE source of defaults (every keyword above defaults to None
+    = "take it from params"), and mixing ``params`` with explicit
+    keywords is an error — silent precedence between the two was a
+    depth-10-instead-of-15 footgun.
+
+    ``with_stats`` appends a third return value, a dict with
+    ``cg_iters_used`` (fine-band iterations the residual stop actually
+    spent) and ``preconditioner`` — the bench's ≤ 30-iteration gate and
+    the convergence tests read it instead of scraping logs.
     """
+    given = {k: v for k, v in dict(
+        depth=depth, cg_iters=cg_iters, screen=screen,
+        max_blocks=max_blocks, coarse_depth=coarse_depth,
+        coarse_iters=coarse_iters, rtol=rtol,
+        preconditioner=preconditioner).items() if v is not None}
+    if params is None:
+        params = PoissonParams()._replace(**given)
+    elif given:
+        raise ValueError(
+            "pass solver knobs either as keywords or bundled in params, "
+            f"not both (got params plus {sorted(given)})")
+    depth = params.depth
+    cg_iters = params.cg_iters
+    screen = params.screen
+    max_blocks = params.max_blocks
+    coarse_depth = params.coarse_depth
+    coarse_iters = params.coarse_iters
+    rtol = params.rtol
+    preconditioner = params.preconditioner
+    if preconditioner not in ("additive", "vcycle", "chebyshev", "jacobi"):
+        raise ValueError(
+            f"preconditioner must be 'additive', 'vcycle', 'chebyshev' "
+            f"or 'jacobi', got {preconditioner!r}")
     if depth > 16:
         raise ValueError(f"depth={depth} > 16: rejected exactly like the "
                          "reference's octree guard "
@@ -671,6 +1071,32 @@ def reconstruct_sparse(points, normals, valid=None, depth: int = 10,
     if 2 ** depth < 4 * BS:
         raise ValueError(f"depth={depth} too shallow for the block solver; "
                          "use ops.poisson.reconstruct")
+    if coarse_depth is None:
+        # Depth-aware coarse grid: keep the coarse/fine ratio ≤ 128.
+        # At ratio 256 (depth 15 over the old fixed 128³) the band is
+        # ~0.05 coarse cells thick: the Dirichlet halo folded from the
+        # coarse field pins BOTH band faces to nearly the same coarse
+        # value, so wherever the coarse blob misplaces the surface the
+        # fine level set shifts with it — the depth-15 p90 = 4.63-voxel
+        # error tail of BENCH r5 (depth 14 at ratio 128, same cloud
+        # density: p90 0.29). Capped at 8 (256³ dense ≈ 470 MB of
+        # solver state); an explicit coarse_depth is always honored.
+        coarse_depth = min(8, max(7, depth - 7))
+        if coarse_depth > 7:
+            log.info("sparse Poisson depth=%d: coarse grid auto-raised "
+                     "to %d^3 (coarse/fine ratio cap 128)", depth,
+                     2 ** coarse_depth)
+        if depth - coarse_depth > 7:
+            # Depth 16 only: the memory cap (256³ dense ≈ 470 MB of
+            # coarse solver state) wins over the ratio cap, so the
+            # ratio is 256 — the regime with the measured p90 tail.
+            log.warning(
+                "sparse Poisson depth=%d: coarse/fine ratio is %d "
+                "(memory-capped at coarse 256³) — surface error can "
+                "carry the unresolved-coarse-halo tail the ratio-128 "
+                "cap removes at depth ≤ 15; pass an explicit "
+                "coarse_depth to trade memory for accuracy", depth,
+                2 ** (depth - coarse_depth))
     points = jnp.asarray(points, jnp.float32)
     normals = jnp.asarray(normals, jnp.float32)
     if valid is None:
@@ -716,11 +1142,32 @@ def reconstruct_sparse(points, normals, valid=None, depth: int = 10,
                                   rtol=rtol)
     b, x0 = _prolong_band(coarse.chi, rhs, nbr, block_valid, block_coords,
                           2 ** depth, 2 ** min(coarse_depth, depth))
-    chi, cg_used = _cg_sparse(b, W, x0, nbr, block_valid, cg_iters,
-                              jnp.float32(rtol))
-    log.info("sparse Poisson depth=%d: fine CG stopped after %d/%d "
-             "iterations", depth, int(cg_used), cg_iters)
+    if preconditioner == "jacobi":
+        chi, cg_used = _cg_sparse(b, W, x0, nbr, block_valid, cg_iters,
+                                  jnp.float32(rtol))
+    else:
+        # Coarse screen for the preconditioner's coarse operator: the
+        # coarse grid's own normalized-density screen — the SAME helper
+        # dense_poisson._solve applies internally, recomputed from the
+        # density field the coarse solve already returns.
+        coarse_W = dense_poisson.screen_weights(coarse.density,
+                                                jnp.float32(screen))
+        om = params.smooth_omega
+        chi, cg_used = _pcg_sparse(
+            b, W, x0, nbr, block_valid, block_coords, coarse_W,
+            2 ** depth, 2 ** min(coarse_depth, depth), cg_iters,
+            rtol=jnp.float32(rtol), precond=preconditioner,
+            precond_coarse_iters=params.precond_coarse_iters,
+            smooth_omega=None if om is None else jnp.float32(om),
+            cheby_lmin=jnp.float32(params.cheby_lmin),
+            cheby_lmax=jnp.float32(params.cheby_lmax),
+            cheby_degree=params.cheby_degree)
+    log.info("sparse Poisson depth=%d: fine CG (%s) stopped after %d/%d "
+             "iterations", depth, preconditioner, int(cg_used), cg_iters)
     iso = _iso_sparse(chi, density, flat, w, cfound, valid)
     grid = SparsePoissonGrid(chi, density, block_coords, block_valid,
-                             iso, origin, scale, 2 ** depth)
+                             iso, origin, scale, 2 ** depth, nbr=nbr)
+    if with_stats:
+        return grid, n_blocks, {"cg_iters_used": int(cg_used),
+                                "preconditioner": preconditioner}
     return grid, n_blocks

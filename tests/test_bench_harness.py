@@ -97,7 +97,12 @@ def test_final_reprint_is_last_act_of_main():
     problems log line — nothing may write to either stream between it and
     process exit (only the sys.exit that sets rc)."""
     src = _BENCH.read_text()
-    i_reprint = src.index("print(_final_headline_line(state[\"headline\"]")
+    i_reprint = src.index("print(_final_headline_line(headline")
+    # The re-printed headline prefers scan→mesh and falls back to the
+    # scan→cloud line when the meshing half failed (failure already in
+    # failed_configs ⇒ rc nonzero).
+    assert src.index('state.get("headline", state.get("headline_cloud"))') \
+        < i_reprint
     assert i_reprint > src.index('details["run_status"]')
     assert i_reprint > src.index("bench completed with problems")
     tail = src[i_reprint:]

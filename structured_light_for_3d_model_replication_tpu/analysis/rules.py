@@ -440,7 +440,65 @@ class MutableGlobalRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# Rule 6: PRNG key reuse
+# Rule 6: silent broad excepts
+# ---------------------------------------------------------------------------
+
+
+@register
+class SilentExceptRule(Rule):
+    """``except Exception: pass`` swallows EVERYTHING — including the
+    tracer leaks, dtype errors and transport failures the rest of this
+    linter exists to surface — and leaves no log line to debug from.  The
+    hazard class behind the turntable serial-probe fix (PR 3): a broad
+    handler whose body does literally nothing.  Heuristic: the handler
+    catches a broad type (bare ``except``, ``Exception``/``BaseException``,
+    alone or in a tuple) AND its body is only ``pass``/``continue``
+    (docstring-style constants ignored).  Handlers that log, return a
+    fallback, re-raise or set state are fine — the rule targets silence,
+    not breadth."""
+
+    name = "silent-except"
+    description = ("except Exception/bare except whose body only "
+                   "pass/continues — failures vanish with no log or "
+                   "fallback")
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if not self._is_silent(node.body):
+                continue
+            v = self.report(
+                ctx, node,
+                "broad except with a pass/continue-only body silently "
+                "swallows every failure — log it, narrow the exception "
+                "type, or return an explicit fallback")
+            if v:
+                yield v
+
+    def _is_broad(self, type_node) -> bool:
+        if type_node is None:           # bare except:
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(self._is_broad(e) for e in type_node.elts)
+        name = dotted(type_node)
+        return name is not None and name.split(".")[-1] in self._BROAD
+
+    @staticmethod
+    def _is_silent(body) -> bool:
+        real = [s for s in body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))]
+        return bool(real) and all(
+            isinstance(s, (ast.Pass, ast.Continue)) for s in real)
+
+
+# ---------------------------------------------------------------------------
+# Rule 7: PRNG key reuse
 # ---------------------------------------------------------------------------
 
 

@@ -30,12 +30,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-scan registration point cap")
     p.add_argument("--no-loop-closure", action="store_true",
                    help="pose-graph without the first↔last edge")
+    g = p.add_argument_group("quality gates (docs/ROBUSTNESS.md)")
+    g.add_argument("--no-gates", action="store_true",
+                   help="disable the per-edge registration gates")
+    g.add_argument("--min-edge-fitness", type=float, default=0.2,
+                   help="reject ring edges below this ICP fitness "
+                        "(consensus-repaired / down-weighted)")
+    g.add_argument("--max-edge-rmse", type=float, default=None,
+                   help="optional absolute inlier-RMSE ceiling per edge")
+    g.add_argument("--step-deg", type=float, default=None,
+                   help="commanded turntable advance per stop; anchors the "
+                        "consensus repair of rejected edges")
+    g.add_argument("--health-json", default=None, metavar="PATH",
+                   help="write the merge health report (edge verdicts, "
+                        "repairs) as JSON here")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    from ..health import QualityGates, ScanHealthReport
     from ..models import merge
 
     params = merge.MergeParams(
@@ -44,10 +59,22 @@ def main(argv=None) -> int:
         icp_iterations=args.icp_iterations,
         max_points=args.max_points,
         loop_closure=not args.no_loop_closure,
+        step_deg=args.step_deg,
     )
+    gates = None if args.no_gates else QualityGates(
+        min_edge_fitness=args.min_edge_fitness,
+        max_edge_rmse=args.max_edge_rmse)
+    health = ScanHealthReport()
     merged = merge.merge_360_files(args.input, args.output, params=params,
-                                   method=args.method)
+                                   method=args.method, gates=gates,
+                                   health=health)
     print(f"merged -> {args.output} ({len(merged)} points)", file=sys.stderr)
+    if health.rejected_edges:
+        print(f"degraded: {len(health.rejected_edges)} edge(s) rejected and "
+              f"repaired (see --health-json)", file=sys.stderr)
+    health.emit()
+    if args.health_json:
+        health.write(args.health_json)
     return 0
 
 

@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(Old/sl_calib_capture.py)")
     p.add_argument("--cam-size", default="1920x1080", metavar="WxH",
                    help="requested local-camera frame size")
+    p.add_argument("--health-json", default=None, metavar="PATH",
+                   help="write the capture health report (per-stop retries, "
+                        "failed/skipped stops) as JSON — auto360 only")
     return p
 
 
@@ -112,10 +115,20 @@ def main(argv=None) -> int:
                       f"{p.elapsed_s:.0f}s avg {p.avg_stop_s:.1f}s "
                       f"remaining ~{p.remaining_s:.0f}s", file=sys.stderr)
 
+            from ..health import ScanHealthReport
+
+            health = ScanHealthReport()
             stops = scanner.auto_scan_360(
                 args.name, degrees_per_turn=args.degrees, turns=args.turns,
-                resume=not args.no_resume, on_progress=progress)
+                resume=not args.no_resume, on_progress=progress,
+                health=health)
             out = f"{len(stops)} stops"
+            if health.failed_stops:
+                print(f"degraded: stops {health.failed_stops} failed and "
+                      f"were skipped", file=sys.stderr)
+            health.emit()
+            if args.health_json:
+                health.write(args.health_json)
         print(f"done: {out}", file=sys.stderr)
         return 0
     finally:

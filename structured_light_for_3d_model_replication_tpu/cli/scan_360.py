@@ -42,6 +42,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "screened Poisson; the full scan→print path in one "
                         "command)")
     p.add_argument("--mesh-depth", type=int, default=8)
+    g = p.add_argument_group("quality gates (docs/ROBUSTNESS.md)")
+    g.add_argument("--no-gates", action="store_true",
+                   help="disable the quality gates (abort-on-anything "
+                        "reference behavior)")
+    g.add_argument("--min-coverage", type=float, default=0.02,
+                   help="drop stops whose decoded-valid pixel fraction is "
+                        "below this (bridged out of the ring)")
+    g.add_argument("--min-edge-fitness", type=float, default=0.2,
+                   help="reject ring edges below this ICP fitness "
+                        "(consensus-repaired / down-weighted)")
+    g.add_argument("--max-edge-rmse", type=float, default=None,
+                   help="optional absolute inlier-RMSE ceiling per edge")
+    g.add_argument("--health-json", default=None, metavar="PATH",
+                   help="write the scan health report (per-stop coverage, "
+                        "dropped stops, edge verdicts) as JSON here")
     return p
 
 
@@ -56,6 +71,20 @@ def main(argv=None) -> int:
         os.path.join(args.input, s) for s in os.listdir(args.input)
         if os.path.isdir(os.path.join(args.input, s))])
     stop_dirs = [s for s in subs if has_frames(s)]
+    # A partially-captured stop (interrupted/failed mid-stack) would make
+    # the stack np.stack ragged — keep only full-stack folders and say so.
+    from ..io.images import list_frames
+
+    counts = {d: len(list_frames(d)) for d in stop_dirs}
+    if counts:
+        full = max(counts.values())
+        ragged = [d for d in stop_dirs if counts[d] < full]
+        if ragged:
+            print(f"skipping {len(ragged)} partial stop folder(s) "
+                  f"(fewer than {full} frames): "
+                  f"{[os.path.basename(d) for d in ragged]}",
+                  file=sys.stderr)
+            stop_dirs = [d for d in stop_dirs if counts[d] == full]
     if len(stop_dirs) < 2:
         raise SystemExit(f"{args.input}: need ≥2 per-stop frame folders, "
                          f"found {len(stop_dirs)}")
@@ -73,23 +102,71 @@ def main(argv=None) -> int:
             print(f"turntable step {step_deg}° (from session folder name)",
                   file=sys.stderr)
 
+    from ..health import QualityGates, ScanHealthReport
+
+    gates = None if args.no_gates else QualityGates(
+        min_coverage=args.min_coverage,
+        min_edge_fitness=args.min_edge_fitness,
+        max_edge_rmse=args.max_edge_rmse)
+    health = ScanHealthReport()
+
+    # Physical stop labels from the auto-scan folder names ("…_<angle>deg_
+    # scan") when the step is known: a session with capture-skipped stops
+    # then reports health by REAL stop index and the ring bridges with
+    # true step gaps.
+    stop_labels = None
+    if step_deg:
+        import re as _re
+
+        angles = []
+        for d in stop_dirs:
+            m = _re.search(r"_(\d+(?:\.\d+)?)deg_scan$",
+                           os.path.basename(os.path.normpath(d)))
+            if not m:
+                angles = None
+                break
+            angles.append(float(m.group(1)))
+        if angles:
+            labs = [round(a / step_deg) for a in angles]
+            if labs == sorted(set(labs)):
+                stop_labels = labs
     params = scan360.Scan360Params(
         merge=merge.MergeParams(voxel_size=args.voxel_size,
                                 max_points=args.max_points,
                                 step_deg=step_deg),
         method=args.method,
         fused=args.fused,
-        stop_chunk=args.stop_chunk)
+        stop_chunk=args.stop_chunk,
+        gates=gates)
     merged, poses = scan360.scan_folders_to_cloud(
-        stop_dirs, args.calib, output_path=args.output, params=params)
+        stop_dirs, args.calib, output_path=args.output, params=params,
+        health=health, stop_labels=stop_labels)
     print(f"{len(stop_dirs)} stops -> {args.output} ({len(merged)} points)",
           file=sys.stderr)
+    if health.dropped_stops:
+        print(f"degraded: stops {health.dropped_stops} dropped by the "
+              f"coverage gate (see --health-json)", file=sys.stderr)
     if args.stl:
         from ..models import meshing
 
-        mesh = meshing.mesh_360(merged, args.stl, depth=args.mesh_depth)
-        print(f"meshed -> {args.stl} ({len(mesh.faces)} faces)",
-              file=sys.stderr)
+        # Terminal guard: a mesh failure (or an empty mesh) degrades to
+        # "you still have the merged PLY" instead of crashing the run.
+        try:
+            mesh = meshing.mesh_360(merged, args.stl, depth=args.mesh_depth)
+        except Exception as e:
+            health.note("meshing failed (%s) — merged cloud kept at %s",
+                        e, args.output)
+            print(f"meshing failed: {e} (cloud kept at {args.output})",
+                  file=sys.stderr)
+        else:
+            if len(mesh.faces) == 0:
+                health.note("mesh has zero faces — treat %s as unusable, "
+                            "merged cloud kept at %s", args.stl, args.output)
+            print(f"meshed -> {args.stl} ({len(mesh.faces)} faces)",
+                  file=sys.stderr)
+    health.emit()
+    if args.health_json:
+        health.write(args.health_json)
     return 0
 
 

@@ -1,0 +1,391 @@
+"""Failure containment: error taxonomy, quality gates, scan health report.
+
+The reference treats any hardware hiccup as fatal — one frame-capture
+timeout raises out of a 24-stop, ~20-minute 360° run
+(`server/sl_system.py:468-471`), and no compute stage ever inspects the
+quality signals it already produces (per-pixel ``valid`` masks, ICP
+fitness/RMSE). Real-time reconstruction systems treat degraded or dropped
+frames as the NORMAL case: AGS drops low-covisibility frames by design
+(PAPERS.md: arxiv 2509.00433) and GS-ICP SLAM keeps tracking through bad
+registrations instead of aborting (arxiv 2403.12550). This module is the
+shared vocabulary of that failure-containment layer:
+
+* the structured error taxonomy (:class:`ScanFault` and subclasses) every
+  hw/orchestration layer raises instead of bare ``RuntimeError``;
+* :class:`QualityGates` — the host-side thresholds applied to the device
+  pipeline's existing health signals (decode coverage, edge fitness/RMSE);
+* :func:`gate_edges` — the gate/repair pass over a registered ring
+  (consensus-step replacement for the sequential chain, information
+  down-weighting for the pose-graph path);
+* :class:`ScanHealthReport` — the per-stop/per-edge record of what was
+  retried, dropped, bridged and degraded, emitted as JSON through
+  :mod:`.utils.log` and surfaced by ``scan-360`` / ``merge-360``.
+
+Everything here is host-side numpy/stdlib: gates read back a handful of
+scalars per stop/edge and never change device program shapes (see
+`models/scan360`'s gated path for the static-shape contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+
+import numpy as np
+
+from .utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy
+# ---------------------------------------------------------------------------
+
+
+class ScanFault(RuntimeError):
+    """Base of the structured scan-pipeline error taxonomy.
+
+    Layers raise the specific subclass; orchestration catches ``ScanFault``
+    to contain a failure (retry, skip, degrade) without masking genuine
+    programming errors, which stay ordinary exceptions.
+    """
+
+
+class CaptureError(ScanFault):
+    """A frame capture failed (timeout, unreadable/truncated file) after
+    the configured retries."""
+
+
+class StopQualityError(ScanFault):
+    """A stop (or the whole session) fell below the quality gates and no
+    degradation path could salvage it."""
+
+
+# ---------------------------------------------------------------------------
+# Quality gates
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class QualityGates:
+    """Host-side thresholds on the pipeline's existing health signals.
+
+    Frozen (hashable) so it can ride inside ``Scan360Params`` — which is
+    itself an ``lru_cache`` key for the compiled pipeline programs.
+    """
+
+    # Minimum fraction of decoded-valid pixels per stop. A stop below it is
+    # dropped from the ring (its merge contribution is masked out; its ring
+    # neighbors are bridged). Synthetic/real objects typically fill 5–40 %
+    # of the frame, an all-black or saturated stack decodes to ~0.
+    min_coverage: float = 0.02
+    # Minimum ICP fitness per ring edge (`RegistrationResult.fitness` —
+    # inlier fraction at the correspondence radius). A failing edge is
+    # replaced by the ring-consensus step (sequential) and down-weighted
+    # (posegraph).
+    min_edge_fitness: float = 0.2
+    # Optional absolute inlier-RMSE ceiling per edge (scene units). None
+    # disables the RMSE gate (fitness alone gates by default: RMSE of a
+    # zero-fitness edge is meaningless).
+    max_edge_rmse: float | None = None
+    # Information-matrix scale applied to rejected edges on the pose-graph
+    # path: the edge stays in the graph (connectivity) but barely votes.
+    posegraph_down_weight: float = 1e-3
+
+    def coverage_ok(self, coverage: float) -> bool:
+        return bool(coverage >= self.min_coverage)
+
+    def edge_ok(self, fitness: float, rmse: float) -> bool:
+        if not math.isfinite(float(fitness)) or fitness < self.min_edge_fitness:
+            return False
+        if self.max_edge_rmse is not None and (
+                not math.isfinite(float(rmse)) or rmse > self.max_edge_rmse):
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Health report
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StopHealth:
+    """One stop's capture + gate record."""
+
+    index: int
+    angle_deg: float | None = None
+    # captured | resumed | failed (capture gave up) | dropped (gate)
+    status: str = "captured"
+    coverage: float | None = None
+    retries: int = 0            # extra capture attempts that recovered
+    stop_attempts: int = 1      # full-stack capture attempts
+    faults: list[str] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.coverage is not None:
+            d["coverage"] = round(float(self.coverage), 4)
+        return d
+
+
+@dataclasses.dataclass
+class EdgeHealth:
+    """One ring edge's registration + gate record. ``gap`` counts the
+    commanded turntable steps the edge spans (> 1 = a bridge over dropped
+    stops)."""
+
+    src: int
+    dst: int
+    gap: int = 1
+    fitness: float | None = None
+    rmse: float | None = None
+    verdict: str = "pass"       # pass | reject
+    action: str = "kept"        # kept | bridged | replaced_consensus
+    #                           | down_weighted
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        for k in ("fitness", "rmse"):
+            if d[k] is not None:
+                d[k] = round(float(d[k]), 4)
+        return d
+
+
+@dataclasses.dataclass
+class ScanHealthReport:
+    """Aggregated capture→merge→mesh health for one 360° session.
+
+    Accumulated by whoever touches the run (scanner, gated pipeline, CLI)
+    and emitted once as a JSON document — the machine-readable answer to
+    "what did this scan survive".
+    """
+
+    stops: dict[int, StopHealth] = dataclasses.field(default_factory=dict)
+    edges: list[EdgeHealth] = dataclasses.field(default_factory=list)
+    notes: list[str] = dataclasses.field(default_factory=list)
+    rotate_timeouts: int = 0
+
+    # -- accumulation -------------------------------------------------------
+
+    def stop(self, index: int, angle_deg: float | None = None) -> StopHealth:
+        """Get-or-create the record for a stop."""
+        rec = self.stops.get(index)
+        if rec is None:
+            rec = StopHealth(index=index, angle_deg=angle_deg)
+            self.stops[index] = rec
+        elif angle_deg is not None and rec.angle_deg is None:
+            rec.angle_deg = angle_deg
+        return rec
+
+    def note(self, message: str, *args) -> None:
+        text = message % args if args else message
+        self.notes.append(text)
+        log.warning("health: %s", text)
+
+    # -- queries ------------------------------------------------------------
+
+    @property
+    def dropped_stops(self) -> list[int]:
+        return sorted(i for i, s in self.stops.items()
+                      if s.status == "dropped")
+
+    @property
+    def failed_stops(self) -> list[int]:
+        return sorted(i for i, s in self.stops.items()
+                      if s.status == "failed")
+
+    @property
+    def recovered_stops(self) -> list[int]:
+        """Stops that needed retries but ended up captured."""
+        return sorted(i for i, s in self.stops.items()
+                      if s.retries > 0 and s.status in ("captured",
+                                                        "resumed"))
+
+    @property
+    def rejected_edges(self) -> list[EdgeHealth]:
+        return [e for e in self.edges if e.verdict == "reject"]
+
+    # -- emission -----------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "stops": [self.stops[i].to_dict()
+                      for i in sorted(self.stops)],
+            "edges": [e.to_dict() for e in self.edges],
+            "dropped_stops": self.dropped_stops,
+            "failed_stops": self.failed_stops,
+            "recovered_stops": self.recovered_stops,
+            "rejected_edges": len(self.rejected_edges),
+            "rotate_timeouts": self.rotate_timeouts,
+            "retries_total": int(sum(s.retries
+                                     for s in self.stops.values())),
+            "notes": list(self.notes),
+        }
+
+    def to_json(self, indent: int | None = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")
+        log.info("health report written to %s", path)
+
+    def emit(self) -> None:
+        """One structured log line carrying the whole report (JSON-lines
+        consumers get it via ``SL_TPU_LOG_JSON``)."""
+        log.info("scan health: %s", self.to_json(indent=None))
+
+
+# ---------------------------------------------------------------------------
+# so3 helpers (host-side: gate repair works on a handful of 4×4s)
+# ---------------------------------------------------------------------------
+
+
+def _log_so3_np(R: np.ndarray) -> np.ndarray:
+    """Rotation vector of a (3, 3) rotation; safe near identity."""
+    cos = np.clip((np.trace(R) - 1.0) / 2.0, -1.0, 1.0)
+    th = float(np.arccos(cos))
+    v = np.array([R[2, 1] - R[1, 2], R[0, 2] - R[2, 0], R[1, 0] - R[0, 1]],
+                 np.float64)
+    if th < 1e-6:
+        return 0.5 * v
+    return v * (th / (2.0 * np.sin(th)))
+
+
+def _exp_so3_np(w: np.ndarray) -> np.ndarray:
+    """Rodrigues: rotation vector → (3, 3) rotation."""
+    th = float(np.linalg.norm(w))
+    if th < 1e-12:
+        return np.eye(3)
+    k = w / th
+    K = np.array([[0, -k[2], k[1]], [k[2], 0, -k[0]], [-k[1], k[0], 0]],
+                 np.float64)
+    return np.eye(3) + np.sin(th) * K + (1 - np.cos(th)) * (K @ K)
+
+
+def consensus_step_np(Ts: np.ndarray,
+                      step_deg: float | None = None) -> np.ndarray | None:
+    """Robust common per-step transform of a turntable ring (numpy port of
+    `models.merge._consensus_step`, for host-side gate repair): median of
+    the edge screws, trusting only edges whose rotation magnitude lands
+    near the commanded step when it is known. Returns None when no edge
+    survives the trust filter (nothing to vote with)."""
+    Ts = np.asarray(Ts, np.float64)
+    if Ts.shape[0] == 0:
+        return None
+    w = np.stack([_log_so3_np(T[:3, :3]) for T in Ts])
+    t = Ts[:, :3, 3]
+    if step_deg is not None:
+        step = abs(float(step_deg)) * np.pi / 180.0
+        ang = np.linalg.norm(w, axis=1)
+        trusted = np.abs(ang - step) <= 0.35 * step
+        if not trusted.any():
+            trusted = np.ones_like(trusted)
+        w, t = w[trusted], t[trusted]
+    T = np.eye(4)
+    T[:3, :3] = _exp_so3_np(np.median(w, axis=0))
+    T[:3, 3] = np.median(t, axis=0)
+    return T
+
+
+def _matrix_power_T(T: np.ndarray, n: int) -> np.ndarray:
+    out = np.eye(4)
+    for _ in range(n):
+        out = out @ T
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Ring edge construction (THE (src, dst, gap) convention, in one place)
+# ---------------------------------------------------------------------------
+
+
+def ring_edges(labels, loop: bool = False,
+               span: int | None = None) -> list[tuple[int, int, int]]:
+    """``(src, dst, gap)`` per ring edge over PHYSICAL stop labels, in the
+    order every consumer shares: sequential edges ``labels[j+1]→labels[j]``
+    first, then the optional loop edge ``labels[0]→labels[-1]``.
+
+    ``gap`` counts commanded turntable steps: a label jump (a stop skipped
+    at capture or dropped by a gate) makes the edge a bridge, and the
+    consensus repair in :func:`gate_edges` raises the step transform to
+    exactly that power. ``span`` is the full ring's step count for the
+    loop edge's wrap-around gap (default: ``max(labels) + 1``)."""
+    labels = [int(x) for x in labels]
+    if any(b <= a for a, b in zip(labels, labels[1:])):
+        raise ValueError(f"stop labels must be strictly increasing, "
+                         f"got {labels}")
+    edges = [(labels[j + 1], labels[j], labels[j + 1] - labels[j])
+             for j in range(len(labels) - 1)]
+    if loop:
+        span = span if span is not None else max(labels) + 1
+        edges.append((labels[0], labels[-1],
+                      (labels[0] - labels[-1]) % span or span))
+    return edges
+
+
+# ---------------------------------------------------------------------------
+# Edge gating
+# ---------------------------------------------------------------------------
+
+
+def gate_edges(
+    edges: list[tuple[int, int, int]],
+    Ts: np.ndarray,
+    fit: np.ndarray,
+    rmse: np.ndarray,
+    infos: np.ndarray,
+    gates: QualityGates,
+    step_deg: float | None = None,
+    report: ScanHealthReport | None = None,
+):
+    """Gate a registered ring's edges; repair the rejects.
+
+    ``edges`` lists ``(src, dst, gap)`` per edge, aligned with ``Ts``
+    (E, 4, 4), ``fit``/``rmse`` (E,), ``infos`` (E, 6, 6). Returns
+    ``(Ts2, infos2, edge_health)`` where
+
+    * rejected edges' transforms are replaced by the ring-consensus step
+      raised to the edge's gap (the sequential chain then keeps the
+      commanded geometry instead of a slid/failed ICP result), when a
+      consensus exists — a ring with no passing gap-1 edge keeps the
+      measured transforms and only records the verdicts;
+    * rejected edges' information matrices are scaled by
+      ``gates.posegraph_down_weight`` so the pose-graph path keeps
+      connectivity but the edge barely votes.
+    """
+    Ts = np.array(np.asarray(Ts, np.float64), copy=True)
+    infos = np.array(np.asarray(infos, np.float64), copy=True)
+    fit = np.asarray(fit, np.float64)
+    rmse = np.asarray(rmse, np.float64)
+    ok = np.array([gates.edge_ok(fit[i], rmse[i])
+                   for i in range(len(edges))], bool)
+    health: list[EdgeHealth] = []
+    step_T = None
+    if not ok.all():
+        base = [Ts[i] / 1.0 for i in range(len(edges))
+                if ok[i] and edges[i][2] == 1]
+        step_T = consensus_step_np(np.stack(base) if base else
+                                   np.zeros((0, 4, 4)), step_deg)
+    for i, (src, dst, gap) in enumerate(edges):
+        e = EdgeHealth(src=src, dst=dst, gap=gap,
+                       fitness=float(fit[i]), rmse=float(rmse[i]),
+                       verdict="pass" if ok[i] else "reject",
+                       action="kept" if gap == 1 else "bridged")
+        if not ok[i]:
+            infos[i] = infos[i] * gates.posegraph_down_weight
+            if step_T is not None:
+                Ts[i] = _matrix_power_T(step_T, gap)
+                e.action = "replaced_consensus"
+            else:
+                e.action = "down_weighted"
+            log.warning(
+                "edge %d→%d rejected (fitness=%.3f rmse=%.4f) — %s",
+                src, dst, fit[i], rmse[i], e.action)
+        health.append(e)
+    if report is not None:
+        report.edges.extend(health)
+    return Ts.astype(np.float32), infos.astype(np.float32), health

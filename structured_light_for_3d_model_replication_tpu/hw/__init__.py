@@ -19,6 +19,14 @@ from .camera import (  # noqa: F401
     SyntheticCamera,
 )
 from .command_server import CommandChannel, CommandServer  # noqa: F401
+from .faults import (  # noqa: F401
+    CallSchedule,
+    FaultPlan,
+    FaultRule,
+    FlakyCamera,
+    FlakyChannel,
+    FlakyTurntable,
+)
 from .projector import VirtualProjector, WindowProjector  # noqa: F401
 from .rig import VirtualRig  # noqa: F401
 from .turntable import SerialTurntable, SimulatedTurntable, TurntableError  # noqa: F401

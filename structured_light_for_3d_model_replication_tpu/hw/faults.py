@@ -1,0 +1,328 @@
+"""Deterministic fault injection for the hardware layer (chaos harness).
+
+The rig is hardware-in-the-loop — phone uploads over HTTP, an ESP32
+turntable over serial — so the interesting failures are the ones a clean
+virtual rig never produces: capture timeouts, all-black/saturated frames
+(torch glitch, exposure misfire), duplicated frames (stale buffer served
+twice), truncated uploads (connection dropped mid-POST), missed turntable
+``DONE`` lines. This module wraps any camera/turntable/channel in a
+schedule-driven fault injector so the containment layer
+(`scanner.RetryPolicy`, the quality gates in `models/scan360`) can be
+proven against EXACTLY reproducible failure runs:
+
+* :class:`FaultPlan` — per-(capture path, attempt) fault kinds, so a
+  "transient" fault (fails attempt 0, clean on retry) and a "hard" fault
+  (fails every attempt) are both one rule; deterministic by construction,
+  with a seeded generator for randomized-but-reproducible campaigns.
+* :class:`FlakyCamera` — wraps any ``capture(path) -> bool`` camera.
+  ``timeout`` faults return False without writing (the pull-channel abort
+  shape, `server/sl_system.py:102-104`); corruption faults let the inner
+  capture succeed and then damage the file — those frames upload "fine"
+  and must be caught downstream by the decode-coverage gate (or, for
+  truncation, the scanner's frame verification).
+* :class:`FlakyTurntable` / :class:`FlakyChannel` — missed DONE lines,
+  dead rotations, dropped command handshakes on a per-call schedule.
+
+Corruption composes with :mod:`..models.realism`: :func:`realism_post`
+routes every *successful* frame through the photoreal degradation chain,
+so a chaos run can be photometrically realistic AND fault-injected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import defaultdict
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+#: Camera fault kinds understood by :class:`FlakyCamera`.
+CAMERA_FAULTS = ("timeout", "black", "saturated", "duplicate", "truncate")
+#: Turntable fault kinds understood by :class:`FlakyTurntable`.
+TURNTABLE_FAULTS = ("done_timeout", "stuck")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """Faults for captures whose path contains ``match``.
+
+    ``kinds[a]`` is the fault injected on attempt ``a`` for that frame
+    (attempts count per path); attempts beyond the list are clean.
+    ``always`` repeats ``kinds[-1]`` forever — a hard failure no retry
+    policy can outlast.
+    """
+
+    match: str
+    kinds: tuple[str, ...]
+    always: bool = False
+
+    def kind_for(self, attempt: int) -> str | None:
+        if attempt < len(self.kinds):
+            return self.kinds[attempt]
+        if self.always and self.kinds:
+            return self.kinds[-1]
+        return None
+
+
+class FaultPlan:
+    """Ordered rule list; first rule whose ``match`` is a substring of the
+    capture path wins. Stateless — attempt counting lives in the wrapper,
+    so one plan can drive several runs."""
+
+    def __init__(self, rules: Sequence[FaultRule] = ()):
+        self.rules = list(rules)
+        for r in self.rules:
+            for k in r.kinds:
+                if k not in CAMERA_FAULTS:
+                    raise ValueError(f"unknown camera fault kind {k!r}")
+
+    def fault_for(self, path: str, attempt: int) -> str | None:
+        for rule in self.rules:
+            if rule.match in path:
+                return rule.kind_for(attempt)
+        return None
+
+    @classmethod
+    def transient(cls, match: str, kind: str = "timeout",
+                  times: int = 1) -> FaultRule:
+        """Rule failing the first ``times`` attempts, then clean."""
+        return FaultRule(match=match, kinds=(kind,) * times)
+
+    @classmethod
+    def hard(cls, match: str, kind: str = "timeout") -> FaultRule:
+        """Rule failing EVERY attempt."""
+        return FaultRule(match=match, kinds=(kind,), always=True)
+
+    @classmethod
+    def seeded(cls, seed: int, matches: Sequence[str],
+               p_transient: float = 0.1, p_hard: float = 0.0,
+               kinds: Sequence[str] = ("timeout",)) -> "FaultPlan":
+        """Reproducible random campaign over ``matches`` (e.g. the stop
+        directories of a session): each match independently draws a
+        transient or hard fault of a random kind."""
+        rng = np.random.default_rng(seed)
+        rules = []
+        for m in matches:
+            u = float(rng.random())
+            kind = str(rng.choice(list(kinds)))
+            if u < p_hard:
+                rules.append(cls.hard(m, kind))
+            elif u < p_hard + p_transient:
+                rules.append(cls.transient(m, kind))
+        return cls(rules)
+
+
+class CallSchedule:
+    """call-index → fault kind, for devices whose faults are per call
+    rather than per file (turntable rotations, channel triggers)."""
+
+    def __init__(self, faults: dict[int, str] | None = None):
+        self.faults = dict(faults or {})
+        self.calls = 0
+
+    def next(self) -> str | None:
+        kind = self.faults.get(self.calls)
+        self.calls += 1
+        return kind
+
+    @classmethod
+    def seeded(cls, seed: int, n_calls: int,
+               rates: dict[str, float]) -> "CallSchedule":
+        rng = np.random.default_rng(seed)
+        faults: dict[int, str] = {}
+        for i in range(n_calls):
+            u = float(rng.random())
+            acc = 0.0
+            for kind, p in sorted(rates.items()):
+                acc += p
+                if u < acc:
+                    faults[i] = kind
+                    break
+        return cls(faults)
+
+
+# ---------------------------------------------------------------------------
+# Frame corruption models
+# ---------------------------------------------------------------------------
+
+
+def corrupt_frame_file(path: str, kind: str,
+                       duplicate_of: str | None = None) -> bool:
+    """Damage an already-written frame file in place; True iff the file
+    was actually modified (``duplicate`` with no prior frame is a no-op).
+
+    ``black``/``saturated`` rewrite the image at its own size (an exposure
+    misfire uploads a well-formed but informationless frame); ``duplicate``
+    replaces the bytes with another frame's (stale camera buffer served
+    twice); ``truncate`` chops the file mid-stream (connection dropped
+    mid-upload — the result is NOT a decodable image)."""
+    from ..io import images as img_io
+
+    if kind in ("black", "saturated"):
+        frame = img_io._imread_gray(path)
+        value = 0 if kind == "black" else 255
+        img_io.write_frame(path, np.full_like(frame, value))
+    elif kind == "duplicate":
+        if duplicate_of is None or not os.path.exists(duplicate_of):
+            return False  # nothing to duplicate yet (first frame): no-op
+        with open(duplicate_of, "rb") as src, open(path, "wb") as dst:
+            dst.write(src.read())
+    elif kind == "truncate":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 3))
+    else:
+        raise ValueError(f"unknown corruption kind {kind!r}")
+    return True
+
+
+def realism_post(cam_K: np.ndarray, params=None,
+                 seed: int = 0) -> Callable[[str], None]:
+    """Post-capture hook routing every successful frame through the
+    photoreal sensor chain (`models.realism.degrade_frame`) — compose with
+    :class:`FlakyCamera` for photometrically-degraded chaos runs."""
+    from ..io import images as img_io
+    from ..models import realism
+
+    if params is None:
+        params = realism.SensorParams()
+    rng = np.random.default_rng(seed)
+
+    def post(path: str) -> None:
+        frame = img_io._imread_gray(path)
+        img_io.write_frame(path, realism.degrade_frame(frame, cam_K, params,
+                                                       rng))
+
+    return post
+
+
+# ---------------------------------------------------------------------------
+# Wrappers
+# ---------------------------------------------------------------------------
+
+
+class FlakyCamera:
+    """Schedule-driven fault wrapper over any ``capture(path)`` camera.
+
+    ``injected`` logs every (path, attempt, kind) actually fired, so a
+    chaos test can assert the health report records EXACTLY the injected
+    faults and nothing else.
+    """
+
+    def __init__(self, inner, plan: FaultPlan,
+                 post: Callable[[str], None] | None = None):
+        self.inner = inner
+        self.plan = plan
+        self.post = post
+        self.attempts: dict[str, int] = defaultdict(int)
+        self.injected: list[tuple[str, int, str]] = []
+        self._last_good: str | None = None
+
+    @property
+    def connected(self) -> bool:
+        return bool(getattr(self.inner, "connected", True))
+
+    def capture(self, path: str) -> bool:
+        attempt = self.attempts[path]
+        self.attempts[path] += 1
+        kind = self.plan.fault_for(path, attempt)
+        if kind == "timeout":
+            self.injected.append((path, attempt, kind))
+            log.debug("chaos: capture timeout injected (%s attempt %d)",
+                      path, attempt)
+            return False
+        if not self.inner.capture(path):
+            return False
+        applied = False
+        if kind is not None:
+            applied = corrupt_frame_file(path, kind,
+                                         duplicate_of=self._last_good)
+        if applied:
+            # Ledger records only faults that actually FIRED — a chaos
+            # test asserting health == injected must not be lied to by a
+            # no-op (duplicate with no prior frame).
+            self.injected.append((path, attempt, kind))
+            log.debug("chaos: frame corruption %r injected (%s attempt %d)",
+                      kind, path, attempt)
+        else:
+            if self.post is not None:
+                self.post(path)
+            self._last_good = path
+        return True
+
+
+class FlakyTurntable:
+    """Fault wrapper over any rotate/wait_for_done turntable.
+
+    ``done_timeout``: the move happens but the DONE line is lost (the
+    warn-and-continue case, `server/gui.py:760-762`). ``stuck``: the
+    rotation command is swallowed — the table never moves, and DONE (for
+    that move) never comes.
+    """
+
+    def __init__(self, inner, schedule: CallSchedule):
+        self.inner = inner
+        self.schedule = schedule
+        self.injected: list[tuple[int, str]] = []
+        self._pending: str | None = None
+
+    @property
+    def connected(self) -> bool:
+        return bool(getattr(self.inner, "connected", True))
+
+    @property
+    def angle_deg(self) -> float:
+        return self.inner.angle_deg
+
+    def connect(self) -> bool:
+        return self.inner.connect()
+
+    def rotate(self, degrees: float) -> None:
+        kind = self.schedule.next()
+        self._pending = kind
+        if kind is not None:
+            self.injected.append((self.schedule.calls - 1, kind))
+            if kind == "stuck":
+                log.debug("chaos: rotation swallowed (%.1f°)", degrees)
+                return
+        self.inner.rotate(degrees)
+
+    def wait_for_done(self, timeout: float = 30.0) -> bool:
+        kind, self._pending = self._pending, None
+        if kind == "stuck":
+            return False        # nothing is moving; DONE never comes
+        done = self.inner.wait_for_done(timeout)
+        if kind == "done_timeout":
+            return False        # move completed but the line was lost
+        return done
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class FlakyChannel:
+    """Fault wrapper over a ``CommandChannel``-shaped object: a ``drop``
+    fault swallows the trigger (the phone never saw the command — the
+    poll response was lost in flight)."""
+
+    def __init__(self, inner, schedule: CallSchedule):
+        self.inner = inner
+        self.schedule = schedule
+        self.injected: list[tuple[int, str]] = []
+
+    @property
+    def connected(self) -> bool:
+        return self.inner.connected
+
+    def trigger_capture(self, save_path: str, timeout: float = 20.0) -> bool:
+        kind = self.schedule.next()
+        if kind is not None:
+            self.injected.append((self.schedule.calls - 1, kind))
+            log.debug("chaos: trigger dropped (%s)", save_path)
+            return False
+        return self.inner.trigger_capture(save_path, timeout=timeout)

@@ -24,6 +24,7 @@ import glob
 import threading
 import time
 
+from ..health import ScanFault
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -33,8 +34,8 @@ DONE_TOKEN = "DONE"
 DEFAULT_RPM = 10.0  # ESP_code.ino:12 — 10 RPM stepper
 
 
-class TurntableError(RuntimeError):
-    pass
+class TurntableError(ScanFault):
+    """Turntable transport failure (part of the scan error taxonomy)."""
 
 
 class SerialTurntable:
@@ -73,8 +74,22 @@ class SerialTurntable:
                 self.port = cand
                 log.info("turntable connected on %s", cand)
                 return True
-            except Exception as e:  # pragma: no cover - hardware path
+            # Only transport-level failures mean "try the next port";
+            # anything else (bad baud type, programming error) must surface.
+            except (self._serial_mod.SerialException,
+                    OSError) as e:  # pragma: no cover - hardware path
                 log.debug("no turntable on %s: %s", cand, e)
+                # A post-open failure (e.g. unplugged during the reset
+                # sleep) leaves a half-open handle in self._conn: close it
+                # so `connected` cannot report True after a failed probe.
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except (self._serial_mod.SerialException, OSError):
+                        log.debug("close of half-open %s failed", cand)
+                    self._conn = None
+        log.warning("turntable connection failed; tried %s",
+                    candidates or "no candidate ports")
         return False
 
     def rotate(self, degrees: float) -> None:

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import health as health_mod
 from ..io import ply as ply_io
 from ..io.layout import list_clouds
 from ..ops import (
@@ -681,20 +682,47 @@ def _apply_poses_and_merge(padded: _Padded, poses, params: MergeParams):
                      has_colors=padded.has_colors)
 
 
+def _gate_ring_edges(n: int, Ts: np.ndarray, infos: np.ndarray,
+                     fit, rmse, loop: bool,
+                     gates: health_mod.QualityGates,
+                     params: MergeParams,
+                     health: health_mod.ScanHealthReport | None):
+    """Post-registration edge gate shared by both merge workflows: the
+    ring's (seq [+ loop]) edges verdicted against ``gates``, rejects
+    replaced by the ring-consensus step and down-weighted for the pose
+    graph (see `health.gate_edges`)."""
+    edges = health_mod.ring_edges(range(n), loop)
+    Ts2, infos2, _ = health_mod.gate_edges(
+        edges, Ts, np.asarray(fit), np.asarray(rmse), infos, gates,
+        step_deg=params.step_deg, report=health)
+    return Ts2, infos2
+
+
 def merge_pro_360(
     clouds: Sequence[ply_io.PointCloud],
     params: MergeParams | None = None,
     key=None,
+    gates: health_mod.QualityGates | None = None,
+    health: health_mod.ScanHealthReport | None = None,
 ):
     """Sequential chain merge — `ProcessingLogic.merge_pro_360`
     (`server/processing.py:115-181`): scan i registers onto scan i-1, poses
     accumulate down the chain (`accum_T = accum_T @ T_local`, `:162`), no
-    loop closure. Returns (merged PointCloud, poses (N,4,4) np.ndarray).
+    loop closure. With ``gates``, edges failing the fitness/RMSE gate are
+    replaced by the ring-consensus step before chaining (a slid edge no
+    longer corrupts every pose downstream of it). Returns
+    (merged PointCloud, poses (N,4,4) np.ndarray).
     """
     params = params or MergeParams()
     padded = _Padded(clouds, max_points=params.max_points)
-    seq_T, _, _, _, _, _ = register_sequence(padded.reg_points, padded.reg_valid,
-                                          params, loop_closure=False, key=key)
+    seq_T, seq_info, _, _, fit, rmse = register_sequence(
+        padded.reg_points, padded.reg_valid,
+        params, loop_closure=False, key=key)
+    if gates is not None:
+        Ts2, _ = _gate_ring_edges(len(clouds), np.asarray(seq_T),
+                                  np.asarray(seq_info), fit, rmse, False,
+                                  gates, params, health)
+        seq_T = jnp.asarray(Ts2, jnp.float32)
     poses = posegraph.chain_poses(seq_T)
     merged = _apply_poses_and_merge(padded, poses, params)
     log.info("merge_pro_360: %d scans → %d points", len(clouds), len(merged))
@@ -705,17 +733,38 @@ def merge_posegraph_360(
     clouds: Sequence[ply_io.PointCloud],
     params: MergeParams | None = None,
     key=None,
+    gates: health_mod.QualityGates | None = None,
+    health: health_mod.ScanHealthReport | None = None,
 ):
     """Pose-graph merge with loop closure (`Old/360Merge.py:43-84`,
     `Old/new360Merge.py:96-137`): per-edge ICP transforms + information
     matrices → Levenberg-Marquardt global optimization → merge under the
-    optimized poses. Returns (merged PointCloud, poses (N,4,4) np.ndarray).
+    optimized poses. With ``gates``, edges failing the fitness/RMSE gate
+    keep the graph connected but barely vote (information matrices scaled
+    by ``gates.posegraph_down_weight``) and their measurements are
+    replaced by the ring-consensus step. Returns
+    (merged PointCloud, poses (N,4,4) np.ndarray).
     """
     params = params or MergeParams()
     padded = _Padded(clouds, max_points=params.max_points)
-    seq_T, seq_info, loop_T, loop_info, _, _ = register_sequence(
+    seq_T, seq_info, loop_T, loop_info, fit, rmse = register_sequence(
         padded.reg_points, padded.reg_valid, params,
         loop_closure=params.loop_closure, key=key)
+    if gates is not None:
+        n = len(clouds)
+        Ts = np.asarray(seq_T)
+        infos = np.asarray(seq_info)
+        if params.loop_closure:
+            Ts = np.concatenate([Ts, np.asarray(loop_T)[None]])
+            infos = np.concatenate([infos, np.asarray(loop_info)[None]])
+        Ts2, infos2 = _gate_ring_edges(n, Ts, infos, fit, rmse,
+                                       params.loop_closure, gates, params,
+                                       health)
+        seq_T = jnp.asarray(Ts2[: n - 1], jnp.float32)
+        seq_info = jnp.asarray(infos2[: n - 1], jnp.float32)
+        if params.loop_closure:
+            loop_T = jnp.asarray(Ts2[n - 1], jnp.float32)
+            loop_info = jnp.asarray(infos2[n - 1], jnp.float32)
     graph = posegraph.build_360_graph(seq_T, seq_info, loop_T, loop_info)
     poses = posegraph.optimize(graph, iterations=params.posegraph_iterations)
     merged = _apply_poses_and_merge(padded, poses, params)
@@ -730,6 +779,8 @@ def merge_360_files(
     params: MergeParams | None = None,
     method: str = "posegraph",
     key=None,
+    gates: health_mod.QualityGates | None = None,
+    health: health_mod.ScanHealthReport | None = None,
 ):
     """File-level entry mirroring the GUI action (`server/gui.py:622-641`):
     read every ``*.ply`` in ``folder`` (numeric sort, `Old/new360Merge.py:
@@ -742,7 +793,7 @@ def merge_360_files(
         raise ValueError(f"need ≥2 .ply files in {folder}, found {len(paths)}")
     clouds = [ply_io.read_ply(p) for p in paths]
     fn = merge_posegraph_360 if method == "posegraph" else merge_pro_360
-    merged, _ = fn(clouds, params, key=key)
+    merged, _ = fn(clouds, params, key=key, gates=gates, health=health)
     ply_io.write_ply(output_path, merged)
     return merged
 

@@ -35,6 +35,10 @@ import dataclasses
 
 import numpy as np
 
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
 
 @dataclasses.dataclass(frozen=True)
 class SensorParams:
@@ -103,9 +107,12 @@ def _jpeg_roundtrip(img_u8: np.ndarray, quality: int) -> np.ndarray:
                                [cv2.IMWRITE_JPEG_QUALITY, quality])
         if ok:
             return cv2.imdecode(buf, cv2.IMREAD_GRAYSCALE)
-    except Exception:
-        pass
-    return img_u8  # cv2-free images keep the rest of the chain
+    except Exception as exc:
+        # cv2-free images skip the JPEG stage; the rest of the degradation
+        # chain still applies.
+        log.debug("jpeg roundtrip unavailable (%s); frame passed through",
+                  exc)
+    return img_u8
 
 
 def degrade_frame(frame: np.ndarray, cam_K: np.ndarray,

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import DecodeConfig, TriangulationConfig
+from .. import health as health_mod
 from ..io import ply as ply_io
 from ..ops import pointcloud, posegraph, registration
 from ..ops.triangulate import Calibration
@@ -88,6 +89,17 @@ class Scan360Params:
     # stratified subset (a warning logs the truncation); size it above the
     # expected post-voxel/SOR count.
     output_cap: int | None = None
+    # Host-side quality gates over the pipeline's existing health signals
+    # (per-stop decode coverage from the `valid` masks, per-edge ICP
+    # fitness/RMSE). None = gates off, behavior identical to before. With
+    # gates on, a failing stop is DROPPED from the ring (its merge
+    # contribution masked out — all static shapes preserved — and its ring
+    # neighbors re-registered directly with the already-compiled edge
+    # program, so no recompile) and a failing edge is repaired by the
+    # ring-consensus step / down-weighted in the pose graph. See
+    # `health.QualityGates` and docs/ROBUSTNESS.md. Gates require the
+    # multi-launch path (fused=True falls back with a warning).
+    gates: health_mod.QualityGates | None = None
 
 
 @functools.lru_cache(maxsize=None)
@@ -299,6 +311,8 @@ def scan_stacks_to_cloud(
     tri_cfg: TriangulationConfig = TriangulationConfig(),
     key=None,
     with_stats: bool = False,
+    health: health_mod.ScanHealthReport | None = None,
+    stop_labels=None,
 ):
     """(N, F, H, W) uint8 capture stacks → (merged PointCloud, poses (N,4,4)).
 
@@ -316,6 +330,16 @@ def scan_stacks_to_cloud(
     registration quality (``{"edges": [{src, dst, fitness, rmse}, ...]}``)
     so callers (bench telemetry, quality guards) can attribute ring
     regressions to specific edges.
+
+    ``params.gates`` enables the failure-containment path: per-stop decode
+    coverage and per-edge fitness/RMSE are gated host-side, failing stops
+    are dropped from the ring (bridged, masked out of the merge — static
+    shapes and compiled programs unchanged), failing edges repaired;
+    ``health`` (a :class:`~..health.ScanHealthReport`) accumulates what
+    happened. ``stop_labels`` (gated path only) maps stack position →
+    physical stop index when the stacks already exclude capture-failed
+    stops, so health records key by real stops and bridge gaps count
+    real commanded steps.
     """
     if params.method not in ("sequential", "posegraph"):
         raise ValueError(f"method must be 'sequential' or 'posegraph', "
@@ -328,7 +352,11 @@ def scan_stacks_to_cloud(
     n = stacks.shape[0]
     mp = params.merge
 
-    if params.fused and not isinstance(stacks, np.ndarray):
+    if params.gates is not None and params.fused:
+        log.warning("quality gates need the multi-launch path — "
+                    "fused=True falls back to the loop strategies")
+    if params.fused and params.gates is None \
+            and not isinstance(stacks, np.ndarray):
         return _run_fused(stacks, calib, col_bits, row_bits, params,
                           decode_cfg, tri_cfg, key, with_stats=with_stats)
 
@@ -378,6 +406,10 @@ def scan_stacks_to_cloud(
                 jnp.concatenate(pts_p)[:n], jnp.concatenate(col_p)[:n],
                 jnp.concatenate(val_p)[:n], None, None)
             del pts_p, col_p, val_p
+
+    if params.gates is not None:
+        return _gated_tail(res, params, key, with_stats=with_stats,
+                           health=health, stop_labels=stop_labels)
 
     # 2. ONE stratified pass per stop feeds BOTH the registration view and
     # the merge reduce (same structure as the fused path, `_fused_fn`, so
@@ -435,6 +467,229 @@ def _edge_stats(n: int, fit: np.ndarray, rmse: np.ndarray) -> dict:
     return {"edges": edges,
             "min_fitness": min(fits) if fits else None,
             "mean_fitness": round(float(np.mean(fits)), 4) if fits else None}
+
+
+# ---------------------------------------------------------------------------
+# Quality-gated path (failure containment; see health.QualityGates)
+# ---------------------------------------------------------------------------
+
+
+def _ring_span(labels: list[int], step_deg: float | None) -> int:
+    """Total commanded steps of the full ring, for the loop edge's
+    wrap-around gap. The commanded step pins it exactly (360/step);
+    without it, max(labels)+1 is the best available estimate — it cannot
+    see holes AFTER the last surviving stop, so prefer setting
+    ``MergeParams.step_deg`` whenever the ring may be degraded."""
+    if step_deg:
+        return max(int(round(360.0 / abs(step_deg))), max(labels) + 1)
+    return max(labels) + 1
+
+
+def _register_ring_gated(reg_pts, reg_val, mp: merge_mod.MergeParams,
+                         surv: list[int], labels: list[int], loop: bool,
+                         key):
+    """Ring registration over the SURVIVING stops only, reusing the two
+    already-compiled loop-strategy programs (`merge._preprocess_fn`,
+    `merge._edge_fn`) — per-stop/per-edge shapes are independent of the
+    stop count, so dropping a stop changes the number of invocations, not
+    the programs (the no-recompile contract the chaos suite asserts).
+
+    An edge between non-adjacent survivors is a BRIDGE registered
+    directly (src onto dst, spanning the dropped stops); its ``gap``
+    records how many commanded turntable steps it covers. The axis-prior
+    re-pass is vmapped over a static edge count and is skipped here —
+    the edge gates + consensus repair in :func:`health.gate_edges` cover
+    its failure mode on the degraded ring.
+
+    Returns ``(edges, Ts, fit, rmse, infos)`` with host arrays; ``edges``
+    is a list of ``(src, dst, gap)``.
+    """
+    prep = merge_mod._preprocess_fn(mp.voxel_size, mp.normals_k,
+                                    mp.fpfh_max_nn, mp.fpfh_engine,
+                                    mp.fpfh_slots, mp.fpfh_max_cells)
+    edge = merge_mod._edge_fn(mp)
+    keys = jax.random.split(key, len(surv))
+    pre = {i: prep(reg_pts[i], reg_val[i])[:4] for i in surv}
+    pairs = [(surv[j + 1], surv[j]) for j in range(len(surv) - 1)]
+    if loop:
+        pairs.append((surv[0], surv[-1]))
+    # Edge metadata in PHYSICAL labels (same order as `pairs`): gaps count
+    # commanded steps, spanning capture-failed stops too.
+    edges = health_mod.ring_edges([labels[i] for i in surv], loop,
+                                  span=_ring_span(labels, mp.step_deg))
+    hint = jnp.eye(4, dtype=jnp.float32)
+    outs = []
+    for k_i, (s, d) in enumerate(pairs):
+        s_pts, s_val, _, s_feat = pre[s]
+        d_pts, d_val, d_nrm, d_feat = pre[d]
+        out = edge(s_pts, s_val, s_feat, d_pts, d_val, d_nrm, d_feat,
+                   keys[k_i], hint)
+        outs.append(out)
+        hint = out[0]
+    Ts = np.stack([np.asarray(o[0]) for o in outs])
+    fit = np.array([float(o[1]) for o in outs])
+    rmse = np.array([float(o[2]) for o in outs])
+    infos = np.stack([np.asarray(o[3]) for o in outs])
+    return edges, Ts, fit, rmse, infos
+
+
+def _terminal_guard_cloud(merged: ply_io.PointCloud, sub_pts, sub_val,
+                          coverage: np.ndarray,
+                          health: health_mod.ScanHealthReport):
+    """Last line of defence: a NaN-poisoned or empty merge degrades to the
+    best available artifact (non-finite points stripped; if nothing is
+    left, the highest-coverage stop's raw subsample) instead of handing
+    the caller a crash in the mesher/writer."""
+    pts = np.asarray(merged.points)
+    if pts.shape[0]:
+        finite = np.isfinite(pts).all(axis=1)
+        if not finite.all():
+            health.note("terminal guard: stripped %d non-finite points "
+                        "from the merged cloud", int((~finite).sum()))
+            merged = ply_io.PointCloud(
+                points=pts[finite],
+                colors=None if merged.colors is None
+                else np.asarray(merged.colors)[finite],
+                normals=None if merged.normals is None
+                else np.asarray(merged.normals)[finite])
+    if len(merged) == 0:
+        best = int(np.argmax(coverage))
+        p = np.asarray(sub_pts[best])
+        v = np.asarray(sub_val[best])
+        health.note("terminal guard: merged cloud empty — degraded to the "
+                    "raw subsample of best-coverage stop %d (%d points)",
+                    best, int(v.sum()))
+        merged = ply_io.PointCloud(points=p[v].astype(np.float32))
+    return merged
+
+
+def _gated_tail(res, params: Scan360Params, key, with_stats: bool,
+                health: health_mod.ScanHealthReport | None,
+                stop_labels=None):
+    """Stages 2-4 under the quality gates: coverage gate → (possibly
+    degraded) ring registration → edge gates/repair → masked merge →
+    terminal guard. Static shapes everywhere: dropping a stop only masks
+    its merge contribution and re-routes ring edges.
+
+    ``stop_labels`` maps stack position → PHYSICAL stop index (strictly
+    increasing; default identity). Callers whose stacks already exclude
+    capture-failed stops pass the surviving physical indices so (a) one
+    ``ScanHealthReport`` can span capture and compute without the records
+    colliding, and (b) edge gaps count real commanded steps across the
+    holes (the consensus repair raises the step transform to that power).
+    """
+    gates = params.gates
+    health = health if health is not None else health_mod.ScanHealthReport()
+    mp = params.merge
+    n = res.points.shape[0]
+    labels = list(range(n)) if stop_labels is None \
+        else [int(x) for x in stop_labels]
+    if len(labels) != n:
+        raise ValueError(f"stop_labels has {len(labels)} entries for "
+                         f"{n} stops")
+
+    # -- per-stop decode-coverage gate (N scalars read back) ---------------
+    coverage = np.asarray(jnp.mean(res.valid.astype(jnp.float32), axis=1))
+    for i in range(n):
+        health.stop(labels[i]).coverage = float(coverage[i])
+    keep = coverage >= gates.min_coverage
+    if int(keep.sum()) < 2:
+        order = np.argsort(-coverage)
+        keep = np.zeros(n, bool)
+        keep[order[:2]] = True
+        health.note("coverage gate relaxed: fewer than 2 stops ≥ %.3f — "
+                    "keeping best stops %s", gates.min_coverage,
+                    sorted(labels[int(i)] for i in order[:2]))
+    dropped = [i for i in range(n) if not keep[i]]
+    for i in dropped:
+        health.stop(labels[i]).status = "dropped"
+    if dropped:
+        health.note("coverage gate dropped stops %s (coverage %s < %.3f)",
+                    [labels[i] for i in dropped],
+                    [round(float(coverage[i]), 4) for i in dropped],
+                    gates.min_coverage)
+    surv = [i for i in range(n) if keep[i]]
+
+    # -- stage 2: shared subsample (same compiled program as ungated) ------
+    m_reg = min(merge_mod._round_up(mp.max_points), res.points.shape[1])
+    view_cap = merge_mod._round_up(min(params.view_cap, res.points.shape[1]))
+    with trace.span("scan360.subsample", m=m_reg):
+        sub_pts, sub_col, sub_val, reg_pts, reg_val = _subsample_views_fn(
+            view_cap, m_reg)(res.points, res.colors, res.valid)
+
+    # -- stage 3: ring registration + edge gates ---------------------------
+    loop = params.method == "posegraph" and mp.loop_closure
+    with trace.span("scan360.register", edges=len(surv) - 1 + int(loop),
+                    dropped=len(dropped)):
+        if not dropped:
+            # Full ring: identical heavy path to the ungated pipeline
+            # (including the axis-prior pass); gates apply post-hoc.
+            (seq_T, seq_info, loop_T, loop_info, fit,
+             rmse) = merge_mod.register_sequence(
+                reg_pts, reg_val, mp, loop_closure=loop, key=key,
+                strategy=params.ring_strategy)
+            edges = health_mod.ring_edges(labels, loop,
+                                          span=_ring_span(labels,
+                                                          mp.step_deg))
+            Ts = np.asarray(seq_T)
+            infos = np.asarray(seq_info)
+            if loop:
+                Ts = np.concatenate([Ts, np.asarray(loop_T)[None]])
+                infos = np.concatenate([infos, np.asarray(loop_info)[None]])
+        else:
+            edges, Ts, fit, rmse, infos = _register_ring_gated(
+                reg_pts, reg_val, mp, surv, labels, loop, key)
+    Ts2, infos2, _ = health_mod.gate_edges(
+        edges, Ts, np.asarray(fit), np.asarray(rmse), infos, gates,
+        step_deg=mp.step_deg, report=health)
+
+    # -- poses: chain (or pose-graph) over the surviving ring --------------
+    n_seq = len(surv) - 1
+    if params.method == "posegraph":
+        graph = posegraph.build_360_graph(
+            jnp.asarray(Ts2[:n_seq], jnp.float32),
+            jnp.asarray(infos2[:n_seq], jnp.float32),
+            jnp.asarray(Ts2[n_seq], jnp.float32) if loop else None,
+            jnp.asarray(infos2[n_seq], jnp.float32) if loop else None)
+        surv_poses = np.asarray(posegraph.optimize(
+            graph, iterations=mp.posegraph_iterations))
+    else:
+        surv_poses = np.empty((len(surv), 4, 4), np.float64)
+        surv_poses[0] = np.eye(4)
+        for j in range(n_seq):
+            surv_poses[j + 1] = surv_poses[j] @ np.asarray(Ts2[j],
+                                                          np.float64)
+    poses = np.tile(np.eye(4, dtype=np.float32), (n, 1, 1))
+    for j, i in enumerate(surv):
+        poses[i] = surv_poses[j].astype(np.float32)
+
+    # -- stage 4: merge with dropped stops masked out ----------------------
+    poses_f = jnp.asarray(poses, jnp.float32)
+    keep_dev = jnp.asarray(keep)
+    with trace.span("scan360.merge", view_cap=view_cap,
+                    dropped=len(dropped)):
+        moved = _transform_views_fn()(poses_f, sub_pts)
+        merged = merge_mod._finalize(
+            moved.reshape(-1, 3), sub_col.reshape(-1, 3),
+            (sub_val & keep_dev[:, None]).reshape(-1), mp, has_colors=True)
+    merged = _terminal_guard_cloud(merged, sub_pts, sub_val, coverage,
+                                   health)
+    log.info("scan_stacks_to_cloud[gated]: %d stops (%d dropped) → %d "
+             "points (%s)", n, len(dropped), len(merged), params.method)
+    if with_stats:
+        stats_edges = [
+            {"src": s, "dst": d, "gap": g,
+             "fitness": round(float(fit[i]), 4),
+             "rmse": round(float(rmse[i]), 4)}
+            for i, (s, d, g) in enumerate(edges)]
+        fits = [e["fitness"] for e in stats_edges]
+        stats = {"edges": stats_edges,
+                 "min_fitness": min(fits) if fits else None,
+                 "mean_fitness": round(float(np.mean(fits)), 4)
+                 if fits else None,
+                 "dropped_stops": [labels[i] for i in dropped]}
+        return merged, poses, stats
+    return merged, poses
 
 
 def _run_fused(stacks, calib, col_bits, row_bits, params, decode_cfg,
@@ -518,6 +773,10 @@ def scan_stream_to_cloud(
 
     if key is None:
         key = jax.random.PRNGKey(0)
+    if params.gates is not None:
+        log.warning("quality gates are not applied on the streaming path "
+                    "(single fused tail launch) — run scan_stacks_to_cloud "
+                    "with gates for the contained pipeline")
     chunk = max(1, params.stop_chunk)
     recon = pipeline_mod.reconstruct_batch_fn(col_bits, row_bits,
                                               decode_cfg, tri_cfg)
@@ -578,6 +837,8 @@ def scan_folders_to_cloud(
     decode_cfg: DecodeConfig = DecodeConfig(),
     tri_cfg: TriangulationConfig = TriangulationConfig(),
     key=None,
+    health: health_mod.ScanHealthReport | None = None,
+    stop_labels=None,
 ):
     """File-level wrapper: a list of per-stop frame folders + a `.mat`
     calibration → merged cloud (optionally written to ``output_path``).
@@ -610,7 +871,8 @@ def scan_folders_to_cloud(
             f"bits imply {expect} (white, black, then pattern/inverse pairs)")
     merged, poses = scan_stacks_to_cloud(
         stacks, cal, col_bits, row_bits,
-        params=params, decode_cfg=decode_cfg, tri_cfg=tri_cfg, key=key)
+        params=params, decode_cfg=decode_cfg, tri_cfg=tri_cfg, key=key,
+        health=health, stop_labels=stop_labels)
     if output_path is not None:
         ply_io.write_ply(output_path, merged)
     return merged, poses

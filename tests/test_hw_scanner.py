@@ -458,3 +458,80 @@ def test_stale_upload_cannot_satisfy_next_capture(tmp_path):
     # The stale upload must NOT have satisfied capture B.
     assert done_b.get("ok") is False, \
         "stale upload from capture A satisfied capture B"
+
+
+def test_stale_upload_cannot_satisfy_retry_of_same_path(tmp_path):
+    """The RetryPolicy interaction with the re-arm race documented at
+    hw/command_server.py:110: a retried frame re-arms a capture for the
+    SAME save path, so a late upload from the timed-out first attempt
+    writes to the right file but belongs to the OLD command — it must not
+    signal the retry's event (the retry must wait for a FRESH upload, or
+    time out and back off again). Same-path variant of the regression
+    test above: the path equality makes the command-id guard the ONLY
+    thing standing between the stale upload and a wrong-image frame."""
+    import builtins
+    import threading
+    import time as _time
+
+    from structured_light_for_3d_model_replication_tpu.hw.command_server import (
+        CommandChannel,
+    )
+
+    ch = CommandChannel()
+    path = str(tmp_path / "frame.jpg")
+
+    entered = threading.Event()
+    release = threading.Event()
+    real_open = builtins.open
+    results = {}
+
+    def slow_upload():
+        try:
+            results["path"] = ch.accept_upload(b"attempt-1-stale")
+        except RuntimeError as e:
+            results["err"] = str(e)
+
+    def blocking_open(f, mode="r", *a, **k):
+        if f == path and "w" in mode and not entered.is_set():
+            entered.set()
+            release.wait(5)
+        return real_open(f, mode, *a, **k)
+
+    # Attempt 1: arm, let the upload pass the armed check, then time out.
+    t_a = threading.Thread(
+        target=lambda: results.setdefault("a_ok",
+                                          ch.trigger_capture(path, 1.5)),
+        daemon=True)
+    t_a.start()
+    _time.sleep(0.05)
+    builtins.open = blocking_open
+    try:
+        up = threading.Thread(target=slow_upload, daemon=True)
+        up.start()
+        assert entered.wait(5), "upload never reached the file write"
+        t_a.join(3)
+        assert results.get("a_ok") is False  # attempt 1 timed out
+
+        # Attempt 2 (the retry): SAME path re-armed. Unblock the stale
+        # upload while it is pending.
+        done_b = {}
+
+        def retry_attempt():
+            done_b["ok"] = ch.trigger_capture(path, 0.6)
+
+        t_b = threading.Thread(target=retry_attempt, daemon=True)
+        t_b.start()
+        _time.sleep(0.05)
+        release.set()
+        up.join(2)
+        t_b.join(2)
+    finally:
+        builtins.open = real_open
+        release.set()
+
+    # The stale bytes DID land in the file (same path), but the retry was
+    # not fooled: its own upload never came, so it must report failure and
+    # leave the retry loop to recapture.
+    assert results.get("path") == path
+    assert done_b.get("ok") is False, \
+        "stale upload from attempt 1 satisfied the retry's capture"

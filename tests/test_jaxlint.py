@@ -27,7 +27,7 @@ REPO_ROOT = Path(__file__).resolve().parents[1]
 
 EXPECTED_RULES = {
     "pallas-import", "host-sync-in-jit", "implicit-dtype",
-    "static-argnames", "mutable-global", "key-reuse",
+    "static-argnames", "mutable-global", "key-reuse", "silent-except",
 }
 
 # rule → (rel_path, triggering source, clean source, suppressed source).
@@ -154,6 +154,33 @@ FIXTURES = {
             return x + len(_CACHE)  # jaxlint: disable=mutable-global
         """,
     ),
+    "silent-except": (
+        "hw/mod.py",
+        """
+        def probe(ports):
+            for p in ports:
+                try:
+                    return open_port(p)
+                except Exception:
+                    continue
+        """,
+        """
+        def probe(ports):
+            for p in ports:
+                try:
+                    return open_port(p)
+                except (OSError, ValueError) as e:
+                    log.debug("no device on %s: %s", p, e)
+        """,
+        """
+        def probe(ports):
+            for p in ports:
+                try:
+                    return open_port(p)
+                except Exception:  # jaxlint: disable=silent-except -- probe loop
+                    continue
+        """,
+    ),
     "key-reuse": (
         "ops/mod.py",
         """
@@ -195,7 +222,7 @@ def _lint(tmp_path: Path, rel_path: str, source: str):
     return lint_file(path, rel_path)
 
 
-def test_registry_has_the_six_rules():
+def test_registry_has_the_expected_rules():
     assert EXPECTED_RULES <= set(REGISTRY)
     assert set(FIXTURES) == EXPECTED_RULES
 

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""CI smoke for the serving layer: real process, real signal, real bytes.
+
+Starts ``cli serve`` as a subprocess on a free port (tiny projector so the
+warmup compiles in seconds), submits ONE synthetic capture over HTTP,
+asserts a non-empty STL mesh comes back, then SIGTERMs the server and
+asserts a clean graceful drain (exit code 0, "drained clean" on stderr).
+Everything is bounded by an overall deadline so a hang fails loudly
+instead of eating the CI job's timeout.
+
+Run: ``python scripts/serve_smoke.py`` (CPU is fine; CI uses
+JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+DEADLINE_S = 420.0
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Tiny rig: 64x32 projector (6+5 bits, 24 frames), 24x40 camera.
+PROJ_W, PROJ_H = 64, 32
+CAM_H, CAM_W = 24, 40
+
+
+def _fail(msg: str, proc: subprocess.Popen | None = None,
+          stderr_lines: list | None = None) -> "NoReturn":
+    print(f"SMOKE FAIL: {msg}", file=sys.stderr)
+    if stderr_lines:
+        print("--- server stderr ---", file=sys.stderr)
+        print("".join(stderr_lines[-50:]), file=sys.stderr)
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+    sys.exit(1)
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    sys.path.insert(0, REPO)
+    import numpy as np  # noqa: F401  (stack build below)
+
+    from structured_light_for_3d_model_replication_tpu.config import (
+        ProjectorConfig,
+    )
+    from structured_light_for_3d_model_replication_tpu.models import (
+        synthetic,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClient,
+    )
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m",
+           "structured_light_for_3d_model_replication_tpu.cli", "serve",
+           "--port", "0", "--proj-width", str(PROJ_W),
+           "--proj-height", str(PROJ_H),
+           "--buckets", f"{CAM_H}x{CAM_W}", "--batch-sizes", "1,2",
+           "--mesh-depth", "6", "--drain-timeout", "60"]
+    print("starting:", " ".join(cmd))
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stderr=subprocess.PIPE, text=True)
+
+    stderr_lines: list[str] = []
+    port_event = threading.Event()
+    port = [None]
+
+    def pump():
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            m = re.search(r"serving on :(\d+)", line)
+            if m:
+                port[0] = int(m.group(1))
+                port_event.set()
+        port_event.set()  # EOF: unblock the waiter either way
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    if not port_event.wait(DEADLINE_S) or port[0] is None:
+        _fail("server never announced its port", proc, stderr_lines)
+    print(f"server up on :{port[0]} "
+          f"({time.monotonic() - t_start:.1f}s to ready)")
+
+    # One synthetic scan over the wire → STL back.
+    proj = ProjectorConfig(width=PROJ_W, height=PROJ_H)
+    cam = synthetic.default_calibration(CAM_H, CAM_W, proj)
+    stack, _ = synthetic.render_scan(synthetic.Scene(), *cam,
+                                     CAM_H, CAM_W, proj)
+    client = ServeClient(f"http://127.0.0.1:{port[0]}", timeout_s=60.0)
+    health = client.healthz()
+    if not health.get("ok"):
+        _fail(f"unhealthy server: {health}", proc, stderr_lines)
+
+    data, status = client.run(stack, result_format="stl",
+                              timeout_s=DEADLINE_S)
+    if len(data) <= 84:
+        _fail(f"STL result too small ({len(data)} bytes)", proc,
+              stderr_lines)
+    (n_faces,) = struct.unpack("<I", data[80:84])  # binary STL face count
+    if n_faces == 0 or n_faces != status["result"]["faces"]:
+        _fail(f"empty/inconsistent mesh: header={n_faces}, "
+              f"status={status['result']}", proc, stderr_lines)
+    print(f"got mesh: {n_faces} faces, {len(data)} bytes "
+          f"(coverage {status['result']['coverage']})")
+
+    metrics = client.metrics()
+    if "serve_program_cache_hits_total" not in metrics:
+        _fail("metrics endpoint missing cache counters", proc,
+              stderr_lines)
+
+    # Graceful drain on SIGTERM.
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=max(10.0,
+                                   DEADLINE_S - (time.monotonic()
+                                                 - t_start)))
+    except subprocess.TimeoutExpired:
+        _fail("server did not exit after SIGTERM", proc, stderr_lines)
+    if rc != 0:
+        _fail(f"server exited {rc} after SIGTERM", proc, stderr_lines)
+    time.sleep(0.2)  # let the pump thread catch the final lines
+    if not any("drained clean" in line for line in stderr_lines):
+        _fail("no 'drained clean' in server stderr", None, stderr_lines)
+    print(f"SMOKE PASS in {time.monotonic() - t_start:.1f}s "
+          "(submit → mesh → SIGTERM → clean drain)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,9 @@ host:
 ``view``           render a .ply/.stl to PNG — the headless stand-in for the
                    reference's Open3D viewer moments (`Old/New360.py:72`,
                    `Old/StatisticalOutlierRemoval.py:66-71`)
+``serve``          continuous-batching reconstruction service: HTTP
+                   submit/status/result over the batched pipeline
+                   (docs/SERVING.md)
 ================  ===========================================================
 
 Invoke via ``python -m structured_light_for_3d_model_replication_tpu.cli <tool> [args]``.
@@ -33,6 +36,7 @@ _TOOLS = {
     "scan-360": "scan_360",
     "mesh": "mesh",
     "scan": "scan",
+    "serve": "serve",
     "view": "view",
 }
 

@@ -1,0 +1,139 @@
+"""``serve``: the continuous-batching reconstruction service.
+
+Runs the `serve/` subsystem headless: bounded admission queue, bucketed
+continuous batcher, warmed program cache, device worker(s), and the HTTP
+front end (submit/status/result + /healthz + /metrics). SIGTERM/SIGINT
+drain gracefully: in-flight jobs finish, new submissions get a retryable
+503, workers exit, then the listener closes. docs/SERVING.md covers the
+endpoints and tuning (bucket shapes, linger, queue bound).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def _parse_buckets(spec: str) -> tuple:
+    out = []
+    for part in spec.split(","):
+        h, _, w = part.strip().partition("x")
+        out.append((int(h), int(w)))
+    if not out:
+        raise ValueError(f"no buckets in {spec!r}")
+    return tuple(out)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # Defaults come FROM ServeConfig (the documented tuning surface) so
+    # the CLI, in-process users (bench, tests) and docs/SERVING.md can't
+    # silently drift apart.
+    from ..serve.service import ServeConfig
+
+    d = ServeConfig()
+    p = argparse.ArgumentParser(
+        prog="serve",
+        description="Continuous-batching scan-reconstruction service")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8090,
+                   help="0 = pick a free port (printed on stderr)")
+    p.add_argument("--queue-depth", type=int, default=d.queue_depth,
+                   help="bounded admission queue; above it submits get "
+                        "429 + Retry-After")
+    p.add_argument("--linger-ms", type=float, default=d.linger_ms,
+                   help="max wait for batch company before a partial "
+                        "bucket flushes")
+    p.add_argument("--workers", type=int, default=d.workers,
+                   help="device launch lanes (keep 1 per chip)")
+    p.add_argument("--buckets",
+                   default=",".join(f"{h}x{w}" for h, w in d.buckets),
+                   help="comma-separated padded HxW shapes, e.g. "
+                        "'1080x1920,2160x3840'")
+    p.add_argument("--batch-sizes",
+                   default=",".join(str(b) for b in d.batch_sizes),
+                   help="allowed batch sizes (compiled per bucket)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip startup precompilation (first requests of "
+                        "each shape will pay the compile)")
+    p.add_argument("--mesh-depth", type=int, default=d.mesh_depth,
+                   help="Poisson depth for STL results")
+    p.add_argument("--proj-width", type=int, default=d.proj.width,
+                   help="projector width (fixes the protocol bit count)")
+    p.add_argument("--proj-height", type=int, default=d.proj.height)
+    p.add_argument("--calib", default=None,
+                   help="reference-layout .mat calibration; default is "
+                        "the synthetic rig (per-bucket)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="max seconds to wait for in-flight jobs on "
+                        "SIGTERM")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..config import ProjectorConfig
+    from ..serve.service import (
+        ReconstructionService,
+        ServeConfig,
+        ServeHTTPServer,
+        fixed_calib_provider,
+    )
+
+    proj = ProjectorConfig(width=args.proj_width, height=args.proj_height)
+    buckets = _parse_buckets(args.buckets)
+    if args.calib is not None and len(buckets) != 1:
+        # A .mat calibration describes ONE camera geometry; warmup of any
+        # other bucket would die mid-start with a provider error. Refuse
+        # the contradiction up front.
+        print(f"error: --calib serves exactly one bucket, got "
+              f"{args.buckets!r} — pass the single HxW matching the "
+              "calibration's camera", file=sys.stderr)
+        return 2
+    config = ServeConfig(
+        proj=proj,
+        queue_depth=args.queue_depth,
+        linger_ms=args.linger_ms,
+        workers=args.workers,
+        buckets=buckets,
+        batch_sizes=tuple(int(b) for b in args.batch_sizes.split(",")),
+        warmup=not args.no_warmup,
+        mesh_depth=args.mesh_depth)
+
+    calib_provider = None
+    if args.calib is not None:
+        from ..io.matcal import load_calibration_mat
+
+        h, w = buckets[0]
+        calib_provider = fixed_calib_provider(
+            load_calibration_mat(args.calib, h, w))
+
+    service = ReconstructionService(config, calib_provider=calib_provider)
+    print("warming program cache..." if config.warmup else
+          "warmup skipped (--no-warmup)", file=sys.stderr, flush=True)
+    service.start()
+    http = ServeHTTPServer(service, host=args.host, port=args.port).start()
+    # Machine-parseable readiness line (the CI smoke script greps it).
+    print(f"serving on :{http.port}", file=sys.stderr, flush=True)
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        print(f"signal {signum}: draining...", file=sys.stderr, flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    stop.wait()
+
+    ok = service.drain(timeout=args.drain_timeout)
+    http.stop()
+    print("drained clean" if ok else "drain timed out", file=sys.stderr,
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

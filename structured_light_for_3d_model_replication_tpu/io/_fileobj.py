@@ -1,0 +1,31 @@
+"""Path-or-file-object plumbing shared by the binary codecs.
+
+The PLY/STL codecs accept either a filesystem path (opened and closed
+here) or an already-open binary file object (the caller's — e.g. the
+serving layer's in-memory buffers streaming results to HTTP responses).
+One owner for that contract, imported by both codecs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def binary_sink(path_or_file):
+    """Yield a binary writable for a path or an already-open file object
+    (only paths are opened/closed here — a caller's buffer stays theirs)."""
+    if hasattr(path_or_file, "write"):
+        yield path_or_file
+    else:
+        with open(path_or_file, "wb") as f:
+            yield f
+
+
+@contextlib.contextmanager
+def binary_source(path_or_file):
+    if hasattr(path_or_file, "read"):
+        yield path_or_file
+    else:
+        with open(path_or_file, "rb") as f:
+            yield f

@@ -21,6 +21,8 @@ import dataclasses
 
 import numpy as np
 
+from ._fileobj import binary_sink, binary_source
+
 _PLY_TO_NP = {
     "char": "i1", "int8": "i1",
     "uchar": "u1", "uint8": "u1",
@@ -78,9 +80,10 @@ def _parse_header(f):
     return fmt, elements
 
 
-def read_ply(path: str) -> PointCloud:
-    """Read a PLY point cloud (vertex element; faces, if any, are skipped)."""
-    with open(path, "rb") as f:
+def read_ply(path) -> PointCloud:
+    """Read a PLY point cloud (vertex element; faces, if any, are skipped).
+    ``path`` is a filesystem path or an open binary file object."""
+    with binary_source(path) as f:
         fmt, elements = _parse_header(f)
         vertex = next((e for e in elements if e[0] == "vertex"), None)
         if vertex is None:
@@ -96,7 +99,9 @@ def read_ply(path: str) -> PointCloud:
             cols = {nm: raw[:, i] for i, nm in enumerate(names)}
         elif fmt == "binary_little_endian":
             dt = np.dtype([(nm, "<" + _PLY_TO_NP[t]) for t, nm in props])
-            raw = np.fromfile(f, dtype=dt, count=n)
+            # frombuffer on an explicit read, not fromfile: the source may
+            # be an in-memory buffer (fromfile needs a real fileno).
+            raw = np.frombuffer(f.read(dt.itemsize * n), dtype=dt, count=n)
             cols = {nm: raw[nm] for nm in names}
         else:
             raise ValueError(f"unsupported PLY format {fmt!r}")
@@ -113,12 +118,16 @@ def read_ply(path: str) -> PointCloud:
 
 
 def write_ply(
-    path: str,
+    path,
     cloud: PointCloud,
     binary: bool = True,
 ) -> None:
     """Write a point cloud. Binary little-endian by default; ASCII matches the
-    reference's schema (xyz %.4f + uchar rgb) for drop-in interop."""
+    reference's schema (xyz %.4f + uchar rgb) for drop-in interop.
+
+    ``path`` is a filesystem path or an open binary file object (the
+    serving layer streams PLY results to HTTP clients without touching
+    disk)."""
     pts = np.asarray(cloud.points, np.float32)
     n = pts.shape[0]
     fields = [("x", "<f4"), ("y", "<f4"), ("z", "<f4")]
@@ -138,7 +147,7 @@ def write_ply(
         f"element vertex {n}\n" + "\n".join(header_props) + "\nend_header\n"
     )
 
-    with open(path, "wb") as f:
+    with binary_sink(path) as f:
         f.write(header.encode())
         if binary:
             rec = np.empty(n, dtype=np.dtype(fields))
@@ -150,7 +159,10 @@ def write_ply(
                 col = np.asarray(cloud.colors, np.uint8)
                 rec["red"], rec["green"], rec["blue"] = (
                     col[:, 0], col[:, 1], col[:, 2])
-            rec.tofile(f)
+            # Buffer-protocol write, not tofile: the sink may be an
+            # in-memory buffer (tofile needs a real fileno), and rec.data
+            # avoids tobytes's full transient copy on multi-MB clouds.
+            f.write(rec.data)
         else:
             parts = ["%.4f %.4f %.4f"]
             arrays = [pts]

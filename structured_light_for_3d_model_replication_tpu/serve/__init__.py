@@ -1,0 +1,79 @@
+"""Continuous-batching reconstruction service.
+
+The compute core (`models/pipeline.reconstruct`) is serving-shaped — one
+static-shape XLA program whose batch-8 lane amortizes to a fraction of the
+single-shot latency — but every other entry point in the repo is a one-shot
+CLI. This package is the missing layer between "a fast kernel" and "a
+service": admission control in front, batched static-shape programs behind,
+the same shape the high-throughput pipelines in the related literature use
+(Gaussian-Plus-SDF SLAM's decoupled 150+ fps pipeline, AGS's admission
+gating — PAPERS.md).
+
+Data path::
+
+    client ── POST /submit ──▶ AdmissionQueue (bounded; priorities,
+                               deadlines, reject-with-retry-after)
+                   │
+                   ▼
+             BucketBatcher     pads (H, W) up to a configured bucket,
+                               coalesces same-bucket jobs into B ∈
+                               {1, 2, 4, 8} batches, flushes on
+                               batch-full or max-linger
+                   │
+                   ▼
+             ProgramCache      AOT-compiled executables keyed by
+                               (B, F, H, W, bits, configs); startup
+                               warmup, LRU eviction, hit/miss counters
+                   │
+                   ▼
+             DeviceWorker(s)   run the batch, per-job postprocess
+                               (compact → PLY, or the models/meshing
+                               tail → STL), per-job fault containment
+                               on the PR-3 health taxonomy
+
+Everything is stdlib + the existing pipeline: the HTTP front end is a
+``ThreadingHTTPServer`` like `hw/command_server.py`, metrics ride
+`utils/trace.MetricsRegistry`, and errors are `health.ScanFault` subclasses
+so one poisoned stack degrades that job, not the process.
+
+Entry points: ``python -m structured_light_for_3d_model_replication_tpu.cli
+serve`` (front end), :class:`~.service.ReconstructionService` (in-process),
+:class:`~.client.ServeClient` (stdlib client). docs/SERVING.md has the
+architecture and tuning guide.
+"""
+
+from .batcher import Batch, BucketBatcher, BucketKey, bucket_for
+from .cache import ProgramCache, ProgramKey
+from .client import ServeClient
+from .jobs import (
+    AdmissionQueue,
+    Job,
+    JobRejected,
+    QueueClosedError,
+    QueueFullError,
+    ServeError,
+    StackFormatError,
+)
+from .service import ReconstructionService, ServeConfig, ServeHTTPServer
+from .worker import DeviceWorker
+
+__all__ = [
+    "AdmissionQueue",
+    "Batch",
+    "BucketBatcher",
+    "BucketKey",
+    "DeviceWorker",
+    "Job",
+    "JobRejected",
+    "ProgramCache",
+    "ProgramKey",
+    "QueueClosedError",
+    "QueueFullError",
+    "ReconstructionService",
+    "ServeClient",
+    "ServeConfig",
+    "ServeError",
+    "ServeHTTPServer",
+    "StackFormatError",
+    "bucket_for",
+]

@@ -1,0 +1,198 @@
+"""Explicit compiled-executable cache: warmup, LRU, hit/miss counters.
+
+Relying on `jax.jit`'s implicit cache is how serving stacks get surprise
+multi-second stalls: the first request of a new shape compiles inline, on
+the request thread, with no way to see it coming on a dashboard. Here the
+executable for every (batch, frames, H, W, bits, configs) program is
+compiled EXPLICITLY via the AOT path
+(``reconstruct_batch_fn(...).lower(shapes).compile()``) and held in a
+bounded LRU:
+
+* **warmup** precompiles the configured buckets × batch sizes at startup,
+  so steady-state traffic never sees a compile (the zero-recompile
+  acceptance bar; asserted in tests via these counters AND the jit cache
+  sizes — AOT executables bypass the jit cache entirely, so those sizes
+  staying flat proves no request slipped onto the implicit path);
+* **hit/miss/compile-time counters** land in the metrics registry
+  (``serve_program_cache_*`` on /metrics), so a miss storm is visible as
+  a counter spike, not a latency mystery;
+* **LRU eviction** bounds device/host program memory when a service sees
+  many one-off shapes; evicting drops the executable, and the next use
+  recompiles (counted).
+
+A compile happens at most once per key even under concurrent misses: the
+per-key entry holds an event that racers wait on while the first caller
+compiles outside the registry lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+
+from ..utils import trace
+from ..utils.log import get_logger
+from .batcher import BucketKey
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramKey:
+    """BucketKey + batch size: one compiled executable."""
+
+    bucket: BucketKey
+    batch: int
+
+    def label(self) -> str:
+        return f"B{self.batch}:{self.bucket.label()}"
+
+
+class _Entry:
+    __slots__ = ("ready", "compiled", "error", "compile_s")
+
+    def __init__(self):
+        self.ready = threading.Event()
+        self.compiled = None
+        self.error: BaseException | None = None
+        self.compile_s = 0.0
+
+
+class ProgramCache:
+    """LRU of AOT-compiled batch-reconstruction executables.
+
+    ``calib_provider(height, width)`` returns the device Calibration for a
+    bucket; its array shapes (not values) parameterize the compile, so one
+    cache serves any rig whose calibration matches the bucket geometry.
+    """
+
+    def __init__(self, calib_provider, max_entries: int = 32,
+                 registry: "trace.MetricsRegistry | None" = None):
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.calib_provider = calib_provider
+        self.max_entries = max_entries
+        self.registry = registry if registry is not None else trace.REGISTRY
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[ProgramKey, _Entry] = OrderedDict()
+        self._hits = self.registry.counter(
+            "serve_program_cache_hits_total",
+            "program-cache lookups served without compiling")
+        self._misses = self.registry.counter(
+            "serve_program_cache_misses_total",
+            "program-cache lookups that triggered a compile")
+        self._evictions = self.registry.counter(
+            "serve_program_cache_evictions_total",
+            "programs dropped by LRU bounding")
+        self._compile_s = self.registry.counter(
+            "serve_program_cache_compile_seconds_total",
+            "cumulative wall-clock spent compiling programs")
+        self._entries_gauge = self.registry.gauge(
+            "serve_program_cache_entries", "resident compiled programs")
+
+    # ------------------------------------------------------------------
+
+    def _compile(self, key: ProgramKey):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import pipeline
+
+        b = key.bucket
+        calib = self.calib_provider(b.height, b.width)
+        fn = pipeline.reconstruct_batch_fn(
+            b.col_bits, b.row_bits, decode_cfg=b.decode_cfg,
+            tri_cfg=b.tri_cfg, downsample=b.downsample)
+        stack_spec = jax.ShapeDtypeStruct(
+            (key.batch, b.frames, b.height, b.width), jnp.uint8)
+        t0 = time.monotonic()
+        compiled = fn.lower(stack_spec, calib).compile()
+        dt = time.monotonic() - t0
+        self._compile_s.inc(dt)
+        log.info("compiled %s in %.2fs", key.label(), dt)
+        return compiled, dt
+
+    def get(self, key: ProgramKey):
+        """The compiled executable for ``key`` — compiling (and counting a
+        miss) if absent, else a counted hit. Raises the original compile
+        error on every lookup of a key whose compile failed (failed
+        entries are not cached)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                owner = False
+            else:
+                entry = _Entry()
+                self._entries[key] = entry
+                owner = True
+        if owner:
+            self._misses.inc()
+            try:
+                entry.compiled, entry.compile_s = self._compile(key)
+            except BaseException as e:
+                entry.error = e
+                with self._lock:
+                    self._entries.pop(key, None)
+                raise
+            finally:
+                entry.ready.set()
+            self._bound()
+        else:
+            entry.ready.wait()
+            if entry.error is not None:
+                raise entry.error
+            self._hits.inc()
+        with self._lock:
+            self._entries_gauge.set(len(self._entries))
+        return entry.compiled
+
+    def _bound(self) -> None:
+        with self._lock:
+            while len(self._entries) > self.max_entries:
+                # Victim = oldest READY entry: an in-flight compile must
+                # not be popped (its executable would be dropped the
+                # moment it finishes, forcing a duplicate compile on the
+                # next lookup of that key).
+                victim = next((k for k, e in self._entries.items()
+                               if e.ready.is_set()), None)
+                if victim is None:
+                    break  # everything resident is mid-compile
+                self._entries.pop(victim)
+                self._evictions.inc()
+                log.info("evicted %s (LRU, max_entries=%d)",
+                         victim.label(), self.max_entries)
+
+    # ------------------------------------------------------------------
+
+    def warmup(self, bucket_keys, batch_sizes) -> dict:
+        """Precompile every (bucket, batch) program; returns
+        {label: compile_s}. Called at service start so the first real
+        request of any configured shape is a hit."""
+        out = {}
+        for bucket in bucket_keys:
+            for b in batch_sizes:
+                key = ProgramKey(bucket=bucket, batch=int(b))
+                with trace.span("serve.warmup", program=key.label()):
+                    t0 = time.monotonic()
+                    self.get(key)
+                    out[key.label()] = round(time.monotonic() - t0, 3)
+        # Warmup compiles are misses by construction; zero them out of the
+        # steady-state signal? No — they stay counted (honest totals), and
+        # the zero-recompile assertion compares counters AFTER warmup.
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            entries = [k.label() for k in self._entries]
+        return {
+            "entries": entries,
+            "size": len(entries),
+            "max_entries": self.max_entries,
+            "hits": int(self._hits.value),
+            "misses": int(self._misses.value),
+            "evictions": int(self._evictions.value),
+            "compile_seconds_total": round(self._compile_s.value, 3),
+        }

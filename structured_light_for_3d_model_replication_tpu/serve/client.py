@@ -1,0 +1,156 @@
+"""Stdlib client for the reconstruction service.
+
+The wire format is deliberately primitive — an ``.npy`` body plus a few
+``X-`` headers — so anything that can HTTP-POST a file can submit a scan
+(curl included; docs/SERVING.md shows the one-liner). This class wraps
+the submit → poll → fetch dance for tests, the bench offered-load sweep
+(config [7]) and the CI smoke script, with honest error surfacing:
+backpressure (429/503) raises :class:`BackpressureError` carrying the
+server's retry-after hint instead of burying it in response prose.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+
+class ServeClientError(RuntimeError):
+    """Non-retryable client-visible failure (4xx, failed job, timeout)."""
+
+    def __init__(self, message: str, payload: dict | None = None):
+        super().__init__(message)
+        self.payload = payload or {}
+
+
+class BackpressureError(ServeClientError):
+    """Queue full (429) or draining (503) — retry after ``retry_after_s``."""
+
+    def __init__(self, message: str, retry_after_s: float | None,
+                 payload: dict | None = None):
+        super().__init__(message, payload)
+        self.retry_after_s = retry_after_s
+
+
+class ServeClient:
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------------
+
+    def _request(self, req: urllib.request.Request):
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.status, dict(r.headers), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
+
+    @staticmethod
+    def _payload(body: bytes) -> dict:
+        try:
+            return json.loads(body.decode())
+        except (ValueError, UnicodeDecodeError):
+            return {"raw": body[:200].decode(errors="replace")}
+
+    # ------------------------------------------------------------------
+
+    def submit(self, stack: np.ndarray, result_format: str = "ply",
+               priority: str = "normal",
+               deadline_s: float | None = None) -> str:
+        """POST one capture stack; returns the job id."""
+        stack = np.asarray(stack)
+        if stack.dtype != np.uint8:
+            # No silent coercion: casting float [0,1] data (or aliasing
+            # int16 mod 256) would upload a well-formed but meaningless
+            # stack that fails server-side with a misleading coverage
+            # error. The caller converts explicitly or fixes the source.
+            raise ServeClientError(
+                f"stack must be uint8, got {stack.dtype} — convert "
+                "explicitly (e.g. (x * 255).astype(np.uint8))")
+        buf = io.BytesIO()
+        np.save(buf, stack)
+        headers = {"Content-Type": "application/octet-stream",
+                   "X-Result-Format": result_format,
+                   "X-Priority": priority}
+        if deadline_s is not None:
+            headers["X-Deadline-S"] = str(deadline_s)
+        req = urllib.request.Request(self.base_url + "/submit",
+                                     data=buf.getvalue(), headers=headers,
+                                     method="POST")
+        status, hdrs, body = self._request(req)
+        payload = self._payload(body)
+        if status in (429, 503):
+            retry = payload.get("error", {}).get("retry_after_s")
+            if retry is None and hdrs.get("Retry-After"):
+                retry = float(hdrs["Retry-After"])
+            raise BackpressureError(
+                f"submit refused ({status}): "
+                f"{payload.get('error', {}).get('message', 'overloaded')}",
+                retry, payload)
+        if status != 200:
+            raise ServeClientError(f"submit failed ({status}): {payload}",
+                                   payload)
+        return payload["job_id"]
+
+    def status(self, job_id: str) -> dict:
+        status, _, body = self._request(urllib.request.Request(
+            f"{self.base_url}/status?id={job_id}"))
+        payload = self._payload(body)
+        if status != 200:
+            raise ServeClientError(f"status failed ({status}): {payload}",
+                                   payload)
+        return payload
+
+    def wait(self, job_id: str, timeout_s: float = 60.0,
+             poll_s: float = 0.05) -> dict:
+        """Poll until the job is terminal; returns its final status dict.
+        A FAILED job returns normally — callers inspect ``error`` (its
+        taxonomy chain tells retryable congestion from poisoned input)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            st = self.status(job_id)
+            if st["status"] in ("done", "failed"):
+                return st
+            if time.monotonic() > deadline:
+                raise ServeClientError(
+                    f"job {job_id} still {st['status']} after "
+                    f"{timeout_s}s", st)
+            time.sleep(poll_s)
+
+    def result(self, job_id: str) -> bytes:
+        status, _, body = self._request(urllib.request.Request(
+            f"{self.base_url}/result?id={job_id}"))
+        if status != 200:
+            raise ServeClientError(
+                f"result not available ({status})", self._payload(body))
+        return body
+
+    def run(self, stack: np.ndarray, result_format: str = "ply",
+            timeout_s: float = 60.0) -> tuple[bytes, dict]:
+        """submit + wait + fetch; raises on a failed job."""
+        job_id = self.submit(stack, result_format=result_format)
+        st = self.wait(job_id, timeout_s=timeout_s)
+        if st["status"] != "done":
+            raise ServeClientError(
+                f"job {job_id} failed: {st.get('error')}", st)
+        return self.result(job_id), st
+
+    # ------------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        _, _, body = self._request(urllib.request.Request(
+            self.base_url + "/healthz"))
+        return self._payload(body)
+
+    def metrics(self) -> str:
+        status, _, body = self._request(urllib.request.Request(
+            self.base_url + "/metrics"))
+        if status != 200:
+            raise ServeClientError(f"metrics failed ({status})")
+        return body.decode()

@@ -1,0 +1,340 @@
+"""Job model + bounded admission queue.
+
+The queue is the service's ONLY growth point, so it is bounded by
+construction: over-admission is rejected at submit time with a retryable
+status and a ``retry_after_s`` hint (computed from queue depth × the
+observed per-job service EMA), never buffered. That is the AGS admission
+rule (PAPERS.md: covisibility-gated frame admission — drop at the door,
+not in the middle of the pipeline) applied to a reconstruction RPC.
+
+Service-side faults subclass the PR-3 :class:`~..health.ScanFault`
+taxonomy: the status payload of a failed job carries the same error
+vocabulary (``CaptureError``/``StopQualityError``/…) that `scan-360`
+health reports use, so a client can tell a malformed upload from a
+decode-quality failure from an overloaded queue without parsing prose.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from ..config import DecodeConfig, TriangulationConfig
+from ..health import CaptureError, ScanFault
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy (service-side extensions of health.ScanFault)
+# ---------------------------------------------------------------------------
+
+
+class ServeError(ScanFault):
+    """Base of the service-side fault vocabulary."""
+
+
+class JobRejected(ServeError):
+    """The job never entered the queue (full, closed, or malformed).
+
+    ``retryable`` distinguishes "try again later" (backpressure) from
+    "fix your request" (malformed stack)."""
+
+    retryable = False
+
+
+class QueueFullError(JobRejected):
+    """Bounded queue at capacity — retry after ``retry_after_s``."""
+
+    retryable = True
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"admission queue full ({depth} jobs); retry in "
+            f"{retry_after_s:.2f}s")
+        self.retry_after_s = retry_after_s
+
+
+class QueueClosedError(JobRejected):
+    """Service is draining (SIGTERM) — in-flight jobs finish, new work is
+    refused."""
+
+    retryable = True
+
+    def __init__(self):
+        super().__init__("service is draining; submit to another replica")
+        self.retry_after_s = None
+
+
+class StackFormatError(CaptureError, JobRejected):
+    """Malformed capture stack (dtype/rank/frame-count/size) — the upload
+    analogue of a truncated frame file, hence a ``CaptureError``."""
+
+
+class DeadlineExceededError(ServeError):
+    """The job's deadline lapsed before a worker could start it."""
+
+
+def error_payload(exc: BaseException) -> dict:
+    """Status-payload form of a fault: concrete type + the taxonomy chain
+    (most-derived first) so clients can match on any ancestor they know."""
+    taxonomy = [c.__name__ for c in type(exc).__mro__
+                if issubclass(c, ScanFault)]
+    out = {"type": type(exc).__name__, "message": str(exc),
+           "taxonomy": taxonomy or ["Exception"]}
+    retry = getattr(exc, "retry_after_s", None)
+    if retry is not None:
+        out["retry_after_s"] = round(float(retry), 3)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Job
+# ---------------------------------------------------------------------------
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+
+@dataclasses.dataclass(eq=False)  # identity equality: a job IS its object
+class Job:
+    """One reconstruction request: a capture stack in, a PLY/STL out.
+
+    Mutable state (status, result, error) is guarded by ``_lock``;
+    ``wait`` blocks on the terminal event. Timestamps are monotonic
+    (queue-wait / batch-wait / run are per-stage latencies on /metrics).
+    """
+
+    stack: np.ndarray                 # (F, H, W) uint8 capture stack
+    col_bits: int
+    row_bits: int
+    decode_cfg: DecodeConfig = DecodeConfig()
+    tri_cfg: TriangulationConfig = TriangulationConfig()
+    downsample: int = 1
+    result_format: str = "ply"        # "ply" | "stl"
+    priority: int = 1                 # 0 high, 1 normal, 2 low
+    deadline_s: float | None = None   # seconds from submit; None = no limit
+    job_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:16])
+
+    # -- lifecycle state (lock-guarded) ------------------------------------
+    status: str = QUEUED
+    error: dict | None = None
+    result_bytes: bytes | None = None
+    result_meta: dict = dataclasses.field(default_factory=dict)
+    # Terminal observer (set by the service before admission): called once
+    # with the job after complete/fail, WHEREVER the transition happens —
+    # worker postprocess, batch-scoped failure, or deadline scrub in the
+    # queue/batcher. Keeps the jobs_total{done,failed} counters conserved
+    # against submitted without every layer knowing the registry.
+    on_terminal: "callable | None" = dataclasses.field(
+        default=None, repr=False)
+
+    submitted_t: float = 0.0
+    started_t: float | None = None
+    finished_t: float | None = None
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._terminal = threading.Event()
+        self.submitted_t = time.monotonic()
+
+    # ------------------------------------------------------------------
+
+    @property
+    def deadline_t(self) -> float | None:
+        if self.deadline_s is None:
+            return None
+        return self.submitted_t + self.deadline_s
+
+    def expired(self, now: float | None = None) -> bool:
+        dl = self.deadline_t
+        return dl is not None and (now or time.monotonic()) > dl
+
+    # ------------------------------------------------------------------
+
+    def mark_running(self) -> None:
+        with self._lock:
+            self.status = RUNNING
+            self.started_t = time.monotonic()
+
+    def complete(self, result: bytes, **meta) -> None:
+        with self._lock:
+            if self._terminal.is_set():
+                return  # first terminal transition wins
+            self.status = DONE
+            self.result_bytes = result
+            self.result_meta.update(meta)
+            self.finished_t = time.monotonic()
+            # Release the input stack: terminal jobs stay registered for
+            # /status///result polling (completed_cap of them), and at
+            # 1080p each stack is ~95 MB — keeping them would let the
+            # registry pin tens of GB of dead inputs.
+            self.stack = None
+        self._terminal.set()
+        if self.on_terminal is not None:
+            self.on_terminal(self)
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._terminal.is_set():
+                return
+            self.status = FAILED
+            self.error = error_payload(exc)
+            self.finished_t = time.monotonic()
+            self.stack = None  # same release rule as complete()
+        self._terminal.set()
+        if self.on_terminal is not None:
+            self.on_terminal(self)
+
+    def release_result(self) -> int:
+        """Drop the retained result payload (registry byte-budget
+        eviction); returns bytes freed. The job entry itself survives, so
+        /status stays truthful and /result can answer an explicit 410
+        instead of a silent unknown-job 404."""
+        with self._lock:
+            n = len(self.result_bytes) if self.result_bytes else 0
+            self.result_bytes = None
+            if n:
+                self.result_meta["result_evicted"] = True
+        return n
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the job reaches a terminal state."""
+        return self._terminal.wait(timeout)
+
+    # ------------------------------------------------------------------
+
+    def status_dict(self) -> dict:
+        with self._lock:
+            # Queue wait ends at start, or at the terminal transition for
+            # jobs that never started (deadline scrub) — "now" only while
+            # genuinely still waiting, else the number grows forever.
+            wait_end = (self.started_t or self.finished_t
+                        or time.monotonic())
+            out = {
+                "job_id": self.job_id,
+                "status": self.status,
+                "result_format": self.result_format,
+                "priority": self.priority,
+                "queue_wait_s": round(wait_end - self.submitted_t, 4),
+            }
+            if self.started_t is not None and self.finished_t is not None:
+                out["run_s"] = round(self.finished_t - self.started_t, 4)
+            if self.error is not None:
+                out["error"] = dict(self.error)
+            if self.status == DONE:
+                out["result"] = dict(self.result_meta)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Bounded admission queue
+# ---------------------------------------------------------------------------
+
+
+class AdmissionQueue:
+    """Thread-safe bounded priority queue with deadline scrubbing.
+
+    Ordering is (priority, arrival) — starvation-free within a priority
+    class. ``submit`` never blocks and never grows past ``max_depth``:
+    at capacity it raises :class:`QueueFullError` whose ``retry_after_s``
+    is depth × the EMA of observed per-job service time (workers feed the
+    EMA via :meth:`observe_service_time`), i.e. an honest estimate of when
+    a slot frees up. ``close`` flips the queue into drain mode: pops still
+    serve (in-flight work finishes), submits are refused.
+    """
+
+    def __init__(self, max_depth: int = 64,
+                 default_service_s: float = 0.25):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._closed = False
+        self._service_ema_s = default_service_s
+
+    # ------------------------------------------------------------------
+
+    def check_admission(self) -> None:
+        """Raise the rejection `submit` WOULD raise right now, without
+        enqueueing. Advisory (another submitter can win the race), but it
+        lets a transport reject an oversized upload at headers time
+        instead of buffering ~95 MB per connection just to say 429 —
+        `submit` remains the authoritative gate."""
+        with self._lock:
+            self._check_admission_locked()
+
+    def _check_admission_locked(self) -> None:
+        if self._closed:
+            raise QueueClosedError()
+        if len(self._heap) >= self.max_depth:
+            retry = max(0.05, len(self._heap) * self._service_ema_s)
+            raise QueueFullError(len(self._heap), retry)
+
+    def submit(self, job: Job) -> None:
+        with self._lock:
+            self._check_admission_locked()
+            heapq.heappush(self._heap,
+                           (job.priority, next(self._seq), job))
+            self._not_empty.notify()
+
+    def pop(self, timeout: float | None = None) -> Job | None:
+        """Next admissible job, or None on timeout. Jobs whose deadline
+        lapsed while queued are failed (DeadlineExceededError) and skipped
+        — a worker never spends a batch slot on work nobody is waiting
+        for."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._not_empty:
+            while True:
+                while self._heap:
+                    _, _, job = heapq.heappop(self._heap)
+                    if job.expired():
+                        job.fail(DeadlineExceededError(
+                            f"deadline {job.deadline_s:.2f}s lapsed after "
+                            f"{time.monotonic() - job.submitted_t:.2f}s "
+                            "in queue"))
+                        continue
+                    return job
+                if deadline is None:
+                    self._not_empty.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._not_empty.wait(remaining)
+
+    # ------------------------------------------------------------------
+
+    def observe_service_time(self, seconds: float) -> None:
+        """EMA update from a worker's measured per-job latency — feeds the
+        retry-after hint."""
+        with self._lock:
+            self._service_ema_s = (0.8 * self._service_ema_s
+                                   + 0.2 * max(1e-3, seconds))
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()

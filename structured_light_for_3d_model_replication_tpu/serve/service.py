@@ -1,0 +1,528 @@
+"""Service composition + stdlib HTTP front end.
+
+:class:`ReconstructionService` wires queue → batcher → program cache →
+device workers into one lifecycle (start / serve / drain) and owns the
+job registry clients poll. :class:`ServeHTTPServer` is the transport: a
+``ThreadingHTTPServer`` (same dependency posture as `hw/command_server.py`
+— no web framework) exposing
+
+========================  ==================================================
+``POST /submit``           ``.npy`` capture stack body (+ ``X-*`` option
+                           headers) → ``{"job_id": ...}``; 429 + Retry-After
+                           on backpressure, 503 while draining, 400 on a
+                           malformed stack
+``GET /status?id=``        job lifecycle + taxonomy error payload
+``GET /result?id=``        the PLY/STL bytes (409 until done)
+``GET /healthz``           liveness + drain flag + worker/queue state
+``GET /metrics``           Prometheus text: queue depth, batch-occupancy
+                           histogram, program-cache stats, per-stage span
+                           latencies (utils/trace)
+========================  ==================================================
+
+The HTTP layer holds no state of its own — every handler delegates to the
+service object, so in-process callers (tests, bench) and HTTP clients see
+identical semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..config import DecodeConfig, ProjectorConfig, TriangulationConfig
+from ..health import QualityGates
+from ..utils import trace
+from ..utils.log import get_logger
+from .batcher import BucketBatcher, BucketKey
+from .cache import ProgramCache
+from .jobs import (
+    DONE,
+    FAILED,
+    AdmissionQueue,
+    Job,
+    JobRejected,
+    StackFormatError,
+    error_payload,
+)
+from .worker import DeviceWorker
+
+log = get_logger(__name__)
+
+_PRIORITY_NAMES = {"high": 0, "normal": 1, "low": 2}
+_CONTENT_TYPES = {"ply": "application/x-ply",
+                  "stl": "model/stl"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Service tuning surface (docs/SERVING.md has the tuning guide)."""
+
+    proj: ProjectorConfig = ProjectorConfig()
+    decode_cfg: DecodeConfig = DecodeConfig()
+    tri_cfg: TriangulationConfig = TriangulationConfig()
+    gates: QualityGates = QualityGates()
+
+    queue_depth: int = 64          # bounded admission (backpressure above)
+    linger_ms: float = 10.0        # max wait for batch company
+    workers: int = 1               # device launch lanes
+    buckets: tuple = ((1080, 1920),)   # padded (H, W) shapes
+    batch_sizes: tuple = (1, 2, 4, 8)
+    max_cache_entries: int = 32
+    warmup: bool = True            # precompile buckets × batch sizes
+    mesh_depth: int = 7            # STL results: Poisson depth
+    completed_cap: int = 256       # terminal jobs kept for /status///result
+    # Byte budget for retained result payloads (a 1080p PLY is ~30 MB —
+    # 256 of those would pin ~8 GB; the count cap alone doesn't bound
+    # memory). Oldest terminal jobs are evicted past EITHER cap.
+    result_cache_bytes: int = 512 << 20
+
+
+def synthetic_calib_provider(proj: ProjectorConfig):
+    """Per-bucket synthetic rig calibration (the no-hardware default —
+    the same `models/synthetic.default_calibration` geometry the bench
+    and tests use). Memoized per (H, W): Calibration arrays live on
+    device and are shared by every batch of that bucket."""
+    from ..models import synthetic
+    from ..ops.triangulate import make_calibration
+
+    lock = threading.Lock()
+    cache: dict = {}
+
+    def provider(height: int, width: int):
+        with lock:
+            calib = cache.get((height, width))
+        if calib is not None:
+            return calib
+        cam_K, proj_K, R, T = synthetic.default_calibration(
+            height, width, proj)
+        calib = make_calibration(cam_K, proj_K, R, T, height, width,
+                                 proj_width=proj.width,
+                                 proj_height=proj.height)
+        with lock:
+            cache[(height, width)] = calib
+        return calib
+
+    return provider
+
+
+def fixed_calib_provider(calib):
+    """Single-rig provider from a loaded calibration (``--calib`` .mat):
+    only the bucket matching its camera geometry is servable."""
+    h, w = int(calib.Nc.shape[0]), int(calib.Nc.shape[1])
+
+    def provider(height: int, width: int):
+        if (height, width) != (h, w):
+            raise StackFormatError(
+                f"service calibration is {h}x{w}; bucket "
+                f"{height}x{width} has no calibration")
+        return calib
+
+    return provider
+
+
+class ReconstructionService:
+    """Queue → batcher → cache → workers, one lifecycle, one job registry."""
+
+    def __init__(self, config: ServeConfig = ServeConfig(),
+                 calib_provider=None,
+                 registry: "trace.MetricsRegistry | None" = None,
+                 tracer: "trace.Tracer | None" = None):
+        self.config = config
+        # Fresh registry per service by default: parallel services (tests,
+        # bench sweeps) must not sum each other's counters. Pass
+        # trace.REGISTRY explicitly to meter into the process-global one.
+        self.registry = registry if registry is not None \
+            else trace.MetricsRegistry()
+        self.tracer = tracer if tracer is not None else trace.GLOBAL
+        self.queue = AdmissionQueue(max_depth=config.queue_depth)
+        self.batcher = BucketBatcher(
+            self.queue, buckets=config.buckets,
+            batch_sizes=config.batch_sizes,
+            linger_s=config.linger_ms / 1e3)
+        self.calib_provider = (calib_provider if calib_provider is not None
+                               else synthetic_calib_provider(config.proj))
+        self.cache = ProgramCache(self.calib_provider,
+                                  max_entries=config.max_cache_entries,
+                                  registry=self.registry)
+        self.workers = [
+            DeviceWorker(self.batcher, self.cache, gates=config.gates,
+                         mesh_depth=config.mesh_depth,
+                         registry=self.registry, tracer=self.tracer,
+                         name=f"serve-worker-{i}")
+            for i in range(max(1, config.workers))]
+        self._jobs_lock = threading.Lock()
+        self._jobs: OrderedDict[str, Job] = OrderedDict()
+        self._draining = False
+        self._started = False
+        self._jobs_total = lambda status: self.registry.counter(
+            "serve_jobs_total", "jobs by admission/terminal status",
+            status=status)
+        self._queue_gauge = self.registry.gauge(
+            "serve_queue_depth", "jobs waiting in the admission queue")
+        self._warmup_report: dict = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReconstructionService":
+        if self.config.warmup:
+            keys = [self._bucket_key(h, w) for h, w in self.config.buckets]
+            t0 = time.monotonic()
+            self._warmup_report = self.cache.warmup(
+                keys, self.config.batch_sizes)
+            log.info("warmup: %d programs in %.1fs",
+                     len(self._warmup_report), time.monotonic() - t0)
+        for w in self.workers:
+            w.start()
+        self._started = True
+        return self
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: refuse new work, finish everything admitted,
+        stop workers. Returns True when every worker exited in time."""
+        self._draining = True
+        self.queue.close()
+        for w in self.workers:
+            w.request_stop()
+        deadline = time.monotonic() + timeout
+        ok = True
+        for w in self.workers:
+            w.join(max(0.0, deadline - time.monotonic()))
+            ok = ok and not w.alive
+        if not ok:
+            log.warning("drain timed out after %.1fs with workers alive",
+                        timeout)
+        return ok
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _bucket_key(self, h: int, w: int) -> BucketKey:
+        cfg = self.config
+        return BucketKey(height=h, width=w, frames=cfg.proj.n_frames,
+                         col_bits=cfg.proj.col_bits,
+                         row_bits=cfg.proj.row_bits,
+                         decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg)
+
+    # -- submission --------------------------------------------------------
+
+    def submit_array(self, stack: np.ndarray, result_format: str = "ply",
+                     priority="normal",
+                     deadline_s: float | None = None) -> Job:
+        """Validate + admit one capture stack; returns the live Job.
+        Raises a :class:`~.jobs.JobRejected` subclass on refusal."""
+        cfg = self.config
+        try:
+            stack = self._validate_stack(stack)
+            if result_format not in _CONTENT_TYPES:
+                raise StackFormatError(
+                    f"result_format must be one of "
+                    f"{sorted(_CONTENT_TYPES)}, got {result_format!r}")
+            if isinstance(priority, str):
+                if priority not in _PRIORITY_NAMES:
+                    raise StackFormatError(
+                        f"priority must be one of "
+                        f"{sorted(_PRIORITY_NAMES)} or an int, "
+                        f"got {priority!r}")
+                priority = _PRIORITY_NAMES[priority]
+            job = Job(stack=stack, col_bits=cfg.proj.col_bits,
+                      row_bits=cfg.proj.row_bits,
+                      decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
+                      result_format=result_format,
+                      priority=int(priority), deadline_s=deadline_s)
+            # Observer BEFORE admission (a worker may finish the job
+            # before _register runs); registry entry AFTER admission (a
+            # rejected job must leave no trace — a pre-registered one
+            # would sit QUEUED forever, pinning its stack, unbounded
+            # growth under the exact overload the bounded queue exists
+            # for).
+            job.on_terminal = self._on_terminal
+            self.queue.submit(job)
+            self._register(job)
+        except JobRejected:
+            self._jobs_total("rejected").inc()
+            raise
+        self._jobs_total("submitted").inc()
+        self._queue_gauge.set(self.queue.depth())
+        return job
+
+    def _validate_stack(self, stack: np.ndarray) -> np.ndarray:
+        cfg = self.config
+        stack = np.asarray(stack)
+        if stack.dtype != np.uint8:
+            raise StackFormatError(
+                f"stack must be uint8, got {stack.dtype}")
+        if stack.ndim != 3:
+            raise StackFormatError(
+                f"stack must be (frames, H, W), got shape {stack.shape}")
+        f, h, w = stack.shape
+        if f != cfg.proj.n_frames:
+            raise StackFormatError(
+                f"stack has {f} frames; this service's protocol is "
+                f"{cfg.proj.n_frames} (2 + 2x{cfg.proj.col_bits} + "
+                f"2x{cfg.proj.row_bits})")
+        # Must fit SOME configured bucket (per-axis maxima are not
+        # enough: a stack under both maxima but inside no single bucket
+        # would otherwise fail late in the worker — or trigger a
+        # request-time compile of an off-menu quantum bucket).
+        if h < 8 or w < 8 or not any(h <= bh and w <= bw
+                                     for bh, bw in cfg.buckets):
+            raise StackFormatError(
+                f"frame size {h}x{w} fits no configured bucket "
+                f"{list(cfg.buckets)} (min 8x8)")
+        return stack
+
+    def check_admission(self) -> None:
+        """Headers-time backpressure probe for the HTTP layer: raises the
+        rejection `submit_array` would, AND counts it — a refusal must hit
+        the rejected counter whether it happened before or after the body
+        was read."""
+        try:
+            self.queue.check_admission()
+        except JobRejected:
+            self._jobs_total("rejected").inc()
+            raise
+
+    def _on_terminal(self, job: Job) -> None:
+        """Counter conservation: every admitted job ends exactly one of
+        done/failed (rejected jobs are counted at submit), wherever the
+        terminal transition happened — worker postprocess, batch-scoped
+        failure, or deadline scrub in the queue/batcher."""
+        self._jobs_total("done" if job.status == DONE else "failed").inc()
+
+    def _register(self, job: Job) -> None:
+        with self._jobs_lock:
+            self._jobs[job.job_id] = job
+            # Bound the registry two ways (live jobs are never touched —
+            # a client could still be polling them):
+            # count cap — drop the oldest terminal ENTRIES entirely;
+            terminal = [(jid, j) for jid, j in self._jobs.items()
+                        if j.status in (DONE, FAILED)]
+            excess = len(self._jobs) - self.config.completed_cap
+            for jid, _ in terminal[:max(0, excess)]:
+                del self._jobs[jid]
+            # byte budget — drop only the oldest result PAYLOADS. The
+            # entries stay, so a client that saw "done" and comes late
+            # gets an explicit 410 ("result evicted"), never a silent
+            # unknown-job 404.
+            kept = [j for _, j in terminal[max(0, excess):]]
+            held = sum(len(j.result_bytes) for j in kept
+                       if j.result_bytes is not None)
+            for j in kept:
+                if held <= self.config.result_cache_bytes:
+                    break
+                held -= j.release_result()
+
+    # -- inspection --------------------------------------------------------
+
+    def get_job(self, job_id: str) -> Job | None:
+        with self._jobs_lock:
+            return self._jobs.get(job_id)
+
+    def status(self, job_id: str) -> dict | None:
+        job = self.get_job(job_id)
+        if job is None:
+            return None
+        out = job.status_dict()
+        # Terminal counters are registered at observation time (cheap,
+        # idempotent-per-scrape is fine for these dashboards).
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "queue_depth": self.queue.depth(),
+            "pending_batches": self.batcher.pending_depth(),
+            "draining": self._draining,
+            "workers_alive": sum(w.alive for w in self.workers),
+            "cache": self.cache.stats(),
+            "warmup": self._warmup_report,
+        }
+
+    def metrics_text(self) -> str:
+        self._queue_gauge.set(self.queue.depth())
+        return self.registry.prometheus_text(tracer=self.tracer)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+MAX_SUBMIT_BYTES = 1 << 30  # absolute transport bound; admission is tighter
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    service: ReconstructionService  # bound by ServeHTTPServer
+
+    protocol_version = "HTTP/1.1"
+    # Socket timeout: a stalled upload or idle keep-alive connection must
+    # not pin its handler thread forever — without this, N dead-slow
+    # clients hold N threads with the admission queue's 429 never
+    # engaging (the request never completes).
+    timeout = 120.0
+
+    def _json(self, obj, status=200, headers=()):
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _bytes(self, data: bytes, content_type: str):
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # ------------------------------------------------------------------
+
+    def do_POST(self):
+        # Early-error paths below respond WITHOUT reading the (possibly
+        # ~95 MB) body; under HTTP/1.1 keep-alive the unread bytes would
+        # desync the next request on the connection, so those paths close
+        # it (flag + explicit header so the client knows too).
+        if urlparse(self.path).path != "/submit":
+            self.close_connection = True
+            self._json({"error": "not found"}, 404,
+                       headers=(("Connection", "close"),))
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0 or length > MAX_SUBMIT_BYTES:
+                self.close_connection = True
+                # Counted here because this refusal never reaches the
+                # service's own counting gates (check_admission /
+                # submit_array) — transport-level refusals must hit the
+                # rejected counter too.
+                self.service._jobs_total("rejected").inc()
+                raise StackFormatError(
+                    f"Content-Length {length} outside (0, "
+                    f"{MAX_SUBMIT_BYTES}]")
+            # Backpressure at HEADERS time: when the queue is full or
+            # draining, reject before buffering the (~95 MB at 1080p)
+            # body — N overloaded connections must cost N sockets, not
+            # N stacks of transient RSS. submit_array below remains the
+            # authoritative (race-free) gate.
+            try:
+                self.service.check_admission()
+            except JobRejected:
+                self.close_connection = True
+                raise
+            body = self.rfile.read(length)
+            stack = np.load(io.BytesIO(body), allow_pickle=False)
+            deadline = self.headers.get("X-Deadline-S")
+            job = self.service.submit_array(
+                stack,
+                result_format=self.headers.get("X-Result-Format", "ply"),
+                priority=self.headers.get("X-Priority", "normal"),
+                deadline_s=float(deadline) if deadline else None)
+        except JobRejected as e:
+            payload = error_payload(e)
+            retry = payload.get("retry_after_s")
+            status = 400
+            headers = []
+            if e.retryable:
+                status = 503 if retry is None else 429
+                if retry is not None:
+                    headers.append(("Retry-After", str(max(1, round(retry)))))
+            if self.close_connection:  # body was never read (length gate)
+                headers.append(("Connection", "close"))
+            self._json({"error": payload}, status, headers)
+            return
+        except Exception as e:
+            # Undecodable body, bad header values, … — client-side
+            # errors. The body may not have been read (e.g. a garbage
+            # Content-Length header throws before rfile.read), so this
+            # path closes the connection like the other early errors.
+            self.close_connection = True
+            self._json({"error": {"type": type(e).__name__,
+                                  "message": str(e)}}, 400,
+                       headers=(("Connection", "close"),))
+            return
+        self._json({"job_id": job.job_id, "status": job.status})
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path == "/healthz":
+            stats = self.service.stats()
+            ok = stats["workers_alive"] > 0 and not stats["draining"]
+            self._json({"ok": ok, **stats}, 200 if ok else 503)
+        elif url.path == "/metrics":
+            data = self.service.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif url.path == "/status":
+            job_id = (parse_qs(url.query).get("id") or [""])[0]
+            status = self.service.status(job_id)
+            if status is None:
+                self._json({"error": f"unknown job {job_id!r}"}, 404)
+            else:
+                self._json(status)
+        elif url.path == "/result":
+            self._result((parse_qs(url.query).get("id") or [""])[0])
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def _result(self, job_id: str):
+        job = self.service.get_job(job_id)
+        if job is None:
+            self._json({"error": f"unknown job {job_id!r}"}, 404)
+        elif job.status == DONE:
+            data = job.result_bytes
+            if data is None:  # payload fell out of the byte budget
+                self._json({"job_id": job_id, "status": job.status,
+                            "error": "result evicted from the bounded "
+                                     "result cache; resubmit the scan",
+                            "result": dict(job.result_meta)}, 410)
+            else:
+                self._bytes(data, _CONTENT_TYPES[job.result_format])
+        elif job.status == FAILED:
+            self._json(job.status_dict(), 409)
+        else:
+            self._json({"job_id": job_id, "status": job.status,
+                        "error": "result not ready"}, 409)
+
+    def log_message(self, fmt, *args):  # per-request noise → debug log
+        log.debug("http: " + fmt, *args)
+
+
+class ServeHTTPServer:
+    """Owns the listener thread (mirrors `hw/command_server.CommandServer`)."""
+
+    def __init__(self, service: ReconstructionService,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        handler = type("BoundServeHandler", (_ServeHandler,),
+                       {"service": service})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="serve-http", daemon=True)
+        self._started = False
+
+    def start(self) -> "ServeHTTPServer":
+        self._thread.start()
+        self._started = True
+        log.info("reconstruction service on :%d", self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            self._httpd.shutdown()
+        self._httpd.server_close()

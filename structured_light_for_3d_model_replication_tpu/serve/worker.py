@@ -1,0 +1,192 @@
+"""Device worker: batch launch, per-job postprocess, fault containment.
+
+One worker owns one launch-at-a-time lane to the device: it asks the
+batcher for a coalesced batch, fetches the AOT executable from the
+program cache (a hit in steady state), launches, reads back, and
+postprocesses each job independently. Failure containment follows the
+PR-3 rule (health.py): a poisoned stack degrades ITS job — a
+`StopQualityError` in that job's status payload — while batchmates
+complete normally and the process keeps serving. Only genuinely
+batch-scoped failures (the launch itself) fail the whole batch, and even
+those never kill the worker loop.
+
+Graceful drain: ``request_stop`` flips the loop into force-flush mode —
+partial buckets launch immediately (linger is pointless when no more
+work is coming) — and the thread exits once batcher and queue are empty.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+
+import numpy as np
+
+from ..health import QualityGates, ScanFault, StopQualityError
+from ..io.ply import PointCloud, write_ply
+from ..io.stl import write_stl
+from ..utils import trace
+from ..utils.log import get_logger
+from .batcher import Batch, BucketBatcher
+from .cache import ProgramCache, ProgramKey
+
+log = get_logger(__name__)
+
+
+def _ply_bytes(cloud: PointCloud) -> bytes:
+    buf = io.BytesIO()
+    write_ply(buf, cloud)
+    return buf.getvalue()
+
+
+def _stl_bytes(mesh) -> bytes:
+    buf = io.BytesIO()
+    write_stl(buf, mesh)
+    return buf.getvalue()
+
+
+class DeviceWorker:
+    """Thread running the batch → launch → postprocess loop."""
+
+    def __init__(self, batcher: BucketBatcher, cache: ProgramCache,
+                 gates: QualityGates = QualityGates(),
+                 mesh_depth: int = 7,
+                 registry: "trace.MetricsRegistry | None" = None,
+                 tracer: "trace.Tracer | None" = None,
+                 name: str = "serve-worker"):
+        self.batcher = batcher
+        self.cache = cache
+        self.gates = gates
+        self.mesh_depth = mesh_depth
+        self.registry = registry if registry is not None else trace.REGISTRY
+        self.tracer = tracer if tracer is not None else trace.GLOBAL
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._batches = self.registry.counter(
+            "serve_batches_total", "batches launched")
+        self._occupancy = self.registry.histogram(
+            "serve_batch_occupancy", "real jobs per launched batch",
+            buckets=(1, 2, 4, 8))
+        self._padded = self.registry.counter(
+            "serve_padded_slots_total",
+            "batch slots filled with zero stacks to reach a bucketed size")
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> "DeviceWorker":
+        self._thread.start()
+        return self
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            draining = self._stop.is_set()
+            batch = self.batcher.next_batch(timeout=0.05, force=draining)
+            if batch is None:
+                if draining and self.batcher.pending_depth() == 0 \
+                        and self.batcher.queue.depth() == 0:
+                    return
+                continue
+            try:
+                self._process(batch)
+            except Exception as e:
+                # Batch-scoped failure (compile, launch, transfer): every
+                # job in it fails with the fault payload; the worker — and
+                # with it the process — keeps serving.
+                log.warning("batch %s failed: %s", batch.key.label(), e)
+                for job in batch.jobs:
+                    job.fail(e)
+
+    # ------------------------------------------------------------------
+
+    def _process(self, batch: Batch) -> None:
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        for job in batch.jobs:
+            job.mark_running()
+        key = ProgramKey(bucket=batch.key, batch=batch.size)
+        with self.tracer.span("serve.batch", program=key.label(),
+                              occupancy=batch.occupancy):
+            compiled = self.cache.get(key)
+            calib = self.cache.calib_provider(batch.key.height,
+                                              batch.key.width)
+            with self.tracer.span("launch"):  # path: serve.batch.launch
+                out = compiled(jnp.asarray(batch.stacked()), calib)
+                # Single readback of the dense batch result; everything
+                # after is host-side numpy.
+                points = np.asarray(out.points)
+                colors = np.asarray(out.colors)
+                valid = np.asarray(out.valid)
+            self._batches.inc()
+            self._occupancy.observe(batch.occupancy)
+            self._padded.inc(batch.size - batch.occupancy)
+            with self.tracer.span("postprocess"):
+                for i, job in enumerate(batch.jobs):
+                    self._finish_job(job, batch.key, points[i], colors[i],
+                                     valid[i])
+        per_job = (time.monotonic() - t0) / max(1, batch.occupancy)
+        self.batcher.queue.observe_service_time(per_job)
+
+    def _finish_job(self, job, key, points, colors, valid) -> None:
+        try:
+            result, meta = self._postprocess(job, key, points, colors,
+                                             valid)
+            job.complete(result, **meta)
+        except ScanFault as e:
+            log.warning("job %s failed: %s", job.job_id, e)
+            job.fail(e)
+        except Exception as e:
+            # Containment boundary: an unexpected host-side error (a
+            # meshing corner case, a writer bug) costs this job only.
+            log.warning("job %s failed unexpectedly: %s", job.job_id, e)
+            job.fail(e)
+
+    def _postprocess(self, job, key, points, colors,
+                     valid) -> tuple[bytes, dict]:
+        """Dense per-job lane → client artifact (PLY cloud or STL mesh).
+
+        The coverage gate reads the job's ORIGINAL (pre-padding) pixel
+        region: padded pixels are black and decode invalid by design, so
+        counting them would punish small-in-bucket jobs."""
+        _, h, w = job.stack.shape
+        vgrid = valid.reshape(key.height, key.width)[:h, :w]
+        coverage = float(vgrid.mean())
+        if not self.gates.coverage_ok(coverage):
+            raise StopQualityError(
+                f"decode coverage {coverage:.4f} below gate "
+                f"{self.gates.min_coverage} — stack unusable "
+                "(black/saturated/garbage upload?)")
+        keep = valid.astype(bool)
+        cloud = PointCloud(points=points[keep].astype(np.float32),
+                           colors=colors[keep].astype(np.uint8))
+        meta = {"points": int(len(cloud)), "coverage": round(coverage, 4)}
+        if job.result_format == "ply":
+            return _ply_bytes(cloud), meta
+        # STL: the models/meshing tail (normals → sparse/dense Poisson →
+        # extraction → weld) on this job's cloud.
+        from ..models import meshing
+
+        mesh = meshing.mesh_from_cloud(
+            cloud, mode="watertight", depth=self.mesh_depth,
+            quantile_trim=0.0)
+        meta.update(vertices=int(len(mesh.vertices)),
+                    faces=int(len(mesh.faces)))
+        if len(mesh.faces) == 0:
+            raise StopQualityError(
+                f"meshing produced 0 faces from {len(cloud)} points — "
+                "cloud too sparse for a watertight surface")
+        return _stl_bytes(mesh), meta

@@ -14,6 +14,13 @@ north-star metric of the whole build is scan→mesh wall-clock seconds:
 * module-level :func:`span` / :func:`summary` / :func:`export` on a global
   default tracer, so pipeline stages can annotate themselves without
   threading a tracer object through every call.
+* :class:`Counter` / :class:`Gauge` / :class:`Histogram` +
+  :class:`MetricsRegistry` — thread-safe monotonic counters and gauges
+  with a Prometheus text exporter (:meth:`MetricsRegistry.prometheus_text`).
+  The serving layer's ``/metrics`` endpoint renders the module-level
+  :data:`REGISTRY`, and the exporter folds in a :class:`Tracer`'s span
+  aggregates (``sl_span_seconds_total{span="scan360.register"}`` …) so the
+  existing scan360 stage spans surface on the same scrape.
 
 Spans measure HOST wall-clock: async dispatches that return lazy arrays
 cost ~0 unless the span body blocks. Wrap the section you time with
@@ -168,3 +175,243 @@ summary = GLOBAL.summary
 export = GLOBAL.export
 totals = GLOBAL.totals
 reset = GLOBAL.reset
+
+
+# ---------------------------------------------------------------------------
+# Metrics: thread-safe counters/gauges/histograms + Prometheus text export
+# ---------------------------------------------------------------------------
+
+
+class Counter:
+    """Monotonic counter. ``inc`` with a negative amount raises — a counter
+    that can go down is a gauge, and Prometheus rate() silently mis-reads
+    one disguised as the other."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter increment must be >= 0, got {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, cache entries, in-flight jobs)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus semantics (cumulative
+    ``_bucket{le=...}`` counts + ``_sum``/``_count``). Default buckets fit
+    the serving layer's batch-occupancy range (1..8)."""
+
+    def __init__(self, buckets: tuple = (1, 2, 4, 8)):
+        self._lock = threading.Lock()
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * len(self.buckets)   # per-bucket, non-cumulative
+        self._overflow = 0
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._overflow += 1
+
+    def snapshot(self) -> dict:
+        """{le: cumulative_count} (incl. "+Inf") + sum/count/mean."""
+        with self._lock:
+            counts = list(self._counts)
+            overflow = self._overflow
+            total = self._count
+            s = self._sum
+        cum, acc = {}, 0
+        for le, c in zip(self.buckets, counts):
+            acc += c
+            cum[_fmt_float(le)] = acc
+        cum["+Inf"] = acc + overflow
+        return {"buckets": cum, "sum": s, "count": total,
+                "mean": (s / total) if total else 0.0}
+
+
+def _fmt_float(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(v)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _render_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Named metric families with optional labels.
+
+    ``registry.counter("serve_jobs_total", status="done").inc()`` get-or-
+    creates the ``status="done"`` child of the ``serve_jobs_total`` family;
+    re-registering a name as a different kind raises (one name, one type —
+    the Prometheus exposition-format rule)."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> (kind, help, {labels_tuple: instrument})
+        self._families: dict[str, tuple] = {}
+
+    def _get(self, kind: str, name: str, help_: str, labels: dict,
+             **ctor_kwargs):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = (kind, help_, {})
+                self._families[name] = fam
+            elif fam[0] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam[0]}, "
+                    f"requested {kind}")
+            children = fam[2]
+            inst = children.get(key)
+            if inst is None:
+                inst = self._KINDS[kind](**ctor_kwargs)
+                children[key] = inst
+            elif "buckets" in ctor_kwargs:
+                # A silently-ignored differing bucket layout would route
+                # observations into the WRONG quantile bins; mismatches
+                # fail loudly like kind mismatches do.
+                want = tuple(sorted(float(b)
+                                    for b in ctor_kwargs["buckets"]))
+                if want != inst.buckets:
+                    raise ValueError(
+                        f"histogram {name!r}{dict(key)} already has "
+                        f"buckets {inst.buckets}, requested {want}")
+            return inst
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._get("counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._get("gauge", name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple = (1, 2, 4, 8), **labels) -> Histogram:
+        return self._get("histogram", name, help, labels, buckets=buckets)
+
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Plain-dict view (JSON-friendly; /status payloads, tests)."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            families = {n: (k, h, dict(c))
+                        for n, (k, h, c) in self._families.items()}
+        for name, (kind, _, children) in sorted(families.items()):
+            fam_out = out.setdefault(name, {})
+            for key, inst in sorted(children.items()):
+                label_s = _render_labels(key) or "_"
+                fam_out[label_s] = (inst.snapshot() if kind == "histogram"
+                                    else inst.value)
+        return out
+
+    def prometheus_text(self, tracer: "Tracer | None" = None) -> str:
+        """Prometheus exposition text of every registered metric, plus —
+        when a tracer is given — its span aggregates as
+        ``sl_span_seconds_total`` / ``sl_span_count`` / ``sl_span_max_seconds``
+        families labelled by span path."""
+        lines: list[str] = []
+        with self._lock:
+            families = {n: (k, h, dict(c))
+                        for n, (k, h, c) in self._families.items()}
+        for name, (kind, help_, children) in sorted(families.items()):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            for key, inst in sorted(children.items()):
+                if kind == "histogram":
+                    snap = inst.snapshot()
+                    for le, c in snap["buckets"].items():
+                        lab = dict(key)
+                        lab["le"] = le
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_render_labels(tuple(sorted(lab.items())))}"
+                            f" {c}")
+                    lines.append(
+                        f"{name}_sum{_render_labels(key)} "
+                        f"{_fmt_metric(snap['sum'])}")
+                    lines.append(
+                        f"{name}_count{_render_labels(key)} {snap['count']}")
+                else:
+                    lines.append(f"{name}{_render_labels(key)} "
+                                 f"{_fmt_metric(inst.value)}")
+        if tracer is not None:
+            agg = tracer.totals()
+            if agg:
+                lines.append("# HELP sl_span_seconds_total cumulative "
+                             "wall-clock per tracer span")
+                lines.append("# TYPE sl_span_seconds_total counter")
+                for path, a in sorted(agg.items()):
+                    lab = _render_labels((("span", path),))
+                    lines.append(f"sl_span_seconds_total{lab} "
+                                 f"{_fmt_metric(a['total_s'])}")
+                lines.append("# TYPE sl_span_count counter")
+                for path, a in sorted(agg.items()):
+                    lab = _render_labels((("span", path),))
+                    lines.append(f"sl_span_count{lab} {a['count']}")
+                lines.append("# TYPE sl_span_max_seconds gauge")
+                for path, a in sorted(agg.items()):
+                    lab = _render_labels((("span", path),))
+                    lines.append(f"sl_span_max_seconds{lab} "
+                                 f"{_fmt_metric(a['max_s'])}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_metric(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+# Module-level default registry, mirroring the GLOBAL tracer: callers that
+# don't thread a registry through (serve/, bench) meter themselves here.
+REGISTRY = MetricsRegistry()

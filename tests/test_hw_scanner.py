@@ -3,6 +3,8 @@
 import json
 import os
 import threading
+import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -535,3 +537,157 @@ def test_stale_upload_cannot_satisfy_retry_of_same_path(tmp_path):
     assert results.get("path") == path
     assert done_b.get("ok") is False, \
         "stale upload from attempt 1 satisfied the retry's capture"
+
+
+# ---------------------------------------------------------------------------
+# Command-server concurrency (ThreadingHTTPServer: every request is a thread)
+# ---------------------------------------------------------------------------
+
+
+def _post_upload(base, payload):
+    req = urllib.request.Request(
+        base + "/upload", data=payload,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_parallel_uploads_race_one_armed_capture(command_server, tmp_path):
+    """8 simultaneous /upload POSTs against ONE armed capture: the trigger
+    completes, the file lands, nobody 500s, and every late racer gets the
+    clean 400 ("no capture armed") — not a traceback out of the handler
+    thread."""
+    base = f"http://127.0.0.1:{command_server.port}"
+    target = str(tmp_path / "race.jpg")
+    trig = {}
+
+    def pc_side():
+        trig["ok"] = command_server.channel.trigger_capture(target,
+                                                            timeout=10)
+
+    t = threading.Thread(target=pc_side)
+    t.start()
+    for _ in range(100):  # wait until armed
+        if _get_json(base + "/poll_command")["command"] == "capture":
+            break
+
+    results = []
+    lock = threading.Lock()
+    start = threading.Barrier(8)
+
+    def racer(i):
+        start.wait()
+        out = _post_upload(base, b"\xff\xd8RACE%d\xff\xd9" % i)
+        with lock:
+            results.append(out)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    t.join(timeout=10)
+
+    assert trig["ok"] is True
+    assert os.path.exists(target)
+    codes = sorted(c for c, _ in results)
+    assert all(c in (200, 400) for c in codes), codes   # no 5xx, no crash
+    assert codes.count(200) >= 1                        # someone satisfied it
+    # The file holds ONE racer's complete payload — no interleaved halves.
+    with open(target, "rb") as f:
+        data = f.read()
+    assert data.startswith(b"\xff\xd8RACE") and data.endswith(b"\xff\xd9")
+
+
+def test_parallel_stray_uploads_all_rejected(command_server):
+    """With NO capture armed, concurrent uploads are all clean 400s (the
+    stray/double-upload path) and the server keeps serving."""
+    base = f"http://127.0.0.1:{command_server.port}"
+    results = []
+    lock = threading.Lock()
+
+    def racer(i):
+        out = _post_upload(base, b"stray-%d" % i)
+        with lock:
+            results.append(out)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    assert [c for c, _ in results] == [400] * 8
+    assert _get_json(base + "/status") is not None      # still alive
+
+
+def test_concurrent_polls_dedup_on_stable_id(command_server, tmp_path):
+    """16 threads polling during one armed capture all see the SAME
+    command id (the client-side dedup key) — per-request threads must not
+    mint per-poll ids — and the id changes across triggers."""
+    base = f"http://127.0.0.1:{command_server.port}"
+    ch = command_server.channel
+
+    def trigger(path):
+        return threading.Thread(
+            target=lambda: ch.trigger_capture(path, timeout=5))
+
+    t = trigger(str(tmp_path / "a.jpg"))
+    t.start()
+    for _ in range(100):
+        if _get_json(base + "/poll_command")["command"] == "capture":
+            break
+
+    seen = []
+    lock = threading.Lock()
+
+    def poller():
+        st = _get_json(base + "/poll_command")
+        with lock:
+            seen.append((st["command"], st["id"]))
+
+    threads = [threading.Thread(target=poller) for _ in range(16)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    assert len(seen) == 16
+    assert {c for c, _ in seen} == {"capture"}
+    first_ids = {i for _, i in seen}
+    assert len(first_ids) == 1, "poll minted different ids mid-command"
+
+    _post_upload(base, b"\xff\xd8A\xff\xd9")
+    t.join(timeout=10)
+
+    t2 = trigger(str(tmp_path / "b.jpg"))
+    t2.start()
+    for _ in range(100):
+        st = _get_json(base + "/poll_command")
+        if st["command"] == "capture":
+            break
+    assert st["id"] not in first_ids, "new trigger reused the old id"
+    _post_upload(base, b"\xff\xd8B\xff\xd9")
+    t2.join(timeout=10)
+
+
+def test_poll_silence_flips_connected_after_window(command_server,
+                                                   monkeypatch):
+    """The 5 s poll-silence disconnect (`server/server.py:80-93` watchdog,
+    event-driven here): connected goes True on a poll, False once the
+    window lapses with no poll, True again on the next poll. Shrunk window
+    so the test takes ~0.3 s."""
+    from structured_light_for_3d_model_replication_tpu.hw import (
+        command_server as cs_mod,
+    )
+
+    monkeypatch.setattr(cs_mod, "POLL_SILENCE_DISCONNECT_S", 0.2)
+    base = f"http://127.0.0.1:{command_server.port}"
+    _get_json(base + "/poll_command")
+    assert _get_json(base + "/status")["connected"] is True
+    time.sleep(0.3)
+    assert _get_json(base + "/status")["connected"] is False  # silence
+    _get_json(base + "/poll_command")
+    assert _get_json(base + "/status")["connected"] is True   # recovers

@@ -521,3 +521,42 @@ def test_meshing_routes_deep_depth_to_sparse(rng):
     assert len(mesh.faces) > 10_000
     rad = np.linalg.norm(mesh.vertices, axis=1)
     assert abs(np.median(rad) - 50.0) < 1.0
+
+
+def test_cpu_solve_never_touches_pallas(rng, monkeypatch):
+    """ADVICE.md round-5 item: the `from . import poisson_pallas` in the
+    CG hot paths must be reached only when use_pallas resolves True (TPU
+    backends). Regression guard: with the pallas kernel module made
+    unimportable, a CPU solve still completes — if the lazy-import gate
+    ever regresses to unconditional, this raises at trace time."""
+    import builtins
+    import sys
+
+    import jax
+
+    assert jax.default_backend() == "cpu"  # conftest pins JAX_PLATFORMS
+
+    for name in [k for k in list(sys.modules)
+                 if k.endswith("poisson_pallas")]:
+        monkeypatch.delitem(sys.modules, name)
+    real_import = builtins.__import__
+
+    def guard(name, globals=None, locals=None, fromlist=(), level=0):
+        if "poisson_pallas" in name or (
+                fromlist and "poisson_pallas" in fromlist):
+            raise ImportError(
+                "poisson_pallas imported on a CPU-only deployment")
+        return real_import(name, globals, locals, fromlist, level)
+
+    monkeypatch.setattr(builtins, "__import__", guard)
+
+    pts, nrm = _sphere_cloud(rng, 2000)
+    # Off-default static args (cg_iters=41) force a FRESH trace of the
+    # solver even when earlier tests warmed the jit cache — the lazy
+    # import sits in the traced body, so only a fresh trace exercises it.
+    sgrid, n_blocks = poisson_sparse.reconstruct_sparse(
+        pts, nrm, depth=6, cg_iters=41, max_blocks=2048, coarse_depth=5)
+    chi = np.asarray(sgrid.chi)
+    assert int(n_blocks) > 0
+    assert np.isfinite(chi).all()
+    assert np.abs(chi).max() > 0  # actually solved, not a zero fallback

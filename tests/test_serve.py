@@ -1,0 +1,655 @@
+"""Continuous-batching reconstruction service (serve/).
+
+Covers the subsystem's acceptance bars:
+
+* bounded admission — over-admission rejected with a retryable status and
+  an honest retry-after, never unbounded growth;
+* zero steady-state recompiles — after warmup a mixed-shape 50-job load
+  is 100% program-cache hits AND the jit caches stay untouched (the AOT
+  executables bypass them; same technique as test_chaos's no-recompile
+  assertion);
+* batching engages — 16 same-bucket jobs coalesce to mean occupancy >= 4
+  and beat sequential single-shot submission per scan;
+* fault containment — a poisoned stack fails only its own job, with the
+  health-taxonomy error in the status payload, while batchmates and the
+  process keep going;
+* graceful drain — in-flight jobs finish, new work is refused.
+
+Shapes are tiny (24x40 cameras, 24-frame protocol) so the whole file
+compiles a handful of sub-second programs.
+"""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import (
+    ProjectorConfig,
+)
+from structured_light_for_3d_model_replication_tpu.health import (
+    ScanFault,
+    StopQualityError,
+)
+from structured_light_for_3d_model_replication_tpu.models import synthetic
+from structured_light_for_3d_model_replication_tpu.serve import (
+    AdmissionQueue,
+    BucketBatcher,
+    Job,
+    ProgramCache,
+    ProgramKey,
+    QueueClosedError,
+    QueueFullError,
+    ReconstructionService,
+    ServeClient,
+    ServeConfig,
+    ServeHTTPServer,
+    StackFormatError,
+    bucket_for,
+)
+from structured_light_for_3d_model_replication_tpu.serve.batcher import (
+    BucketKey,
+    batch_size_for,
+)
+from structured_light_for_3d_model_replication_tpu.serve.jobs import (
+    DeadlineExceededError,
+    error_payload,
+)
+from structured_light_for_3d_model_replication_tpu.serve.service import (
+    synthetic_calib_provider,
+)
+
+PROJ = ProjectorConfig(width=64, height=32)     # 6+5 bits, 24 frames
+H, W = 24, 40                                   # exact primary bucket
+H2, W2 = 32, 48                                 # second bucket
+BATCH_SIZES = (1, 2, 4)
+
+
+def _job(stack=None, **kw):
+    if stack is None:
+        stack = np.zeros((PROJ.n_frames, H, W), np.uint8)
+    kw.setdefault("col_bits", PROJ.col_bits)
+    kw.setdefault("row_bits", PROJ.row_bits)
+    return Job(stack=stack, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Admission queue (pure stdlib — no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_queue_bounded_rejects_with_retry_after():
+    q = AdmissionQueue(max_depth=2)
+    q.submit(_job())
+    q.submit(_job())
+    with pytest.raises(QueueFullError) as ei:
+        q.submit(_job())
+    assert ei.value.retryable
+    assert ei.value.retry_after_s > 0
+    payload = error_payload(ei.value)
+    assert payload["retry_after_s"] > 0
+    assert "ScanFault" in payload["taxonomy"]  # PR-3 vocabulary
+    assert q.depth() == 2  # rejected job never entered
+
+
+def test_queue_retry_after_tracks_service_time():
+    q = AdmissionQueue(max_depth=1, default_service_s=0.1)
+    for _ in range(20):
+        q.observe_service_time(2.0)
+    q.submit(_job())
+    with pytest.raises(QueueFullError) as ei:
+        q.submit(_job())
+    assert ei.value.retry_after_s > 0.5  # EMA pulled toward 2 s/job
+
+
+def test_queue_priority_order_fifo_within_class():
+    q = AdmissionQueue(max_depth=8)
+    normal1 = _job(priority=1)
+    low = _job(priority=2)
+    high = _job(priority=0)
+    normal2 = _job(priority=1)
+    for j in (normal1, low, high, normal2):
+        q.submit(j)
+    order = [q.pop(0.1) for _ in range(4)]
+    assert order == [high, normal1, normal2, low]
+
+
+def test_queue_deadline_scrubbed_on_pop():
+    q = AdmissionQueue(max_depth=4)
+    dead = _job(deadline_s=0.001)
+    live = _job()
+    q.submit(dead)
+    q.submit(live)
+    time.sleep(0.02)
+    assert q.pop(0.1) is live
+    assert dead.status == "failed"
+    assert dead.error["type"] == "DeadlineExceededError"
+
+
+def test_queue_close_refuses_new_but_pops_remaining():
+    q = AdmissionQueue(max_depth=4)
+    j = _job()
+    q.submit(j)
+    q.close()
+    with pytest.raises(QueueClosedError) as ei:
+        q.submit(_job())
+    assert ei.value.retryable
+    assert q.pop(0.1) is j  # drain still serves admitted work
+
+
+# ---------------------------------------------------------------------------
+# Bucketing + coalescing (no device work: jobs are batched, not run)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_selection_and_quantum_fallback():
+    buckets = ((1080, 1920), (2160, 3840))
+    assert bucket_for(1080, 1920, buckets) == (1080, 1920)
+    assert bucket_for(720, 1280, buckets) == (1080, 1920)   # smallest fit
+    assert bucket_for(2000, 3000, buckets) == (2160, 3840)
+    # Off-menu: rounds to pad_quantum multiples, still coalescable.
+    assert bucket_for(2200, 4000, buckets, pad_quantum=64) == (2240, 4032)
+    assert batch_size_for(3, (1, 2, 4, 8)) == 4
+    assert batch_size_for(9, (1, 2, 4, 8)) == 8  # capped at max
+
+
+def test_batcher_coalesces_full_batch_and_pads():
+    q = AdmissionQueue(max_depth=16)
+    b = BucketBatcher(q, buckets=((H, W),), batch_sizes=(1, 2, 4),
+                      linger_s=10.0)  # linger long: only fullness flushes
+    for _ in range(4):
+        q.submit(_job())
+    batch = b.next_batch(timeout=1.0)
+    assert batch is not None
+    assert batch.occupancy == 4
+    assert batch.size == 4
+    arr = batch.stacked()
+    assert arr.shape == (4, PROJ.n_frames, H, W)
+    assert arr.dtype == np.uint8
+
+
+def test_batcher_linger_flushes_partial_and_pads_to_pow2():
+    q = AdmissionQueue(max_depth=16)
+    b = BucketBatcher(q, buckets=((H, W),), batch_sizes=(1, 2, 4),
+                      linger_s=0.01)
+    for _ in range(3):
+        q.submit(_job())
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=2.0)
+    waited = time.monotonic() - t0
+    assert batch.occupancy == 3
+    assert batch.size == 4            # padded up, one zero slot
+    assert waited < 1.0               # flushed on linger, not timeout
+    padded = batch.stacked()
+    assert not padded[3].any()        # pad slot is zeros (decodes invalid)
+
+
+def test_batcher_separates_buckets_and_pads_small_jobs():
+    q = AdmissionQueue(max_depth=16)
+    b = BucketBatcher(q, buckets=((H, W), (H2, W2)),
+                      batch_sizes=(1, 2, 4), linger_s=0.005)
+    q.submit(_job())                                            # bucket 1
+    small = np.ones((PROJ.n_frames, H2 - 4, W2 - 4), np.uint8)  # bucket 2
+    q.submit(_job(stack=small))
+    batches = [b.next_batch(timeout=1.0), b.next_batch(timeout=1.0)]
+    keys = {(bt.key.height, bt.key.width) for bt in batches}
+    assert keys == {(H, W), (H2, W2)}
+    for bt in batches:
+        assert bt.occupancy == 1
+    padded = next(bt for bt in batches
+                  if (bt.key.height, bt.key.width) == (H2, W2)).stacked()
+    assert padded[0, :, :H2 - 4, :W2 - 4].all()   # content in place
+    assert not padded[0, :, H2 - 4:, :].any()     # zero margin
+
+
+def test_batcher_force_flush_ignores_linger():
+    q = AdmissionQueue(max_depth=16)
+    b = BucketBatcher(q, buckets=((H, W),), batch_sizes=(1, 2, 4),
+                      linger_s=30.0)
+    q.submit(_job())
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=5.0, force=True)
+    assert batch is not None and batch.occupancy == 1
+    assert time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Program cache
+# ---------------------------------------------------------------------------
+
+
+def _tiny_key(h, w, proj=None):
+    proj = proj or ProjectorConfig(width=16, height=8)  # 4+3 bits, 16 frames
+    return BucketKey(height=h, width=w, frames=proj.n_frames,
+                     col_bits=proj.col_bits, row_bits=proj.row_bits)
+
+
+def test_program_cache_lru_eviction_and_counters():
+    from structured_light_for_3d_model_replication_tpu.utils import trace
+
+    tiny = ProjectorConfig(width=16, height=8)
+    cache = ProgramCache(synthetic_calib_provider(tiny), max_entries=2,
+                         registry=trace.MetricsRegistry())
+    keys = [ProgramKey(bucket=_tiny_key(8, 8, tiny), batch=1),
+            ProgramKey(bucket=_tiny_key(8, 16, tiny), batch=1),
+            ProgramKey(bucket=_tiny_key(16, 16, tiny), batch=1)]
+    for k in keys:
+        cache.get(k)
+    st = cache.stats()
+    assert st["misses"] == 3 and st["hits"] == 0
+    assert st["evictions"] == 1 and st["size"] == 2
+    assert keys[0].label() not in st["entries"]   # LRU victim
+    cache.get(keys[2])                            # resident → hit
+    cache.get(keys[0])                            # evicted → recompile
+    st = cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 4
+    assert st["compile_seconds_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Integrated service
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_stack():
+    """One rendered capture stack exactly filling the primary bucket."""
+    cam = synthetic.default_calibration(H, W, PROJ)
+    stack, gt = synthetic.render_scan(synthetic.Scene(), *cam, H, W, PROJ)
+    return stack, gt
+
+
+@pytest.fixture(scope="module")
+def service(serve_stack):
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W), (H2, W2)),
+                      batch_sizes=BATCH_SIZES, linger_ms=5.0,
+                      queue_depth=16, workers=1, mesh_depth=6)
+    svc = ReconstructionService(cfg).start()
+    yield svc
+    svc.drain(timeout=10.0)
+
+
+@pytest.fixture(scope="module")
+def http_client(service):
+    http = ServeHTTPServer(service, port=0).start()
+    yield ServeClient(f"http://127.0.0.1:{http.port}")
+    http.stop()
+
+
+def _run_ok(service, stack, **kw):
+    job = service.submit_array(stack, **kw)
+    assert job.wait(30.0), "job did not reach a terminal state"
+    assert job.status == "done", job.status_dict()
+    # Terminal jobs release their input stack (registry holds up to
+    # completed_cap of them; at 1080p a retained stack is ~95 MB).
+    assert job.stack is None
+    assert job.result_bytes is not None
+    return job
+
+
+def test_ply_result_matches_direct_pipeline(service, serve_stack):
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.models import (
+        pipeline,
+    )
+    from structured_light_for_3d_model_replication_tpu.io.ply import (
+        read_ply,
+    )
+
+    stack, _ = serve_stack
+    job = _run_ok(service, stack, result_format="ply")
+    # Service result == the single-shot pipeline on the same stack.
+    calib = service.calib_provider(H, W)
+    direct = pipeline.to_point_cloud(pipeline.reconstruct(
+        jnp.asarray(stack), calib, PROJ.col_bits, PROJ.row_bits))
+    got = read_ply(io.BytesIO(job.result_bytes))
+    assert len(got) == len(direct) == job.result_meta["points"]
+    np.testing.assert_allclose(got.points, direct.points, atol=1e-2)
+
+
+def test_smaller_than_bucket_job_pads_and_serves(service, serve_stack):
+    stack, _ = serve_stack
+    small = stack[:, :H - 4, :W - 8]  # rides the same (H, W) bucket padded
+    job = _run_ok(service, small)
+    assert job.result_meta["points"] > 0
+    assert job.result_meta["coverage"] > 0.1
+
+
+def test_stl_result_is_watertight_mesh(service, serve_stack, tmp_path):
+    from structured_light_for_3d_model_replication_tpu.io.stl import (
+        read_stl,
+    )
+
+    stack, _ = serve_stack
+    job = _run_ok(service, stack, result_format="stl")
+    assert job.result_meta["faces"] > 0
+    out = tmp_path / "serve.stl"
+    out.write_bytes(job.result_bytes)
+    mesh = read_stl(str(out))
+    assert len(mesh.faces) == job.result_meta["faces"]
+    assert np.isfinite(mesh.vertices).all()
+
+
+def test_malformed_stacks_rejected_before_queue(service):
+    f = PROJ.n_frames
+    for bad in (np.zeros((f, H, W), np.float32),        # dtype
+                np.zeros((f - 2, H, W), np.uint8),      # frame count
+                np.zeros((f, H, W, 3), np.uint8),       # rank
+                np.zeros((f, H2 + 64, W2 + 64), np.uint8)):  # oversize
+        with pytest.raises(StackFormatError):
+            service.submit_array(bad)
+    with pytest.raises(StackFormatError):
+        service.submit_array(np.zeros((f, H, W), np.uint8),
+                             result_format="obj")
+    with pytest.raises(StackFormatError):
+        service.submit_array(np.zeros((f, H, W), np.uint8),
+                             priority="urgent")
+    assert service.queue.depth() == 0
+
+
+def test_poisoned_stack_fails_only_its_job(service, serve_stack):
+    """The batch-containment acceptance bar: a garbage stack in the same
+    batch as healthy jobs degrades ITS job with a health-taxonomy error;
+    batchmates complete and the service keeps serving."""
+    stack, _ = serve_stack
+    good = [service.submit_array(stack) for _ in range(2)]
+    bad = service.submit_array(np.zeros_like(stack))  # all-black exposure
+    for j in good:
+        assert j.wait(30.0) and j.status == "done", j.status_dict()
+    assert bad.wait(30.0)
+    assert bad.status == "failed"
+    err = bad.status_dict()["error"]
+    assert err["type"] == "StopQualityError"
+    assert "StopQualityError" in err["taxonomy"]
+    assert "ScanFault" in err["taxonomy"]           # PR-3 vocabulary
+    # Process healthy: the next job is served normally.
+    _run_ok(service, stack)
+
+
+def test_zero_steady_state_recompiles_mixed_load(service, serve_stack):
+    """After warmup, a mixed-shape 50-job load is 100% cache hits — by the
+    cache's own counters AND by the jit caches (which the AOT executables
+    bypass entirely; any growth means a request slipped onto the implicit
+    compile path)."""
+    from structured_light_for_3d_model_replication_tpu.models import (
+        pipeline,
+    )
+
+    stack, _ = serve_stack
+    shapes = [stack,                                  # exact bucket 1
+              stack[:, :H - 2, :W - 2],               # padded into bucket 1
+              np.broadcast_to(stack[:, :1, :1],       # constant; bucket 2
+                              (PROJ.n_frames, H2, W2)).copy()]
+    # The constant stack decodes to ~0 coverage → fails its jobs; that is
+    # fine here — failed-by-gate jobs still exercise the program path.
+    batch_fn = pipeline.reconstruct_batch_fn(PROJ.col_bits, PROJ.row_bits)
+    before = service.cache.stats()
+    jit_before = (pipeline.reconstruct._cache_size(),
+                  batch_fn._cache_size())
+
+    def counts():
+        return {s: service.registry.counter("serve_jobs_total",
+                                            status=s).value
+                for s in ("submitted", "done", "failed")}
+
+    c_before = counts()
+
+    jobs = []
+    for i in range(50):
+        while True:
+            try:
+                jobs.append(service.submit_array(shapes[i % 3]))
+                break
+            except QueueFullError as e:  # honest backpressure: wait it out
+                time.sleep(min(0.05, e.retry_after_s))
+    for j in jobs:
+        assert j.wait(60.0), j.status_dict()
+
+    after = service.cache.stats()
+    jit_after = (pipeline.reconstruct._cache_size(),
+                 batch_fn._cache_size())
+    assert after["misses"] == before["misses"], (before, after)
+    assert after["hits"] - before["hits"] > 0
+    assert jit_after == jit_before, "a request compiled via jit"
+    assert after["evictions"] == before["evictions"]
+    # Counter conservation: every admitted job ended exactly one of
+    # done/failed (the constant-stack third fails its coverage gate).
+    d = {s: counts()[s] - c_before[s] for s in c_before}
+    assert d["submitted"] == 50
+    assert d["done"] + d["failed"] == 50, d
+    assert d["failed"] >= 16  # the constant-stack jobs
+
+
+def test_batching_engages_and_beats_sequential(serve_stack):
+    """Acceptance: >= 8 same-bucket jobs coalesce to mean occupancy >= 4
+    and beat sequential single-shot submission per scan."""
+    stack, _ = serve_stack
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W),),
+                      batch_sizes=BATCH_SIZES, linger_ms=20.0,
+                      queue_depth=32, workers=1)
+    svc = ReconstructionService(cfg)
+    svc.cache.warmup([svc._bucket_key(H, W)], BATCH_SIZES)
+
+    # Enqueue 16 jobs BEFORE starting the worker: deterministic full
+    # coalescing (the concurrency-16 arrival pattern without sleep races).
+    jobs = [svc.submit_array(stack + np.uint8(i)) for i in range(16)]
+    t0 = time.monotonic()
+    for w in svc.workers:
+        w.start()
+    for j in jobs:
+        assert j.wait(30.0) and j.status == "done", j.status_dict()
+    batched_per_scan = (time.monotonic() - t0) / len(jobs)
+
+    occ = svc.registry.histogram("serve_batch_occupancy").snapshot()
+    assert occ["count"] == 4                 # 16 jobs / B=4 programs
+    assert occ["mean"] >= 4.0, occ
+
+    # Sequential single-shot: one in flight at a time pays per-launch
+    # overhead + linger with no company to share it.
+    t0 = time.monotonic()
+    for i in range(4):
+        j = svc.submit_array(stack + np.uint8(100 + i))
+        assert j.wait(30.0) and j.status == "done"
+    sequential_per_scan = (time.monotonic() - t0) / 4
+
+    assert batched_per_scan < sequential_per_scan, (
+        f"batched {batched_per_scan * 1e3:.1f} ms/scan vs sequential "
+        f"{sequential_per_scan * 1e3:.1f} ms/scan")
+    svc.drain(timeout=10.0)
+
+
+def test_graceful_drain_finishes_inflight_refuses_new(serve_stack):
+    stack, _ = serve_stack
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1, 2, 4),
+                      linger_ms=5.0, queue_depth=16, workers=1,
+                      warmup=False)  # first batch compiles lazily
+    svc = ReconstructionService(cfg).start()
+    jobs = [svc.submit_array(stack) for _ in range(6)]
+    assert svc.drain(timeout=60.0), "workers did not exit"
+    for j in jobs:                       # everything admitted finished
+        assert j.status == "done", j.status_dict()
+    with pytest.raises(QueueClosedError):
+        svc.submit_array(stack)
+    assert all(not w.alive for w in svc.workers)
+    assert svc.stats()["draining"]
+
+
+def test_rejected_submit_leaves_no_registry_entry(serve_stack):
+    """A refused job must leave NO trace: a pre-registered zombie would
+    sit QUEUED forever, pinning its stack — unbounded growth under the
+    exact overload the bounded queue exists to survive."""
+    stack, _ = serve_stack
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1,),
+                      queue_depth=1, workers=1, warmup=False)
+    svc = ReconstructionService(cfg)          # workers never started
+    admitted = svc.submit_array(stack)
+    with pytest.raises(QueueFullError):
+        svc.submit_array(stack)
+    assert svc.get_job(admitted.job_id) is admitted
+    assert len(svc._jobs) == 1                # no zombie from the reject
+
+
+def test_registry_bounded_by_result_bytes(serve_stack):
+    """The count cap alone doesn't bound memory (a 1080p PLY is ~30 MB):
+    past the byte budget the oldest result PAYLOADS are dropped — but the
+    job entries survive, so a late client gets an explicit eviction
+    notice (HTTP 410), never a silent unknown-job 404."""
+    stack, _ = serve_stack
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1,),
+                      linger_ms=1.0, queue_depth=8, workers=1,
+                      warmup=False, completed_cap=100,
+                      result_cache_bytes=1)  # any result busts the budget
+    svc = ReconstructionService(cfg).start()
+    old = [_run_ok(svc, stack) for _ in range(3)]
+    newest = _run_ok(svc, stack)  # its _register evicts the old payloads
+    assert svc.get_job(newest.job_id) is newest
+    for j in old:
+        survivor = svc.get_job(j.job_id)
+        assert survivor is j                    # entry kept, not 404
+        assert survivor.result_bytes is None    # payload dropped
+        assert survivor.result_meta["result_evicted"] is True
+        assert survivor.status == "done"        # /status stays truthful
+    svc.drain(timeout=10.0)
+
+
+def test_deadline_expires_in_queue(serve_stack):
+    stack, _ = serve_stack
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1,),
+                      linger_ms=1.0, queue_depth=8, workers=1,
+                      warmup=False)
+    svc = ReconstructionService(cfg)        # workers NOT started
+    job = svc.submit_array(stack, deadline_s=0.01)
+    time.sleep(0.05)
+    for w in svc.workers:
+        w.start()
+    assert job.wait(10.0)
+    assert job.status == "failed"
+    assert job.error["type"] == "DeadlineExceededError"
+    svc.drain(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def test_http_submit_status_result_roundtrip(http_client, serve_stack):
+    stack, _ = serve_stack
+    data, st = http_client.run(stack, result_format="ply")
+    assert st["status"] == "done"
+    assert st["result"]["points"] > 0
+    assert data.startswith(b"ply")
+    assert "run_s" in st and "queue_wait_s" in st
+
+
+def test_http_unknown_job_404_and_failed_job_409(http_client, serve_stack):
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClientError,
+    )
+
+    with pytest.raises(ServeClientError):
+        http_client.status("nope")
+    stack, _ = serve_stack
+    job_id = http_client.submit(np.zeros_like(stack))   # poisoned
+    st = http_client.wait(job_id)
+    assert st["status"] == "failed"
+    assert "StopQualityError" in st["error"]["taxonomy"]
+    with pytest.raises(ServeClientError):               # 409, not bytes
+        http_client.result(job_id)
+
+
+def test_http_rejects_malformed_body(http_client):
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClientError,
+    )
+
+    import urllib.request
+
+    req = urllib.request.Request(
+        http_client.base_url + "/submit", data=b"not an npy",
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST")
+    status, _, body = http_client._request(req)
+    assert status == 400
+    with pytest.raises(ServeClientError):
+        http_client.submit(np.zeros((3, H, W), np.uint8))  # frame count
+
+
+def test_http_backpressure_429_with_retry_after(serve_stack):
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        BackpressureError,
+    )
+
+    stack, _ = serve_stack
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1,),
+                      queue_depth=2, workers=1, warmup=False)
+    svc = ReconstructionService(cfg)         # workers never started
+    http = ServeHTTPServer(svc, port=0).start()
+    client = ServeClient(f"http://127.0.0.1:{http.port}")
+    try:
+        client.submit(stack)
+        client.submit(stack)
+        with pytest.raises(BackpressureError) as ei:
+            client.submit(stack)
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        health = client.healthz()
+        assert health["ok"] is False          # no workers alive
+        assert health["queue_depth"] == 2
+    finally:
+        http.stop()
+
+
+def test_http_metrics_and_healthz(http_client, service):
+    health = http_client.healthz()
+    assert health["ok"] is True
+    assert health["workers_alive"] >= 1
+    text = http_client.metrics()
+    for needle in ("serve_queue_depth",
+                   "serve_batch_occupancy_bucket",
+                   "serve_program_cache_hits_total",
+                   'serve_jobs_total{status="done"}',
+                   "sl_span_seconds_total"):     # per-stage latencies
+        assert needle in text, f"missing {needle} in /metrics"
+    assert service.stats()["cache"]["hits"] > 0
+
+
+def test_cli_bucket_spec_parsing():
+    from structured_light_for_3d_model_replication_tpu.cli.serve import (
+        _parse_buckets,
+        build_parser,
+    )
+
+    assert _parse_buckets("1080x1920") == ((1080, 1920),)
+    assert _parse_buckets("1080x1920, 2160x3840") == ((1080, 1920),
+                                                      (2160, 3840))
+    with pytest.raises(ValueError):
+        _parse_buckets("garbage")
+    # CLI defaults track ServeConfig (one tuning surface, no drift).
+    args = build_parser().parse_args([])
+    dflt = ServeConfig()
+    assert args.queue_depth == dflt.queue_depth
+    assert args.mesh_depth == dflt.mesh_depth
+    assert _parse_buckets(args.buckets) == dflt.buckets
+
+
+def test_cli_calib_with_multiple_buckets_refused():
+    from structured_light_for_3d_model_replication_tpu.cli.serve import (
+        main,
+    )
+
+    # A .mat calibration fixes one camera geometry; pairing it with two
+    # buckets must be refused at argument time, not die mid-warmup.
+    assert main(["--calib", "rig.mat",
+                 "--buckets", "24x40,32x48"]) == 2
+
+
+def test_client_refuses_non_uint8_stack(http_client):
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClientError,
+    )
+
+    with pytest.raises(ServeClientError, match="uint8"):
+        http_client.submit(np.zeros((PROJ.n_frames, H, W), np.float32))

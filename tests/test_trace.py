@@ -64,6 +64,114 @@ def test_wrap_decorator_and_reset():
     assert tr.totals() == {}
 
 
+# ---------------------------------------------------------------------------
+# Metrics: counters/gauges/histograms + Prometheus exporter
+# ---------------------------------------------------------------------------
+
+
+def test_counter_monotonic_and_gauge():
+    reg = trace.MetricsRegistry()
+    c = reg.counter("jobs_total", "jobs", status="done")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("depth")
+    g.set(5)
+    g.dec()
+    assert g.value == 4
+    # Same (name, labels) returns the same instrument; same name as a
+    # different kind is an error.
+    assert reg.counter("jobs_total", status="done") is c
+    with pytest.raises(ValueError):
+        reg.gauge("jobs_total")
+    # Same histogram with a DIFFERENT bucket layout is an error too —
+    # silently reusing the first layout would mis-bin observations.
+    h = reg.histogram("lat", buckets=(1, 2))
+    assert reg.histogram("lat", buckets=(2, 1)) is h  # order-insensitive
+    with pytest.raises(ValueError):
+        reg.histogram("lat", buckets=(0.1, 1))
+
+
+def test_histogram_prometheus_semantics():
+    reg = trace.MetricsRegistry()
+    h = reg.histogram("occupancy", buckets=(1, 2, 4, 8))
+    for v in (1, 1, 3, 8, 9):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["buckets"] == {"1": 2, "2": 2, "4": 3, "8": 4, "+Inf": 5}
+    assert snap["count"] == 5
+    assert snap["sum"] == 22
+    assert snap["mean"] == pytest.approx(4.4)
+
+
+def test_counters_thread_safe():
+    reg = trace.MetricsRegistry()
+    c = reg.counter("n")
+    h = reg.histogram("h", buckets=(10,))
+
+    def hammer():
+        for _ in range(1000):
+            c.inc()
+            h.observe(1)
+
+    ts = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == 8000
+    assert h.snapshot()["count"] == 8000
+
+
+def test_prometheus_text_format():
+    reg = trace.MetricsRegistry()
+    reg.counter("serve_jobs_total", "jobs by status", status="done").inc(3)
+    reg.counter("serve_jobs_total", status="failed").inc()
+    reg.gauge("serve_queue_depth", "queue depth").set(2)
+    reg.histogram("serve_batch_occupancy", buckets=(1, 2)).observe(2)
+    text = reg.prometheus_text()
+    assert "# TYPE serve_jobs_total counter" in text
+    assert 'serve_jobs_total{status="done"} 3' in text
+    assert 'serve_jobs_total{status="failed"} 1' in text
+    assert "# HELP serve_jobs_total jobs by status" in text
+    assert "serve_queue_depth 2" in text
+    assert 'serve_batch_occupancy_bucket{le="2"} 1' in text
+    assert 'serve_batch_occupancy_bucket{le="+Inf"} 1' in text
+    assert "serve_batch_occupancy_count 1" in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_text_includes_tracer_spans():
+    """The scan360 stage spans (any Tracer's spans) ride the same scrape."""
+    reg = trace.MetricsRegistry()
+    tr = trace.Tracer()
+    with tr.span("scan360.register"):
+        time.sleep(0.002)
+    text = reg.prometheus_text(tracer=tr)
+    assert 'sl_span_seconds_total{span="scan360.register"}' in text
+    assert 'sl_span_count{span="scan360.register"} 1' in text
+    assert 'sl_span_max_seconds{span="scan360.register"}' in text
+
+
+def test_label_escaping():
+    reg = trace.MetricsRegistry()
+    reg.counter("errors_total", kind='Bad"Quote\nNewline').inc()
+    text = reg.prometheus_text()
+    assert 'kind="Bad\\"Quote\\nNewline"' in text
+
+
+def test_registry_snapshot_json_friendly():
+    reg = trace.MetricsRegistry()
+    reg.counter("c", status="x").inc(2)
+    reg.histogram("h", buckets=(1,)).observe(1)
+    snap = reg.snapshot()
+    assert snap["c"]['{status="x"}'] == 2
+    assert snap["h"]["_"]["count"] == 1
+    json.dumps(snap)  # must serialize
+
+
 @pytest.mark.slow
 def test_scan360_emits_spans(synth_rig, synth_scan):
     import jax.numpy as jnp

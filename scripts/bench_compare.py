@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Diff a fresh bench run against the BENCH_r*.json trajectory.
+
+The driver archives each round's bench output as ``BENCH_r<NN>.json``
+(``{"n": round, "tail": <last stdout>, ...}``); the headline metric rides
+the tail as single-line JSON objects (``{"metric": ..., "value": ...}``,
+`bench.py`). This script rebuilds the per-metric trajectory from those
+archives and compares a fresh run against it, flagging regressions —
+the "did this PR slow the north star down" answer as a command instead
+of archaeology.
+
+The fresh run can be any of:
+
+* a bench stdout log (or a single headline line) — headline JSON lines
+  are extracted exactly like the history tails;
+* a ``BENCH_DETAILS.json`` — per-config ``value_s``/``value_ms`` leaves
+  are lifted, with the known config → headline-metric aliases applied.
+
+Exit code is 0 (informational) unless ``--strict``, where any
+regression beyond the threshold fails the run.
+
+Usage::
+
+    python scripts/bench_compare.py --fresh BENCH_DETAILS.json
+    python bench.py | tee fresh.log; python scripts/bench_compare.py \
+        --fresh fresh.log --strict
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# BENCH_DETAILS config name → headline metric name, where they differ.
+_DETAILS_ALIASES = {
+    "full_360_scan_to_mesh": "full_360_scan_to_mesh_s",
+    "full_360_24x46_1080p": "full_360_scan_24x46_1080p_s",
+}
+
+
+def _headline_metrics(text: str) -> dict[str, float]:
+    """Every ``{"metric": ..., "value": ...}`` JSON line in ``text``;
+    later lines win per metric (bench prints the crash-hedge scan→cloud
+    headline first, the promoted scan→mesh one later)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        metric, value = obj.get("metric"), obj.get("value")
+        if isinstance(metric, str) and isinstance(value, (int, float)):
+            out[metric] = float(value)
+    return out
+
+
+def load_history(paths: list[str]) -> dict[str, list[tuple[int, float]]]:
+    """{metric: [(round, value), ...]} sorted by round."""
+    traj: dict[str, list[tuple[int, float]]] = {}
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"warn: skipping {path}: {e}", file=sys.stderr)
+            continue
+        n = int(doc.get("n", -1))
+        for metric, value in _headline_metrics(doc.get("tail", "")).items():
+            traj.setdefault(metric, []).append((n, value))
+    for rounds in traj.values():
+        rounds.sort()
+    return traj
+
+
+def load_fresh(path: str) -> dict[str, float]:
+    """Fresh-run metrics from a headline log OR a BENCH_DETAILS.json."""
+    with open(path) as f:
+        text = f.read()
+    metrics = _headline_metrics(text)
+    if metrics:
+        return metrics
+    try:
+        details = json.loads(text)
+    except json.JSONDecodeError:
+        raise SystemExit(
+            f"{path}: neither headline JSON lines nor a JSON document")
+    if not isinstance(details, dict):
+        raise SystemExit(f"{path}: unrecognized bench document")
+    for config, row in details.items():
+        if not isinstance(row, dict):
+            continue
+        name = _DETAILS_ALIASES.get(config, config)
+        if isinstance(row.get("value_s"), (int, float)):
+            metrics[name if name.endswith("_s") else name + "_s"] = \
+                float(row["value_s"])
+        elif isinstance(row.get("value_ms"), (int, float)):
+            metrics[name + "_ms"] = float(row["value_ms"])
+    if not metrics:
+        raise SystemExit(f"{path}: no value_s/value_ms leaves found")
+    return metrics
+
+
+def compare(fresh: dict[str, float],
+            traj: dict[str, list[tuple[int, float]]],
+            threshold: float) -> list[dict]:
+    """One row per fresh metric: verdict vs the last round and the best
+    round. Lower is better (every headline is seconds/milliseconds)."""
+    rows = []
+    for metric in sorted(fresh):
+        value = fresh[metric]
+        history = traj.get(metric, [])
+        row: dict = {"metric": metric, "fresh": value,
+                     "rounds": len(history)}
+        if history:
+            last_n, last_v = history[-1]
+            best_n, best_v = min(history, key=lambda nv: nv[1])
+            row.update(last=last_v, last_round=last_n,
+                       best=best_v, best_round=best_n,
+                       vs_last=round(value / last_v, 3) if last_v else None)
+            if last_v and value > last_v * (1 + threshold):
+                row["verdict"] = "REGRESSION"
+            elif last_v and value < last_v * (1 - threshold):
+                row["verdict"] = "improved"
+            else:
+                row["verdict"] = "flat"
+        else:
+            row["verdict"] = "no-history"
+        rows.append(row)
+    return rows
+
+
+def render(rows: list[dict]) -> str:
+    if not rows:
+        return "(no comparable metrics)"
+    w = max(len(r["metric"]) for r in rows)
+    lines = [f"{'metric':<{w}}  {'fresh':>10}  {'last':>10}  "
+             f"{'best':>10}  {'x last':>7}  verdict"]
+    for r in rows:
+        last = f"{r['last']:.3f}" if "last" in r else "-"
+        best = f"{r['best']:.3f}" if "best" in r else "-"
+        ratio = f"{r['vs_last']:.3f}" if r.get("vs_last") else "-"
+        lines.append(f"{r['metric']:<{w}}  {r['fresh']:>10.3f}  "
+                     f"{last:>10}  {best:>10}  {ratio:>7}  {r['verdict']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--fresh", required=True,
+                   help="fresh bench output: stdout log with headline "
+                        "lines, or a BENCH_DETAILS.json")
+    p.add_argument("--history", default=None,
+                   help="history glob (default <root>/BENCH_r*.json)")
+    p.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root for the default history glob")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="relative slowdown vs the last round that flags "
+                        "a regression (default 0.10)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 when any metric regressed")
+    p.add_argument("--json", action="store_true",
+                   help="emit the comparison as one JSON line instead "
+                        "of a table")
+    args = p.parse_args(argv)
+
+    pattern = args.history or os.path.join(args.root, "BENCH_r*.json")
+    history_paths = sorted(glob.glob(pattern))
+    traj = load_history(history_paths)
+    fresh = load_fresh(args.fresh)
+    rows = compare(fresh, traj, args.threshold)
+
+    regressions = [r for r in rows if r["verdict"] == "REGRESSION"]
+    if args.json:
+        print(json.dumps({"rows": rows,
+                          "history_files": len(history_paths),
+                          "regressions": len(regressions)}))
+    else:
+        print(f"history: {len(history_paths)} rounds "
+              f"({pattern.replace(os.path.expanduser('~'), '~')})")
+        print(render(rows))
+        if regressions:
+            print(f"\n{len(regressions)} regression(s) beyond "
+                  f"{args.threshold:.0%} vs the last round")
+    return 1 if (args.strict and regressions) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,6 +20,9 @@ host:
 ``serve``          continuous-batching reconstruction service: HTTP
                    submit/status/result over the batched pipeline
                    (docs/SERVING.md)
+``diagnose``       support bundle: health + metrics + flight journal +
+                   Perfetto spans + env manifest in one tarball
+                   (docs/OBSERVABILITY.md)
 ================  ===========================================================
 
 Invoke via ``python -m structured_light_for_3d_model_replication_tpu.cli <tool> [args]``.
@@ -30,6 +33,7 @@ from __future__ import annotations
 import sys
 
 _TOOLS = {
+    "diagnose": "diagnose",
     "process-cloud": "process_cloud",
     "read-calib": "read_calib",
     "merge-360": "merge_360",

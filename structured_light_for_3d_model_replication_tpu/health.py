@@ -34,6 +34,7 @@ import math
 
 import numpy as np
 
+from .utils import events
 from .utils.log import get_logger
 
 log = get_logger(__name__)
@@ -50,7 +51,28 @@ class ScanFault(RuntimeError):
     Layers raise the specific subclass; orchestration catches ``ScanFault``
     to contain a failure (retry, skip, degrade) without masking genuine
     programming errors, which stay ordinary exceptions.
+
+    Construction records a ``fault``-severity event in the flight
+    recorder (`utils.events`), tagged with whatever correlation context
+    (scan_id/job_id/stop) is ambient at the raise site — so every
+    taxonomy failure ships the journal of events that led to it, and a
+    configured dump directory gets the last-N events as JSONL. The hook
+    is best-effort by design: observability must never turn a contained
+    fault into a crash.
+
+    ``flight_severity`` is the journal severity of that event; designed
+    flow-control subclasses (serve's backpressure rejections) override
+    it to "warning" so only genuine faults trigger dump-on-fault.
     """
+
+    flight_severity = "fault"
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        try:
+            events.fault(self)
+        except Exception as e:  # pragma: no cover — never mask the fault
+            log.debug("flight recorder unavailable at raise site: %s", e)
 
 
 class CaptureError(ScanFault):
@@ -167,6 +189,9 @@ class ScanHealthReport:
     edges: list[EdgeHealth] = dataclasses.field(default_factory=list)
     notes: list[str] = dataclasses.field(default_factory=list)
     rotate_timeouts: int = 0
+    # Correlation ID linking this report to flight-recorder events and
+    # tracer spans of the same run (set by `scanner.auto_scan_360`).
+    scan_id: str | None = None
 
     # -- accumulation -------------------------------------------------------
 
@@ -212,6 +237,7 @@ class ScanHealthReport:
 
     def to_dict(self) -> dict:
         return {
+            **({"scan_id": self.scan_id} if self.scan_id else {}),
             "stops": [self.stops[i].to_dict()
                       for i in sorted(self.stops)],
             "edges": [e.to_dict() for e in self.edges],
@@ -385,6 +411,13 @@ def gate_edges(
             log.warning(
                 "edge %d→%d rejected (fitness=%.3f rmse=%.4f) — %s",
                 src, dst, fit[i], rmse[i], e.action)
+            events.record("edge_rejected", severity="warning",
+                          message=f"edge {src}->{dst} {e.action}",
+                          scan_id=(report.scan_id if report is not None
+                                   else None),
+                          src=src, dst=dst, gap=gap,
+                          fitness=round(float(fit[i]), 4),
+                          rmse=round(float(rmse[i]), 4), action=e.action)
         health.append(e)
     if report is not None:
         report.edges.extend(health)

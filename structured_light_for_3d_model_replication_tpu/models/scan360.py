@@ -36,7 +36,7 @@ from .. import health as health_mod
 from ..io import ply as ply_io
 from ..ops import pointcloud, posegraph, registration
 from ..ops.triangulate import Calibration
-from ..utils import trace
+from ..utils import events, trace
 from ..utils.log import get_logger
 from . import merge as merge_mod
 from . import pipeline as pipeline_mod
@@ -603,6 +603,11 @@ def _gated_tail(res, params: Scan360Params, key, with_stats: bool,
     dropped = [i for i in range(n) if not keep[i]]
     for i in dropped:
         health.stop(labels[i]).status = "dropped"
+        events.record("stop_dropped", severity="warning",
+                      message="decode coverage below gate",
+                      scan_id=health.scan_id, stop=labels[i],
+                      coverage=round(float(coverage[i]), 4),
+                      min_coverage=gates.min_coverage)
     if dropped:
         health.note("coverage gate dropped stops %s (coverage %s < %.3f)",
                     [labels[i] for i in dropped],

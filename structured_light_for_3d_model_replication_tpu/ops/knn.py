@@ -222,10 +222,30 @@ def _knn_padded(
         )
         return best_d, best_i
 
+    def refined_tile(args):
+        # The blocked |q|²+|p|²−2q·p expansion loses ~|q|·|p|·eps to fp32
+        # cancellation — a true-zero self-distance comes back as ~1e-2 at
+        # coordinate scale 10 (the seed test_knn_matches_kdtree failure).
+        # Selection over full tiles must keep the matmul form, but the k
+        # SELECTED distances are O(Tq·k·D): recompute them by direct
+        # difference (exact to fp32 rounding) and re-sort, so callers see
+        # KDTree-grade distances at negligible cost.
+        q, _ = args
+        best_d, best_i = per_query_tile(args)
+        diff = q[:, None, :] - points[best_i]          # (Tq, k, D)
+        exact = jnp.sum(diff * diff, axis=-1)
+        keep = jnp.isfinite(best_d)                    # inf = no neighbor
+        best_d = jnp.where(keep, exact, best_d)
+        if best_d.shape[1] > 1:
+            order = jnp.argsort(best_d, axis=1, stable=True)
+            best_d = jnp.take_along_axis(best_d, order, axis=1)
+            best_i = jnp.take_along_axis(best_i, order, axis=1)
+        return best_d, best_i
+
     q_tiles = queries.reshape(M // q_tile, q_tile, dim)
     qv_tiles = q_valid.reshape(M // q_tile, q_tile)
     # lax.map over query tiles: one (Tq, Tk) block resident at a time.
-    best_d, best_i = jax.lax.map(per_query_tile, (q_tiles, qv_tiles))
+    best_d, best_i = jax.lax.map(refined_tile, (q_tiles, qv_tiles))
     best_d = best_d.reshape(M, -1)
     best_i = best_i.reshape(M, -1)
     # Squared distances can go epsilon-negative in fp32; clamp for sqrt users.

@@ -42,6 +42,7 @@ import dataclasses
 import glob
 import os
 import time
+import uuid
 from typing import Callable
 
 import numpy as np
@@ -50,6 +51,7 @@ from .config import ProjectorConfig
 from .health import CaptureError, ScanHealthReport
 from .io.layout import SessionLayout, frame_name
 from .ops.patterns import pattern_stack_for
+from .utils import events
 from .utils.log import get_logger
 
 log = get_logger(__name__)
@@ -194,6 +196,11 @@ class Scanner:
                 return
             log.warning("capture attempt %d/%d failed (%s)", attempt + 1,
                         self.retry.frame_attempts, path)
+            events.record(
+                "capture_retry", severity="warning",
+                message=f"attempt {attempt + 1}/"
+                        f"{self.retry.frame_attempts} failed",
+                frame=os.path.basename(path), attempt=attempt)
             if stop_health is not None:
                 stop_health.faults.append(
                     f"{os.path.basename(path)}:attempt{attempt}")
@@ -284,6 +291,7 @@ class Scanner:
         resume: bool = True,
         on_progress: Callable[[ScanProgress], None] | None = None,
         health: ScanHealthReport | None = None,
+        scan_id: str | None = None,
     ) -> list[str]:
         """The flagship capture loop (`server/gui.py:686-773`). Returns the
         list of per-stop folders (``{base}_{angle}deg_scan``) that hold a
@@ -304,6 +312,8 @@ class Scanner:
         simulated table boots at 0°).
         """
         health = health if health is not None else ScanHealthReport()
+        scan_id = scan_id or uuid.uuid4().hex[:12]
+        health.scan_id = scan_id
         done_before = set(
             self.layout.completed_stops(base_name, degrees_per_turn,
                                         self.proj.n_frames)
@@ -311,41 +321,55 @@ class Scanner:
         t0 = time.monotonic()
         stops = []
         captured = 0
+        events.record("scan_started", scan_id=scan_id, base=base_name,
+                      turns=turns, step_deg=degrees_per_turn)
         for i in range(turns):
             angle = i * degrees_per_turn
             out = self.layout.stop_dir(base_name, degrees_per_turn, angle)
             rec = health.stop(i, angle_deg=angle)
-            if out in done_before:
-                log.info("stop %d/%d (%.0f°) already complete — resumed past",
-                         i + 1, turns, angle)
-                rec.status = "resumed"
-                stops.append(out)
-            elif self._capture_stop(out, dwell_ms, rec):
-                captured += 1
-                stops.append(out)
-            else:
-                log.error("stop %d/%d (%.0f°) failed after %d stop "
-                          "attempts — skipping (degraded ring)", i + 1,
-                          turns, angle, self.retry.stop_attempts)
+            # Correlation context: every event (and ScanFault) out of this
+            # stop's capture — frame retries, exhausted stops — carries
+            # the scan_id + stop index into the flight journal.
+            with events.context(scan_id=scan_id, stop=i):
+                if out in done_before:
+                    log.info("stop %d/%d (%.0f°) already complete — "
+                             "resumed past", i + 1, turns, angle)
+                    rec.status = "resumed"
+                    stops.append(out)
+                elif self._capture_stop(out, dwell_ms, rec):
+                    captured += 1
+                    stops.append(out)
+                else:
+                    log.error("stop %d/%d (%.0f°) failed after %d stop "
+                              "attempts — skipping (degraded ring)", i + 1,
+                              turns, angle, self.retry.stop_attempts)
+                    events.record(
+                        "stop_failed", severity="error",
+                        message=f"stop {i} exhausted "
+                                f"{self.retry.stop_attempts} attempts",
+                        angle_deg=angle)
 
-            if on_progress is not None:
-                elapsed = time.monotonic() - t0
-                avg = elapsed / max(captured, 1)
-                remaining = avg * sum(
-                    1 for j in range(i + 1, turns)
-                    if self.layout.stop_dir(base_name, degrees_per_turn,
-                                            j * degrees_per_turn)
-                    not in done_before)
-                on_progress(ScanProgress(i + 1, turns, elapsed, avg,
-                                         remaining))
+                if on_progress is not None:
+                    elapsed = time.monotonic() - t0
+                    avg = elapsed / max(captured, 1)
+                    remaining = avg * sum(
+                        1 for j in range(i + 1, turns)
+                        if self.layout.stop_dir(base_name, degrees_per_turn,
+                                                j * degrees_per_turn)
+                        not in done_before)
+                    on_progress(ScanProgress(i + 1, turns, elapsed, avg,
+                                             remaining))
 
-            if i < turns - 1 and self.turntable is not None:
-                self.turntable.rotate(degrees_per_turn)
-                if not self.turntable.wait_for_done(
-                        self.timings.rotate_timeout_s):
-                    log.warning("rotation %d DONE timeout — continuing", i)
-                    health.rotate_timeouts += 1
-                self._sleep(self.timings.settle_s)
+                if i < turns - 1 and self.turntable is not None:
+                    self.turntable.rotate(degrees_per_turn)
+                    if not self.turntable.wait_for_done(
+                            self.timings.rotate_timeout_s):
+                        log.warning("rotation %d DONE timeout — continuing",
+                                    i)
+                        events.record("rotate_timeout", severity="warning",
+                                      angle_deg=angle)
+                        health.rotate_timeouts += 1
+                    self._sleep(self.timings.settle_s)
         if not stops:
             raise ScanAborted(
                 f"auto 360 failed: all {turns} stops exhausted their "
@@ -357,4 +381,8 @@ class Scanner:
                  "%d failed) in %.1fs", len(stops), turns, captured,
                  len(done_before & set(stops)), len(health.failed_stops),
                  time.monotonic() - t0)
+        events.record("scan_finished", scan_id=scan_id,
+                      stops_ok=len(stops),
+                      stops_failed=len(health.failed_stops),
+                      elapsed_s=round(time.monotonic() - t0, 3))
         return stops

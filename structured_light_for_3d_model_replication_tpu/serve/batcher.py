@@ -35,6 +35,7 @@ import time
 import numpy as np
 
 from ..config import DecodeConfig, TriangulationConfig
+from ..utils import events
 from ..utils.log import get_logger
 from .jobs import AdmissionQueue, DeadlineExceededError, Job
 
@@ -189,8 +190,12 @@ class BucketBatcher:
         jobs = [j for _, j in take if not j.expired()]
         for _, j in take:
             if j not in jobs:
-                j.fail(DeadlineExceededError(
-                    "deadline lapsed while batching"))
+                # Context so the fault event the constructor records
+                # carries the scrubbed job's id (same rule as the
+                # queue-side scrub in jobs.pop).
+                with events.context(job_id=j.job_id):
+                    j.fail(DeadlineExceededError(
+                        "deadline lapsed while batching"))
         if not jobs:
             return None
         return Batch(key=key, jobs=jobs,

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..config import DecodeConfig, TriangulationConfig
 from ..health import CaptureError, ScanFault
+from ..utils import events
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -45,9 +46,14 @@ class JobRejected(ServeError):
     """The job never entered the queue (full, closed, or malformed).
 
     ``retryable`` distinguishes "try again later" (backpressure) from
-    "fix your request" (malformed stack)."""
+    "fix your request" (malformed stack). Rejections are designed flow
+    control, not failures: they journal as warnings (``flight_severity``)
+    so an overload burst — hundreds of constructions per second — never
+    wraps the flight ring past genuine fault history or storms the
+    dump-on-fault directory."""
 
     retryable = False
+    flight_severity = "warning"
 
 
 class QueueFullError(JobRejected):
@@ -295,26 +301,44 @@ class AdmissionQueue:
         """Next admissible job, or None on timeout. Jobs whose deadline
         lapsed while queued are failed (DeadlineExceededError) and skipped
         — a worker never spends a batch slot on work nobody is waiting
-        for."""
+        for. The fail itself runs OUTSIDE the queue lock: constructing
+        the fault records a flight event and may write a dump-on-fault
+        journal, and that disk I/O must never stall every submitter and
+        worker contending for this lock."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._not_empty:
-            while True:
-                while self._heap:
-                    _, _, job = heapq.heappop(self._heap)
-                    if job.expired():
-                        job.fail(DeadlineExceededError(
-                            f"deadline {job.deadline_s:.2f}s lapsed after "
-                            f"{time.monotonic() - job.submitted_t:.2f}s "
-                            "in queue"))
-                        continue
-                    return job
-                if deadline is None:
-                    self._not_empty.wait()
-                else:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return None
-                    self._not_empty.wait(remaining)
+        while True:
+            job: Job | None = None
+            expired: list[Job] = []
+            timed_out = False
+            with self._not_empty:
+                while True:
+                    while self._heap:
+                        _, _, j = heapq.heappop(self._heap)
+                        if j.expired():
+                            expired.append(j)
+                            continue
+                        job = j
+                        break
+                    if job is not None or expired:
+                        break  # fail the scrubbed jobs lock-free first
+                    if deadline is None:
+                        self._not_empty.wait()
+                    else:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            timed_out = True
+                            break
+                        self._not_empty.wait(remaining)
+            for j in expired:
+                # Context so the fault event the constructor records
+                # carries the scrubbed job's id.
+                with events.context(job_id=j.job_id):
+                    j.fail(DeadlineExceededError(
+                        f"deadline {j.deadline_s:.2f}s lapsed after "
+                        f"{time.monotonic() - j.submitted_t:.2f}s "
+                        "in queue"))
+            if job is not None or timed_out:
+                return job
 
     # ------------------------------------------------------------------
 

@@ -16,7 +16,10 @@ job registry clients poll. :class:`ServeHTTPServer` is the transport: a
 ``GET /healthz``           liveness + drain flag + worker/queue state
 ``GET /metrics``           Prometheus text: queue depth, batch-occupancy
                            histogram, program-cache stats, per-stage span
-                           latencies (utils/trace)
+                           latencies (utils/trace), compile/device-memory
+                           telemetry (utils/telemetry)
+``GET /events?n=``         flight-recorder journal tail as JSONL
+                           (utils/events; docs/OBSERVABILITY.md)
 ========================  ==================================================
 
 The HTTP layer holds no state of its own — every handler delegates to the
@@ -39,7 +42,7 @@ import numpy as np
 
 from ..config import DecodeConfig, ProjectorConfig, TriangulationConfig
 from ..health import QualityGates
-from ..utils import trace
+from ..utils import events, telemetry, trace
 from ..utils.log import get_logger
 from .batcher import BucketBatcher, BucketKey
 from .cache import ProgramCache
@@ -83,6 +86,10 @@ class ServeConfig:
     # 256 of those would pin ~8 GB; the count cap alone doesn't bound
     # memory). Oldest terminal jobs are evicted past EITHER cap.
     result_cache_bytes: int = 512 << 20
+    # Compile/memory telemetry (docs/OBSERVABILITY.md): sl_compile_total,
+    # sl_compile_seconds, device-memory gauges and the recompile-storm
+    # detector on this service's /metrics.
+    telemetry: bool = True
 
 
 def synthetic_calib_provider(proj: ProjectorConfig):
@@ -167,18 +174,45 @@ class ReconstructionService:
             status=status)
         self._queue_gauge = self.registry.gauge(
             "serve_queue_depth", "jobs waiting in the admission queue")
+        # Per-job latency histograms: seconds-valued, so they take the
+        # explicit latency bucket layout (the occupancy-shaped Histogram
+        # default would bin every sub-second wait into `le="1"`).
+        self._queue_wait_s = self.registry.histogram(
+            "serve_job_queue_wait_seconds",
+            "submit-to-start wait per job",
+            buckets=trace.LATENCY_SECONDS_BUCKETS)
+        self._run_s = self.registry.histogram(
+            "serve_job_run_seconds", "start-to-terminal time per job",
+            buckets=trace.LATENCY_SECONDS_BUCKETS)
+        # Constructed here (its counter families must exist in the
+        # registry from the first scrape) but installed into the compile-
+        # event dispatch only for the start→drain window, so an abandoned
+        # or failed service never keeps receiving process-wide events.
+        self.telemetry: "telemetry.DeviceTelemetry | None" = (
+            telemetry.DeviceTelemetry(registry=self.registry)
+            if config.telemetry else None)
+        self._events_seen: dict[str, int] = {}  # _sync_event_counters
+        self._events_seen_lock = threading.Lock()
         self._warmup_report: dict = {}
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "ReconstructionService":
-        if self.config.warmup:
-            keys = [self._bucket_key(h, w) for h, w in self.config.buckets]
-            t0 = time.monotonic()
-            self._warmup_report = self.cache.warmup(
-                keys, self.config.batch_sizes)
-            log.info("warmup: %d programs in %.1fs",
-                     len(self._warmup_report), time.monotonic() - t0)
+        if self.telemetry is not None:
+            self.telemetry.install()   # before warmup: count its compiles
+        try:
+            if self.config.warmup:
+                keys = [self._bucket_key(h, w)
+                        for h, w in self.config.buckets]
+                t0 = time.monotonic()
+                self._warmup_report = self.cache.warmup(
+                    keys, self.config.batch_sizes)
+                log.info("warmup: %d programs in %.1fs",
+                         len(self._warmup_report), time.monotonic() - t0)
+        except BaseException:
+            if self.telemetry is not None:
+                self.telemetry.uninstall()
+            raise
         for w in self.workers:
             w.start()
         self._started = True
@@ -199,6 +233,8 @@ class ReconstructionService:
         if not ok:
             log.warning("drain timed out after %.1fs with workers alive",
                         timeout)
+        if self.telemetry is not None:
+            self.telemetry.uninstall()
         return ok
 
     @property
@@ -297,6 +333,15 @@ class ReconstructionService:
         terminal transition happened — worker postprocess, batch-scoped
         failure, or deadline scrub in the queue/batcher."""
         self._jobs_total("done" if job.status == DONE else "failed").inc()
+        wait_end = job.started_t or job.finished_t
+        if wait_end is not None:
+            self._queue_wait_s.observe(wait_end - job.submitted_t)
+        if job.started_t is not None and job.finished_t is not None:
+            self._run_s.observe(job.finished_t - job.started_t)
+        events.record("job_terminal",
+                      severity="info" if job.status == DONE else "warning",
+                      job_id=job.job_id, status=job.status,
+                      exc_type=(job.error or {}).get("type"))
 
     def _register(self, job: Job) -> None:
         with self._jobs_lock:
@@ -348,7 +393,36 @@ class ReconstructionService:
 
     def metrics_text(self) -> str:
         self._queue_gauge.set(self.queue.depth())
+        if self.telemetry is not None:
+            self.telemetry.sample_memory()  # refresh device gauges
+        self._sync_event_counters()
         return self.registry.prometheus_text(tracer=self.tracer)
+
+    def _sync_event_counters(self) -> None:
+        """Mirror the process flight recorder's severity tallies onto
+        THIS service's registry at scrape time — the recorder is
+        process-global and counts into trace.REGISTRY, which a service
+        with a private registry (the default) never renders. Deltas keep
+        the counters monotonic across scrapes; the lock keeps concurrent
+        scrapes (ThreadingHTTPServer) from double-applying a delta. When
+        the service IS handed the global registry, the recorder already
+        counts there — mirroring would double every event."""
+        if self.registry is trace.REGISTRY:
+            return
+        with self._events_seen_lock:
+            for sev, total in events.RECORDER.severity_counts().items():
+                seen = self._events_seen.get(sev, 0)
+                if total > seen:
+                    self.registry.counter(
+                        "sl_events_total",
+                        "flight-recorder events by severity",
+                        severity=sev).inc(total - seen)
+                    self._events_seen[sev] = total
+
+    def events_jsonl(self, n: int = 256) -> str:
+        """Tail of the process flight journal (GET /events): the ordered,
+        correlated record of what recently happened to which job."""
+        return events.to_jsonl(n)
 
 
 # ---------------------------------------------------------------------------
@@ -464,6 +538,18 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif url.path == "/events":
+            try:
+                n = int((parse_qs(url.query).get("n") or ["256"])[0])
+            except ValueError:
+                n = 256
+            data = self.service.events_jsonl(max(1, n)).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "application/x-ndjson; charset=utf-8")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

@@ -26,7 +26,7 @@ import numpy as np
 from ..health import QualityGates, ScanFault, StopQualityError
 from ..io.ply import PointCloud, write_ply
 from ..io.stl import write_stl
-from ..utils import trace
+from ..utils import events, trace
 from ..utils.log import get_logger
 from .batcher import Batch, BucketBatcher
 from .cache import ProgramCache, ProgramKey
@@ -107,8 +107,13 @@ class DeviceWorker:
                 # job in it fails with the fault payload; the worker — and
                 # with it the process — keeps serving.
                 log.warning("batch %s failed: %s", batch.key.label(), e)
+                events.record(
+                    "batch_failed", severity="error", message=str(e),
+                    program=batch.key.label(), exc_type=type(e).__name__,
+                    jobs=",".join(j.job_id for j in batch.jobs))
                 for job in batch.jobs:
-                    job.fail(e)
+                    with events.context(job_id=job.job_id):
+                        job.fail(e)
 
     # ------------------------------------------------------------------
 
@@ -142,18 +147,23 @@ class DeviceWorker:
         self.batcher.queue.observe_service_time(per_job)
 
     def _finish_job(self, job, key, points, colors, valid) -> None:
-        try:
-            result, meta = self._postprocess(job, key, points, colors,
-                                             valid)
-            job.complete(result, **meta)
-        except ScanFault as e:
-            log.warning("job %s failed: %s", job.job_id, e)
-            job.fail(e)
-        except Exception as e:
-            # Containment boundary: an unexpected host-side error (a
-            # meshing corner case, a writer bug) costs this job only.
-            log.warning("job %s failed unexpectedly: %s", job.job_id, e)
-            job.fail(e)
+        # Correlation context covers the whole postprocess: a gate raise
+        # (StopQualityError construction) journals with this job's id.
+        with events.context(job_id=job.job_id):
+            try:
+                result, meta = self._postprocess(job, key, points, colors,
+                                                 valid)
+                job.complete(result, **meta)
+            except ScanFault as e:
+                log.warning("job %s failed: %s", job.job_id, e)
+                job.fail(e)
+            except Exception as e:
+                # Containment boundary: an unexpected host-side error (a
+                # meshing corner case, a writer bug) costs this job only.
+                log.warning("job %s failed unexpectedly: %s", job.job_id, e)
+                events.record("job_contained", severity="error",
+                              message=str(e), exc_type=type(e).__name__)
+                job.fail(e)
 
     def _postprocess(self, job, key, points, colors,
                      valid) -> tuple[bytes, dict]:

@@ -1,0 +1,236 @@
+"""Device & compile telemetry: XLA compiles, device memory, recompile storms.
+
+A serving process that silently recompiles is a latency mystery: the
+symptom is a multi-second p99 spike, the cause is an off-menu shape or a
+non-hashable-static bug three layers down. This module makes compiles a
+first-class metric:
+
+* :class:`DeviceTelemetry` — subscribes to ``jax.monitoring``'s
+  ``/jax/core/compile/backend_compile_duration`` events (fired once per
+  actual XLA compile, NOT per cache hit) and meters them into the
+  registry as ``sl_compile_total`` + an ``sl_compile_seconds`` histogram.
+  Where ``jax.monitoring`` is unavailable (older jaxlib) automatic
+  metering is off — ``install()`` logs it, and callers that need
+  compile metrics there wrap their jit entry points with the
+  :func:`meter_jit` shim themselves (it is not applied automatically).
+* **recompile-storm detector** — a sliding window over compile times; a
+  burst above threshold increments ``sl_recompile_storms_total`` and
+  records a warning event in the flight recorder, so "it recompiled 40
+  times in a minute" is an alert, not archaeology.
+* :meth:`DeviceTelemetry.sample_memory` — per-device
+  ``bytes_in_use``/``peak_bytes_in_use`` gauges from
+  ``Device.memory_stats()`` (TPU/GPU; CPU reports none and the gauges
+  simply stay absent).
+
+One process-level jax listener fans out to every installed
+:class:`DeviceTelemetry` (jax's listener list is append-only), so tests
+can install a telemetry against a private registry and uninstall it
+without disturbing the process-global one.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+import time
+
+from . import events as events_mod
+from . import trace
+from .log import get_logger
+
+log = get_logger(__name__)
+
+#: The jax.monitoring duration key fired once per real XLA compile.
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# Fan-out: jax.monitoring listeners cannot be unregistered one at a time,
+# so exactly one real listener is registered (lazily) and dispatches to
+# the currently-installed telemetries.
+_DISPATCH_LOCK = threading.Lock()
+_DISPATCH: list["DeviceTelemetry"] = []
+_LISTENER_STATE = {"installed": False, "available": None}
+
+
+def _on_duration(key: str, duration_s: float, **_kw) -> None:
+    if key != COMPILE_EVENT:
+        return
+    with _DISPATCH_LOCK:
+        sinks = list(_DISPATCH)
+    for t in sinks:
+        t.observe_compile(duration_s)
+
+
+def _ensure_listener() -> bool:
+    """Register the process-level jax.monitoring listener once; returns
+    whether the monitoring backend is available."""
+    with _DISPATCH_LOCK:
+        if _LISTENER_STATE["installed"]:
+            return bool(_LISTENER_STATE["available"])
+        _LISTENER_STATE["installed"] = True
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_duration)
+            _LISTENER_STATE["available"] = True
+        except Exception as e:   # ancient jaxlib / stubbed-out jax
+            log.warning(
+                "jax.monitoring unavailable (%s) — automatic compile "
+                "metering is OFF; wrap jit entry points with "
+                "telemetry.meter_jit to meter compiles manually", e)
+            _LISTENER_STATE["available"] = False
+        return bool(_LISTENER_STATE["available"])
+
+
+class DeviceTelemetry:
+    """Compile + device-memory meters over one :class:`MetricsRegistry`."""
+
+    def __init__(self, registry: "trace.MetricsRegistry | None" = None,
+                 recorder: "events_mod.FlightRecorder | None" = None,
+                 storm_window_s: float = 30.0,
+                 storm_threshold: int = 20):
+        self.registry = registry if registry is not None else trace.REGISTRY
+        self.recorder = (recorder if recorder is not None
+                         else events_mod.RECORDER)
+        self.storm_window_s = float(storm_window_s)
+        self.storm_threshold = int(storm_threshold)
+        self._lock = threading.Lock()
+        self._recent: collections.deque[float] = collections.deque()
+        self._in_storm = False
+        self.monitoring_available: bool | None = None
+        self._compiles = self.registry.counter(
+            "sl_compile_total", "XLA backend compiles observed")
+        self._compile_s = self.registry.histogram(
+            "sl_compile_seconds", "per-compile wall-clock",
+            buckets=trace.COMPILE_SECONDS_BUCKETS)
+        self._storms = self.registry.counter(
+            "sl_recompile_storms_total",
+            "compile bursts above the storm threshold")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def install(self) -> "DeviceTelemetry":
+        """Start receiving compile events. Idempotent."""
+        self.monitoring_available = _ensure_listener()
+        with _DISPATCH_LOCK:
+            if self not in _DISPATCH:
+                _DISPATCH.append(self)
+        return self
+
+    def uninstall(self) -> None:
+        with _DISPATCH_LOCK:
+            if self in _DISPATCH:
+                _DISPATCH.remove(self)
+
+    # -- compile metering --------------------------------------------------
+
+    def observe_compile(self, duration_s: float) -> None:
+        self._compiles.inc()
+        self._compile_s.observe(float(duration_s))
+        now = time.monotonic()
+        with self._lock:
+            self._recent.append(now)
+            horizon = now - self.storm_window_s
+            while self._recent and self._recent[0] < horizon:
+                self._recent.popleft()
+            burst = len(self._recent)
+            storming = burst >= self.storm_threshold
+            new_storm = storming and not self._in_storm
+            self._in_storm = storming
+        if new_storm:
+            self._storms.inc()
+            self.recorder.record(
+                "recompile_storm", severity="warning",
+                message=f"{burst} XLA compiles inside "
+                        f"{self.storm_window_s:.0f}s — check for "
+                        "shape churn / non-hashable statics",
+                compiles_in_window=burst)
+            log.warning("recompile storm: %d compiles in %.0fs", burst,
+                        self.storm_window_s)
+
+    # -- device memory -----------------------------------------------------
+
+    def sample_memory(self) -> dict:
+        """Refresh per-device memory gauges; returns {device: stats}.
+        Devices without memory_stats (CPU) are reported but not gauged."""
+        out: dict[str, dict] = {}
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception as e:
+            log.debug("device enumeration failed: %s", e)
+            return out
+        for d in devices:
+            name = f"{d.platform}:{d.id}"
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                out[name] = {}
+                continue
+            out[name] = dict(stats)
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                self.registry.gauge(
+                    "sl_device_bytes_in_use",
+                    "live buffer bytes per device", device=name
+                ).set(float(in_use))
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                self.registry.gauge(
+                    "sl_device_peak_bytes",
+                    "peak buffer bytes per device", device=name
+                ).set(float(peak))
+        return out
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "monitoring_available": self.monitoring_available,
+            "compiles_total": int(self._compiles.value),
+            "compile_seconds": self._compile_s.snapshot(),
+            "recompile_storms": int(self._storms.value),
+            "device_memory": self.sample_memory(),
+        }
+
+
+def meter_jit(fn, telemetry: DeviceTelemetry):
+    """Fallback shim for environments without ``jax.monitoring``: wrap a
+    jitted callable so cache growth (``fn._cache_size()``) is counted as
+    a compile, with the growing call's wall-clock as the (upper-bound)
+    compile time. A no-op-cost passthrough when the cache is warm."""
+    if not hasattr(fn, "_cache_size"):
+        return fn
+
+    @functools.wraps(fn)
+    def metered(*args, **kwargs):
+        before = fn._cache_size()
+        t0 = time.monotonic()
+        out = fn(*args, **kwargs)
+        if fn._cache_size() > before:
+            telemetry.observe_compile(time.monotonic() - t0)
+        return out
+
+    return metered
+
+
+# ---------------------------------------------------------------------------
+# Global default telemetry (lazy; serve/bench/diagnose call install_global)
+# ---------------------------------------------------------------------------
+
+_GLOBAL: DeviceTelemetry | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def install_global() -> DeviceTelemetry:
+    """The process-default telemetry against ``trace.REGISTRY`` — created
+    and installed once, returned thereafter."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = DeviceTelemetry().install()
+        return _GLOBAL

@@ -30,11 +30,21 @@ numbers as dispatch time, which is also a real metric.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
+import functools
 import json
+import os
 import threading
 import time
+
+#: Default bucket layouts for seconds-valued histograms. The Histogram
+#: ctor default (1, 2, 4, 8) fits the serving layer's batch-OCCUPANCY
+#: range; latency/compile observations need these instead (enforced by
+#: the seconds-histogram audit in tests/test_trace.py).
+LATENCY_SECONDS_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.0, 10.0, 60.0)
+COMPILE_SECONDS_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0)
 
 
 @dataclasses.dataclass
@@ -47,11 +57,25 @@ class SpanRecord:
 
 
 class Tracer:
-    def __init__(self):
+    #: Raw-record cap. A long-running serve process spans every batch;
+    #: unbounded records are a slow leak. Past the cap the OLDEST records
+    #: are folded into `_evicted` aggregates — `totals()` stays exact
+    #: forever, only the raw span list (export/Perfetto) is windowed.
+    DEFAULT_MAX_RECORDS = 16384
+
+    def __init__(self, max_records: int | None = None):
         self._lock = threading.Lock()
         self._local = threading.local()
         self._t0 = time.monotonic()
-        self.records: list[SpanRecord] = []
+        self.max_records = (self.DEFAULT_MAX_RECORDS if max_records is None
+                            else int(max_records))
+        if self.max_records < 1:
+            raise ValueError(
+                f"max_records must be >= 1, got {self.max_records}")
+        self.records: collections.deque[SpanRecord] = collections.deque()
+        # span path -> {count, total_s, max_s} for evicted records.
+        self._evicted: dict[str, dict] = {}
+        self.evicted_count = 0
 
     # ------------------------------------------------------------------
 
@@ -77,6 +101,18 @@ class Tracer:
         finally:
             dur = time.monotonic() - start
             stack.pop()
+            # Ambient correlation fields (events.context scan_id/job_id/
+            # stop) ride every span's meta, so Perfetto args and span
+            # exports correlate with the flight journal. Lazy import:
+            # events.py imports this module for REGISTRY.
+            try:
+                from . import events as _events
+
+                ctx = _events.current_context()
+            except Exception:
+                ctx = {}
+            if ctx:
+                meta = {**ctx, **meta}
             with self._lock:
                 self.records.append(SpanRecord(
                     name=path,
@@ -84,24 +120,40 @@ class Tracer:
                     duration_s=dur,
                     thread=threading.current_thread().name,
                     meta=meta or None))
+                while len(self.records) > self.max_records:
+                    self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        old = self.records.popleft()
+        agg = self._evicted.setdefault(
+            old.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += old.duration_s
+        agg["max_s"] = max(agg["max_s"], old.duration_s)
+        self.evicted_count += 1
 
     def wrap(self, name: str):
         """Decorator form of :meth:`span`."""
         def deco(fn):
+            @functools.wraps(fn)
             def inner(*a, **kw):
                 with self.span(name):
                     return fn(*a, **kw)
-            inner.__name__ = getattr(fn, "__name__", name)
             return inner
         return deco
 
     # ------------------------------------------------------------------
 
     def totals(self) -> dict[str, dict]:
-        """Aggregate {span path: {count, total_s, mean_s, max_s}}."""
-        agg: dict[str, dict] = {}
+        """Aggregate {span path: {count, total_s, mean_s, max_s}}. Exact
+        over the tracer's whole lifetime: evicted records contribute via
+        their folded aggregates."""
         with self._lock:
             records = list(self.records)
+            agg: dict[str, dict] = {
+                name: {"count": a["count"], "total_s": a["total_s"],
+                       "max_s": a["max_s"]}
+                for name, a in self._evicted.items()}
         for r in records:
             a = agg.setdefault(r.name, {"count": 0, "total_s": 0.0,
                                         "max_s": 0.0})
@@ -129,16 +181,55 @@ class Tracer:
         return "\n".join(lines)
 
     def export(self, path: str) -> None:
-        """JSON dump: raw spans + aggregates."""
+        """JSON dump: raw spans (the retained window) + lifetime
+        aggregates."""
         with self._lock:
             records = [dataclasses.asdict(r) for r in self.records]
+            evicted = self.evicted_count
         with open(path, "w") as f:
-            json.dump({"spans": records, "totals": self.totals()}, f,
-                      indent=2)
+            json.dump({"spans": records, "totals": self.totals(),
+                       "evicted_spans": evicted}, f, indent=2)
+
+    # -- Perfetto / Chrome trace_event export ---------------------------
+
+    def to_perfetto(self) -> dict:
+        """The retained spans as a Chrome/Perfetto ``trace_event`` JSON
+        object (open at ui.perfetto.dev or chrome://tracing). Complete
+        duration events ("ph": "X") on one track per thread; span meta —
+        including the correlation IDs merged in by :meth:`span` — rides
+        in ``args``, so a slow scan's track is searchable by scan_id
+        next to a `device_trace` XProf capture of the same run."""
+        with self._lock:
+            records = list(self.records)
+        pid = os.getpid()
+        tids: dict[str, int] = {}
+        trace_events: list[dict] = [{
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": "sl-host"}}]
+        for r in records:
+            tid = tids.get(r.thread)
+            if tid is None:
+                tid = tids[r.thread] = len(tids) + 1
+                trace_events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": r.thread}})
+            trace_events.append({
+                "ph": "X", "cat": "host", "name": r.name,
+                "pid": pid, "tid": tid,
+                "ts": round(r.start_s * 1e6, 3),
+                "dur": round(r.duration_s * 1e6, 3),
+                "args": dict(r.meta or {})})
+        return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+    def export_perfetto(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_perfetto(), f)
 
     def reset(self) -> None:
         with self._lock:
             self.records.clear()
+            self._evicted.clear()
+            self.evicted_count = 0
             self._t0 = time.monotonic()
 
 
@@ -173,6 +264,7 @@ span = GLOBAL.span
 wrap = GLOBAL.wrap
 summary = GLOBAL.summary
 export = GLOBAL.export
+export_perfetto = GLOBAL.export_perfetto
 totals = GLOBAL.totals
 reset = GLOBAL.reset
 
@@ -395,10 +487,23 @@ class MetricsRegistry:
                     lab = _render_labels((("span", path),))
                     lines.append(f"sl_span_seconds_total{lab} "
                                  f"{_fmt_metric(a['total_s'])}")
+                lines.append("# HELP sl_span_count_total completed spans "
+                             "per tracer span path")
+                lines.append("# TYPE sl_span_count_total counter")
+                for path, a in sorted(agg.items()):
+                    lab = _render_labels((("span", path),))
+                    lines.append(f"sl_span_count_total{lab} {a['count']}")
+                # DEPRECATED: sl_span_count predates the exposition-format
+                # `_total` counter suffix; kept one release for existing
+                # scrapes, then sl_span_count_total only.
+                lines.append("# HELP sl_span_count deprecated alias of "
+                             "sl_span_count_total (no _total suffix)")
                 lines.append("# TYPE sl_span_count counter")
                 for path, a in sorted(agg.items()):
                     lab = _render_labels((("span", path),))
                     lines.append(f"sl_span_count{lab} {a['count']}")
+                lines.append("# HELP sl_span_max_seconds longest single "
+                             "span per tracer span path")
                 lines.append("# TYPE sl_span_max_seconds gauge")
                 for path, a in sorted(agg.items()):
                     lab = _render_labels((("span", path),))

@@ -1,0 +1,481 @@
+"""Observability layer: flight recorder, compile telemetry, Perfetto
+export, `cli diagnose` (docs/OBSERVABILITY.md).
+
+The acceptance bars (ISSUE 5):
+
+* a chaos-run scan produces a dump-on-fault journal whose events carry
+  the failing scan_id/stop;
+* cold compiles surface as nonzero ``sl_compile_total`` + compile
+  seconds on /metrics, with ZERO growth across a warm repeat;
+* Perfetto export validates against the ``trace_event`` JSON shape and
+  round-trips correlation IDs through span args;
+* ``cli diagnose`` emits a tarball containing health + metrics +
+  journal + env manifest.
+"""
+
+import json
+import tarfile
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from structured_light_for_3d_model_replication_tpu import (
+    health as health_mod,
+)
+from structured_light_for_3d_model_replication_tpu import (
+    scanner as scan_mod,
+)
+from structured_light_for_3d_model_replication_tpu.cli import diagnose
+from structured_light_for_3d_model_replication_tpu.config import (
+    ProjectorConfig,
+)
+from structured_light_for_3d_model_replication_tpu.hw import faults
+from structured_light_for_3d_model_replication_tpu.hw.rig import VirtualRig
+from structured_light_for_3d_model_replication_tpu.io.layout import (
+    SessionLayout,
+)
+from structured_light_for_3d_model_replication_tpu.utils import (
+    events,
+    telemetry,
+    trace,
+)
+
+TINY = ProjectorConfig(width=64, height=32)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_is_bounded():
+    rec = events.FlightRecorder(capacity=5)
+    for i in range(12):
+        rec.record("tick", i=i)
+    assert len(rec) == 5
+    assert rec.dropped == 7
+    kept = [e.fields["i"] for e in rec.tail()]
+    assert kept == [7, 8, 9, 10, 11]      # oldest evicted first
+    assert rec.tail(2)[-1].fields["i"] == 11
+
+
+def test_recorder_rejects_unknown_severity():
+    rec = events.FlightRecorder()
+    with pytest.raises(ValueError, match="severity"):
+        rec.record("x", severity="catastrophic")
+
+
+def test_context_merges_and_nests():
+    rec = events.FlightRecorder()
+    with events.context(scan_id="s1", stop=0):
+        with events.context(stop=3, job_id="j9"):
+            ev = rec.record("inner")
+        outer = rec.record("outer")
+    bare = rec.record("bare")
+    assert ev.fields == {"scan_id": "s1", "stop": 3, "job_id": "j9"}
+    assert outer.fields == {"scan_id": "s1", "stop": 0}
+    assert bare.fields == {}
+
+
+def test_context_is_thread_isolated():
+    rec = events.FlightRecorder()
+    seen = {}
+
+    def worker():
+        seen["ctx"] = events.current_context()
+        rec.record("from_thread")
+
+    with events.context(scan_id="main-only"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["ctx"] == {}              # no cross-thread leakage
+    assert rec.tail()[-1].fields == {}
+
+
+def test_events_jsonl_round_trip():
+    rec = events.FlightRecorder()
+    rec.record("alpha", message="hello", n=1)
+    rec.record("beta", severity="warning")
+    lines = rec.to_jsonl().strip().splitlines()
+    docs = [json.loads(ln) for ln in lines]
+    assert [d["kind"] for d in docs] == ["alpha", "beta"]
+    assert docs[0]["fields"] == {"n": 1}
+    assert docs[1]["severity"] == "warning"
+    assert docs[0]["t_mono"] <= docs[1]["t_mono"]
+
+
+def test_scanfault_records_fault_event():
+    before = len(events.RECORDER)
+    with events.context(job_id="jj42"):
+        exc = health_mod.StopQualityError("coverage 0.001 below gate")
+    assert isinstance(exc, health_mod.ScanFault)
+    faults_seen = [e for e in events.RECORDER.tail()
+                   if e.severity == "fault"][-1]
+    assert len(events.RECORDER) > before
+    assert faults_seen.fields["exc_type"] == "StopQualityError"
+    assert "StopQualityError" in faults_seen.fields["taxonomy"]
+    assert faults_seen.fields["job_id"] == "jj42"
+
+
+def test_backpressure_rejections_journal_as_warnings(tmp_path):
+    """QueueFullError is designed flow control: it must journal at
+    warning severity and never trigger a dump-on-fault file — an
+    overload burst must not wrap the ring's fault history or storm the
+    dump directory."""
+    from structured_light_for_3d_model_replication_tpu.serve.jobs import (
+        QueueFullError,
+    )
+
+    events.RECORDER.clear()
+    dump_dir = tmp_path / "dumps"
+    events.set_dump_dir(str(dump_dir), min_interval_s=0.0)
+    try:
+        QueueFullError(depth=64, retry_after_s=1.5)
+    finally:
+        events.set_dump_dir(None)
+    ev = events.RECORDER.tail()[-1]
+    assert ev.kind == "fault" and ev.severity == "warning"
+    assert ev.fields["exc_type"] == "QueueFullError"
+    assert not list(dump_dir.glob("*.jsonl")) and not dump_dir.exists()
+
+
+# ---------------------------------------------------------------------------
+# Chaos scan → dump-on-fault journal (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_scan_dumps_fault_journal(tmp_path):
+    """A FlakyCamera hard fault on one stop must land fault events
+    carrying the scan_id + failing stop in the journal, AND write a
+    JSONL dump (dump dir configured) whose rows carry the same IDs."""
+    events.RECORDER.clear()
+    dump_dir = tmp_path / "dumps"
+    events.set_dump_dir(str(dump_dir), min_interval_s=0.0)
+    try:
+        rig = VirtualRig(proj=TINY, cam_height=24, cam_width=40)
+        rig.turntable.time_scale = 0.001
+        plan = faults.FaultPlan(
+            [faults.FaultPlan.hard("_120deg_scan/03", "timeout")])
+        layout = SessionLayout(root=str(tmp_path / "session")).ensure()
+        sc = scan_mod.Scanner(
+            faults.FlakyCamera(rig.camera, plan), rig.projector,
+            turntable=rig.turntable, proj=TINY, layout=layout,
+            settle_s=0.0,
+            retry=scan_mod.RetryPolicy(frame_attempts=2, stop_attempts=2,
+                                       backoff_s=0.0),
+            sleep=lambda s: None)
+        report = health_mod.ScanHealthReport()
+        stops = sc.auto_scan_360("obj", degrees_per_turn=120.0, turns=3,
+                                 health=report, scan_id="scan-cafe01")
+    finally:
+        events.set_dump_dir(None)
+
+    assert len(stops) == 2 and report.failed_stops == [1]
+    assert report.scan_id == "scan-cafe01"
+
+    # Journal: fault events from the exhausted stop carry scan_id + stop.
+    fault_evs = [e for e in events.RECORDER.tail() if e.severity == "fault"]
+    assert fault_evs, "no fault events recorded for the failed stop"
+    assert all(e.fields["scan_id"] == "scan-cafe01" for e in fault_evs)
+    assert all(e.fields["stop"] == 1 for e in fault_evs)
+    # Retry/skip breadcrumbs precede the fault.
+    kinds = [e.kind for e in events.RECORDER.tail()]
+    assert "capture_retry" in kinds
+    assert "stop_failed" in kinds
+    assert kinds.index("capture_retry") < kinds.index("fault")
+
+    # Dump-on-fault: a JSONL file exists and its rows round-trip the IDs.
+    dumps = sorted(dump_dir.glob("flight_*.jsonl"))
+    assert dumps, "no dump-on-fault journal written"
+    rows = [json.loads(ln) for ln in
+            dumps[0].read_text().strip().splitlines()]
+    fault_rows = [r for r in rows if r["severity"] == "fault"]
+    assert fault_rows
+    assert fault_rows[-1]["fields"]["scan_id"] == "scan-cafe01"
+    assert fault_rows[-1]["fields"]["stop"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Compile telemetry: cold counts, warm stays flat
+# ---------------------------------------------------------------------------
+
+
+def test_compile_telemetry_cold_then_warm_metrics():
+    reg = trace.MetricsRegistry()
+    rec = events.FlightRecorder(registry=reg)
+    tel = telemetry.DeviceTelemetry(registry=reg, recorder=rec).install()
+    try:
+        # A FRESH function object: its jit cache is empty, so the first
+        # call compiles regardless of what ran before this test.
+        salt = np.float32(1.2345)
+        f = jax.jit(lambda x: jnp.sin(x) * salt + x)
+        x = jnp.arange(8, dtype=jnp.float32)
+        f(x).block_until_ready()                      # cold: compiles
+        cold = int(reg.counter("sl_compile_total").value)
+        if tel.monitoring_available:
+            assert cold >= 1, "cold compile not counted"
+        else:  # environments without jax.monitoring use the shim
+            f = telemetry.meter_jit(jax.jit(lambda x: jnp.cos(x)), tel)
+            f(x).block_until_ready()
+            cold = int(reg.counter("sl_compile_total").value)
+            assert cold >= 1
+
+        f(x).block_until_ready()                      # warm: cache hit
+        warm = int(reg.counter("sl_compile_total").value)
+        assert warm == cold, "warm repeat grew the compile counter"
+
+        text = reg.prometheus_text()
+        assert "# TYPE sl_compile_total counter" in text
+        assert f"sl_compile_total {cold}" in text
+        snap = reg.snapshot()["sl_compile_seconds"]["_"]
+        assert snap["count"] == cold and snap["sum"] > 0
+    finally:
+        tel.uninstall()
+
+
+def test_serve_metrics_expose_compile_telemetry():
+    """Service-level acceptance: /metrics shows nonzero sl_compile_total
+    after the cold (warmup + first batch) phase and zero growth across a
+    warm repeat of the same-shaped job."""
+    from structured_light_for_3d_model_replication_tpu.models import (
+        synthetic,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve import (
+        ReconstructionService,
+        ServeConfig,
+    )
+
+    # "Cold" must mean a REAL XLA compile, and jax has two caches that
+    # would silently satisfy it instead: the in-memory compilation cache
+    # (identical HLO compiled earlier in this process — e.g. test_serve's
+    # 24x40 programs) and the persistent on-disk cache (compiled on a
+    # previous RUN; conftest shares the dir). So this test uses a bucket
+    # shape no other test compiles (28x44) and disables the persistent
+    # cache — which jax memoizes as enabled, hence the reset_cache() on
+    # top of the config update.
+    from jax.experimental.compilation_cache import (
+        compilation_cache as comp_cache,
+    )
+
+    cache_dir = jax.config.jax_compilation_cache_dir
+    comp_cache.reset_cache()
+    jax.config.update("jax_compilation_cache_dir", None)
+
+    proj = TINY
+    h, w = 28, 44
+    cfg = ServeConfig(proj=proj, buckets=((h, w),), batch_sizes=(1,),
+                      linger_ms=1.0, queue_depth=8, workers=1)
+    svc = ReconstructionService(cfg).start()
+    try:
+        cam = synthetic.default_calibration(h, w, proj)
+        stack, _ = synthetic.render_scan(synthetic.Scene(), *cam, h, w,
+                                         proj)
+        job = svc.submit_array(np.asarray(stack))     # cold batch
+        assert job.wait(60.0) and job.status == "done"
+        cold = int(svc.registry.counter("sl_compile_total").value)
+        assert cold >= 1, "warmup/cold batch compiles not metered"
+        text_cold = svc.metrics_text()
+        assert f"sl_compile_total {cold}" in text_cold
+        assert "sl_compile_seconds_sum" in text_cold
+        # Flight-recorder severity tallies ride the SERVICE scrape too
+        # (the recorder is process-global; the service registry mirrors
+        # deltas at scrape time) — job_terminal above recorded at info.
+        assert 'sl_events_total{severity="info"}' in text_cold
+
+        job2 = svc.submit_array(np.asarray(stack))    # warm repeat
+        assert job2.wait(60.0) and job2.status == "done"
+        warm = int(svc.registry.counter("sl_compile_total").value)
+        assert warm == cold, (
+            f"warm repeat recompiled: {warm - cold} extra compile(s)")
+    finally:
+        svc.drain(timeout=10.0)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        comp_cache.reset_cache()  # re-arm the restored cache dir
+
+
+def test_recompile_storm_detector():
+    reg = trace.MetricsRegistry()
+    rec = events.FlightRecorder(registry=reg)
+    tel = telemetry.DeviceTelemetry(registry=reg, recorder=rec,
+                                    storm_window_s=60.0,
+                                    storm_threshold=3)
+    for _ in range(5):
+        tel.observe_compile(0.01)
+    # One storm: the detector latches while the window stays hot.
+    assert int(reg.counter("sl_recompile_storms_total").value) == 1
+    storms = [e for e in rec.tail() if e.kind == "recompile_storm"]
+    assert len(storms) == 1
+    assert storms[0].severity == "warning"
+    assert storms[0].fields["compiles_in_window"] == 3
+
+
+def test_meter_jit_shim_counts_cache_growth():
+    reg = trace.MetricsRegistry()
+    tel = telemetry.DeviceTelemetry(registry=reg,
+                                    recorder=events.FlightRecorder(
+                                        registry=reg))
+    f = telemetry.meter_jit(jax.jit(lambda x: x * 3 + 1), tel)
+    x = jnp.ones(4)
+    f(x).block_until_ready()
+    assert int(reg.counter("sl_compile_total").value) == 1
+    f(x).block_until_ready()
+    assert int(reg.counter("sl_compile_total").value) == 1  # warm: flat
+    f(jnp.ones((2, 2))).block_until_ready()                 # new shape
+    assert int(reg.counter("sl_compile_total").value) == 2
+
+
+def test_device_memory_sampling_graceful():
+    reg = trace.MetricsRegistry()
+    tel = telemetry.DeviceTelemetry(registry=reg,
+                                    recorder=events.FlightRecorder(
+                                        registry=reg))
+    mem = tel.sample_memory()
+    # CPU devices report no memory_stats; the call must still enumerate
+    # them and never throw.
+    assert isinstance(mem, dict) and len(mem) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def _validate_trace_events(doc: dict) -> list[dict]:
+    """Minimal trace_event-format checks (the JSON array-of-events shape
+    Perfetto/chrome://tracing load)."""
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert isinstance(doc["traceEvents"], list)
+    spans = []
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        assert isinstance(ev.get("args", {}), dict)
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+            assert ev["cat"] == "host"
+            spans.append(ev)
+    json.dumps(doc)  # must serialize
+    return spans
+
+
+def test_perfetto_export_round_trips_correlation_ids(tmp_path):
+    tr = trace.Tracer()
+    with events.context(scan_id="scan-deadbeef", job_id="j7"):
+        with tr.span("scan360.decode", stops=4):
+            with tr.span("launch"):
+                pass
+    with tr.span("uncorrelated"):
+        pass
+    doc = tr.to_perfetto()
+    spans = _validate_trace_events(doc)
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["scan360.decode"]["args"]["scan_id"] == "scan-deadbeef"
+    assert by_name["scan360.decode"]["args"]["job_id"] == "j7"
+    assert by_name["scan360.decode"]["args"]["stops"] == 4
+    assert by_name["scan360.decode.launch"]["args"]["scan_id"] \
+        == "scan-deadbeef"
+    assert "scan_id" not in by_name["uncorrelated"]["args"]
+    # Thread metadata track exists and is referenced.
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"
+            and e["name"] == "thread_name"]
+    assert meta and meta[0]["tid"] == by_name["scan360.decode"]["tid"]
+
+    out = tmp_path / "trace.json"
+    tr.export_perfetto(str(out))
+    reread = json.loads(out.read_text())
+    assert _validate_trace_events(reread)
+
+
+def test_perfetto_export_of_scan360_spans(synth_rig, synth_scan):
+    """End-to-end: a gated scan360 run exports spans whose args carry the
+    ambient scan_id."""
+    from structured_light_for_3d_model_replication_tpu.models import (
+        merge, scan360,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops.triangulate \
+        import make_calibration
+
+    from .conftest import CAM_H, CAM_W, SMALL_PROJ
+
+    trace.reset()
+    cam_K, proj_K, R, T = synth_rig
+    stack, _ = synth_scan
+    stacks = np.stack([stack, stack])
+    calib = make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                             proj_width=SMALL_PROJ.width,
+                             proj_height=SMALL_PROJ.height)
+    params = scan360.Scan360Params(merge=merge.MergeParams(
+        voxel_size=6.0, ransac_iterations=512, icp_iterations=5,
+        fpfh_max_nn=16, normals_k=8, max_points=1024))
+    with events.context(scan_id="scan-e2e"):
+        scan360.scan_stacks_to_cloud(jnp.asarray(stacks), calib,
+                                     SMALL_PROJ.col_bits,
+                                     SMALL_PROJ.row_bits, params=params)
+    spans = _validate_trace_events(trace.GLOBAL.to_perfetto())
+    decoded = [s for s in spans if s["name"].startswith("scan360.")]
+    assert decoded, "scan360 spans missing from the export"
+    assert all(s["args"].get("scan_id") == "scan-e2e" for s in decoded)
+    trace.reset()
+
+
+# ---------------------------------------------------------------------------
+# cli diagnose
+# ---------------------------------------------------------------------------
+
+
+def test_diagnose_bundle_members(tmp_path):
+    events.record("diagnose_test_marker", n=1)
+    with trace.span("diagnose_test_span"):
+        pass
+    health_path = tmp_path / "health.json"
+    report = health_mod.ScanHealthReport(scan_id="scan-diag")
+    report.stop(0).status = "captured"
+    report.write(str(health_path))
+    journal_path = tmp_path / "old_dump.jsonl"
+    events.RECORDER.dump(str(journal_path))
+
+    out = tmp_path / "bundle.tar.gz"
+    rc = diagnose.main(["-o", str(out),
+                        "--health-json", str(health_path),
+                        "--journal", str(journal_path)])
+    assert rc == 0 and out.exists()
+
+    with tarfile.open(out) as tar:
+        names = set(tar.getnames())
+        required = {"env.json", "metrics.json", "metrics.prom",
+                    "spans.json", "events.jsonl", "perfetto.json",
+                    "telemetry.json", "health.json", "MANIFEST.json",
+                    "journal_00_old_dump.jsonl"}
+        assert required <= names, f"missing {required - names}"
+
+        env = json.load(tar.extractfile("env.json"))
+        assert "jax" in env and "packages" in env
+        assert env["jax"]["backend"] == "cpu"
+
+        health = json.load(tar.extractfile("health.json"))
+        assert health["scan_id"] == "scan-diag"
+
+        journal = tar.extractfile("events.jsonl").read().decode()
+        assert "diagnose_test_marker" in journal
+
+        manifest = json.load(tar.extractfile("MANIFEST.json"))
+        assert manifest["errors"] == {}
+        assert set(manifest["members"]) == names
+
+        _validate_trace_events(
+            json.load(tar.extractfile("perfetto.json")))
+
+
+def test_diagnose_health_stub_without_sources(tmp_path):
+    members = diagnose.collect()
+    assert json.loads(members["health.json"])["source"] == "none"
+    manifest = json.loads(members["MANIFEST.json"])
+    assert "health.json" in manifest["members"]

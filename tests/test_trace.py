@@ -64,6 +64,57 @@ def test_wrap_decorator_and_reset():
     assert tr.totals() == {}
 
 
+def test_wrap_preserves_introspection():
+    """functools.wraps semantics: docstring/signature/qualname survive,
+    so wrapped pipeline stages stay inspectable."""
+    import inspect
+
+    tr = trace.Tracer()
+
+    @tr.wrap("stage")
+    def decode_stage(stack, *, chunk=4):
+        """Decode a stack in chunks."""
+        return chunk
+
+    assert decode_stage.__name__ == "decode_stage"
+    assert decode_stage.__doc__ == "Decode a stack in chunks."
+    assert "decode_stage" in decode_stage.__qualname__
+    assert list(inspect.signature(decode_stage).parameters) \
+        == ["stack", "chunk"]
+    assert decode_stage.__wrapped__ is not decode_stage
+
+
+def test_tracer_bounded_records_exact_totals():
+    """Past max_records the oldest raw spans are evicted into folded
+    aggregates — totals stay EXACT, memory stays bounded."""
+    tr = trace.Tracer(max_records=10)
+    for i in range(25):
+        with tr.span("a" if i % 2 else "b"):
+            pass
+    assert len(tr.records) == 10
+    assert tr.evicted_count == 15
+    agg = tr.totals()
+    assert agg["a"]["count"] + agg["b"]["count"] == 25
+    assert agg["a"]["count"] == 12 and agg["b"]["count"] == 13
+    total = sum(a["total_s"] for a in agg.values())
+    assert total >= 0
+    tr.reset()
+    assert tr.evicted_count == 0 and tr.totals() == {}
+
+
+def test_tracer_export_reports_eviction(tmp_path):
+    tr = trace.Tracer(max_records=2)
+    for _ in range(5):
+        with tr.span("s"):
+            pass
+    out = tmp_path / "t.json"
+    tr.export(str(out))
+    doc = json.loads(out.read_text())
+    assert len(doc["spans"]) == 2
+    assert doc["evicted_spans"] == 3
+    assert doc["totals"]["s"]["count"] == 5
+
+
 # ---------------------------------------------------------------------------
 # Metrics: counters/gauges/histograms + Prometheus exporter
 # ---------------------------------------------------------------------------
@@ -155,6 +206,25 @@ def test_prometheus_text_includes_tracer_spans():
     assert 'sl_span_max_seconds{span="scan360.register"}' in text
 
 
+def test_prometheus_span_exposition_conformance():
+    """Counters carry the `_total` suffix and every span family has a
+    HELP line; the unsuffixed sl_span_count stays one release as a
+    deprecated alias."""
+    reg = trace.MetricsRegistry()
+    tr = trace.Tracer()
+    with tr.span("stage"):
+        pass
+    text = reg.prometheus_text(tracer=tr)
+    assert "# HELP sl_span_seconds_total " in text
+    assert "# HELP sl_span_count_total " in text
+    assert "# TYPE sl_span_count_total counter" in text
+    assert 'sl_span_count_total{span="stage"} 1' in text
+    assert "# HELP sl_span_count deprecated alias" in text
+    assert "# HELP sl_span_max_seconds " in text
+    # Alias agrees with the conforming family.
+    assert 'sl_span_count{span="stage"} 1' in text
+
+
 def test_label_escaping():
     reg = trace.MetricsRegistry()
     reg.counter("errors_total", kind='Bad"Quote\nNewline').inc()
@@ -170,6 +240,58 @@ def test_registry_snapshot_json_friendly():
     assert snap["c"]['{status="x"}'] == 2
     assert snap["h"]["_"]["count"] == 1
     json.dumps(snap)  # must serialize
+
+
+def test_seconds_histograms_use_explicit_latency_buckets():
+    """Audit every ``.histogram(...)`` call site in the package: a
+    seconds-valued family (name ending ``_seconds``) must pass explicit
+    ``buckets=`` — the ctor default (1, 2, 4, 8) is the batch-OCCUPANCY
+    layout and bins every sub-second latency into le="1"."""
+    import os
+    import re
+
+    import structured_light_for_3d_model_replication_tpu as pkg
+
+    root = os.path.dirname(pkg.__file__)
+    offenders = []
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                src = f.read()
+            for m in re.finditer(r"\.histogram\(", src):
+                start = m.end() - 1   # the opening paren
+                depth, i = 0, start
+                while i < len(src):
+                    if src[i] == "(":
+                        depth += 1
+                    elif src[i] == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    i += 1
+                call = src[start:i + 1]
+                name = re.search(r'["\']([A-Za-z0-9_:]+)["\']', call)
+                if name is None:
+                    continue
+                if name.group(1).endswith("_seconds") \
+                        and "buckets=" not in call:
+                    offenders.append(
+                        f"{os.path.relpath(path, root)}: "
+                        f"{name.group(1)}")
+    assert not offenders, (
+        "seconds-valued histograms inheriting the occupancy bucket "
+        f"default: {offenders} — pass "
+        "buckets=trace.LATENCY_SECONDS_BUCKETS (or a deliberate layout)")
+
+
+def test_latency_bucket_constants_sane():
+    for buckets in (trace.LATENCY_SECONDS_BUCKETS,
+                    trace.COMPILE_SECONDS_BUCKETS):
+        assert list(buckets) == sorted(buckets)
+        assert buckets[0] < 0.1 and buckets[-1] >= 60
 
 
 @pytest.mark.slow

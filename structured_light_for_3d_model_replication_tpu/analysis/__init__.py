@@ -4,12 +4,23 @@ Usage::
 
     python -m structured_light_for_3d_model_replication_tpu.analysis --check .
 
-The framework (:mod:`.core`) is AST-only and stdlib-only; the built-in
-rules (:mod:`.rules`) target the hazard classes this codebase has
-actually shipped: unguarded pallas imports, host syncs inside jit,
-implicit dtypes in the ops layer, ``static_argnames`` mistakes, jitted
-reads of mutable globals, and PRNG key reuse.  See ``docs/JAXLINT.md``
-for the workflow (running, suppressing, updating the baseline).
+Two passes (docs/JAXLINT.md):
+
+* the **lexical** fast path (:mod:`.core` + :mod:`.rules`): per-file AST
+  rules for the hazard classes this codebase has actually shipped —
+  unguarded pallas imports, host syncs inside jit, implicit dtypes in
+  the ops layer, ``static_argnames`` mistakes, jitted reads of mutable
+  globals, PRNG key reuse;
+* the **project** pass (:mod:`.project` over :mod:`.callgraph` +
+  :mod:`.locks`): cross-module dataflow rules — lock-order inversions,
+  blocking calls under locks, unlocked shared state across thread entry
+  points, jit statics fed from loop variables, shape scalars at traced
+  positions, and the warn-tier sharding-readiness family paving the
+  multi-chip PR.
+
+Everything is AST-only and stdlib-only, so the gate runs where jax
+itself is absent. The runtime complements live in `utils/sanitize.py`
+(``SL_SANITIZE=1``).
 """
 
 from .core import (  # noqa: F401
@@ -25,5 +36,18 @@ from .core import (  # noqa: F401
     load_baseline,
     make_baseline,
     register,
+    to_sarif,
 )
 from . import rules  # noqa: F401  (importing registers the built-in rules)
+from .project import (  # noqa: F401
+    PROJECT_REGISTRY,
+    ProjectIndex,
+    ProjectRule,
+    build_index,
+    project_lint,
+    register_project,
+    rule_severity,
+)
+from . import rules_concurrency  # noqa: F401  (registers project rules)
+from . import rules_recompile    # noqa: F401
+from . import rules_sharding     # noqa: F401

@@ -37,8 +37,9 @@ from typing import Iterable, Iterator
 
 __all__ = [
     "BASELINE_NAME", "FileContext", "Rule", "REGISTRY", "Violation",
-    "apply_baseline", "iter_python_files", "lint_file", "lint_path",
-    "load_baseline", "make_baseline", "register",
+    "apply_baseline", "iter_python_files", "lint_context", "lint_file",
+    "lint_path", "load_baseline", "make_baseline", "parse_file",
+    "register", "to_sarif",
 ]
 
 BASELINE_NAME = "jaxlint_baseline.json"
@@ -119,6 +120,9 @@ class Rule:
 
     name: str = ""
     description: str = ""
+    # "error" findings gate (exit 1); "warn" findings are reported and
+    # ratcheted through the baseline but never fail the check.
+    severity: str = "error"
     # Lint only files whose relative posix path contains one of these
     # substrings (empty tuple = every file).
     path_filter: tuple[str, ...] = ()
@@ -180,29 +184,43 @@ def iter_python_files(root: Path) -> Iterator[Path]:
         yield p
 
 
-def lint_file(path: Path, rel_path: str,
-              rules: Iterable[Rule] | None = None) -> list[Violation]:
+def parse_file(path: Path, rel_path: str):
+    """(FileContext, None) or (None, parse-error Violation)."""
     try:
         source = path.read_text(encoding="utf-8")
     except (OSError, UnicodeDecodeError) as exc:
         # Same non-baselinable channel as a syntax error: an unreadable
         # file must fail the gate with a pointer, not a traceback.
-        return [Violation(rel_path, 1, 0, "parse-error",
-                          f"could not read: {exc}")]
+        return None, Violation(rel_path, 1, 0, "parse-error",
+                               f"could not read: {exc}")
     try:
         tree = ast.parse(source, filename=str(path))
     except SyntaxError as exc:
         # Unparseable files fail the gate outright (parse-error is not a
         # registered rule, so it can neither be suppressed nor baselined).
-        return [Violation(rel_path, exc.lineno or 1, exc.offset or 0,
-                          "parse-error", f"could not parse: {exc.msg}")]
-    ctx = FileContext(rel_path, source, tree)
+        return None, Violation(rel_path, exc.lineno or 1, exc.offset or 0,
+                               "parse-error",
+                               f"could not parse: {exc.msg}")
+    return FileContext(rel_path, source, tree), None
+
+
+def lint_context(ctx: FileContext,
+                 rules: Iterable[Rule] | None = None) -> list[Violation]:
+    """Run the (lexical) rules over an already-parsed file."""
     out: list[Violation] = []
     for rule in (rules if rules is not None else REGISTRY.values()):
-        if rule.applies_to(rel_path):
+        if rule.applies_to(ctx.rel_path):
             out.extend(rule.check(ctx))
     out.sort()
     return out
+
+
+def lint_file(path: Path, rel_path: str,
+              rules: Iterable[Rule] | None = None) -> list[Violation]:
+    ctx, err = parse_file(path, rel_path)
+    if err is not None:
+        return [err]
+    return lint_context(ctx, rules)
 
 
 def lint_path(root: Path,
@@ -217,6 +235,69 @@ def lint_path(root: Path,
         out.extend(lint_file(path, rel, rules))
     out.sort()
     return out
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 export
+# ---------------------------------------------------------------------------
+
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(violations: list[Violation],
+             rules_meta: dict[str, tuple[str, str]]) -> dict:
+    """SARIF 2.1.0 document for the given findings.
+
+    ``rules_meta`` maps rule name → (description, severity); severities
+    map warn→"warning", everything else →"error". Columns are
+    1-indexed per the SARIF spec (Violation.col is 0-indexed AST
+    col_offset)."""
+    used = sorted({v.rule for v in violations} | set(rules_meta))
+    rule_index = {name: i for i, name in enumerate(used)}
+    rules = [{
+        "id": name,
+        "shortDescription": {
+            "text": rules_meta.get(name, ("", "error"))[0]
+                    or name},
+        "defaultConfiguration": {
+            "level": ("warning"
+                      if rules_meta.get(name, ("", "error"))[1] == "warn"
+                      else "error")},
+    } for name in used]
+    results = [{
+        "ruleId": v.rule,
+        "ruleIndex": rule_index[v.rule],
+        "level": ("warning"
+                  if rules_meta.get(v.rule, ("", "error"))[1] == "warn"
+                  else "error"),
+        "message": {"text": v.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": v.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, v.line),
+                           "startColumn": v.col + 1},
+            },
+        }],
+    } for v in violations]
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jaxlint",
+                "informationUri": "docs/JAXLINT.md",
+                "rules": rules,
+            }},
+            "results": results,
+            # No columnKind declared: startColumn comes from ast
+            # col_offset (a UTF-8 byte offset), which is neither of the
+            # declarable units — on the rare non-ASCII line it is a
+            # best-effort approximation, and declaring a unit it does
+            # not honor would just mis-anchor viewers confidently.
+        }],
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,169 @@
+"""Recompile-hazard rule family: jit callsites that churn the compile cache.
+
+A jit program recompiles whenever its *static* signature changes — and a
+static fed from a Python loop variable changes every iteration. The
+complementary mistake is feeding a *shape-derived* Python int to a
+TRACED position: the callee cannot do shape math with a tracer (it
+raises at trace time) and, where it slips through as a weak-typed
+constant instead, the value is baked into the program — one compile per
+distinct value. Both are invisible per-file (the callsite and the jit
+decorator live in different modules), hence project rules over the call
+graph:
+
+* ``jit-static-from-loop`` — a call to a project-jitted function where
+  an argument mapped to a ``static_argnames`` parameter mentions an
+  enclosing ``for``-loop target. One compile per iteration by
+  construction (PR-5's recompile-storm detector sees it at runtime;
+  this sees it in review).
+* ``jit-traced-shape-scalar`` — an argument at a traced position that is
+  ``len(x)`` / ``x.shape[i]`` / ``x.size`` / ``x.ndim``: shape-derived
+  Python ints are almost always meant to be static (mark them in
+  ``static_argnames``, or compute the quantity inside the jitted body
+  from the traced operand itself).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .callgraph import FunctionInfo
+from .project import ProjectIndex, ProjectRule, register_project
+
+
+def _loop_targets(node) -> set[str]:
+    out: set[str] = set()
+    t = node.target
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        out.update(e.id for e in t.elts if isinstance(e, ast.Name))
+    return out
+
+
+def _names_in(expr: ast.expr) -> set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _map_args(callee: FunctionInfo, call: ast.Call):
+    """[(param_name | None, arg_expr)] for the call's positional +
+    keyword arguments against the callee's parameter list. Methods are
+    not project-jitted here (jit wraps functions), so no self-shift."""
+    out = []
+    params = list(callee.params)
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break    # positional mapping unknowable past *args
+        out.append((params[i] if i < len(params) else None, arg))
+    for kw in call.keywords:
+        if kw.arg is not None:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+def _resolved_jit_calls(index: ProjectIndex, fn: FunctionInfo):
+    """(callee, call) for this function's calls that resolve to a
+    project-jitted function."""
+    for name, call in fn.calls:
+        callee = index.graph._resolve(fn.module, fn, name)
+        if callee is not None and callee.jitted:
+            yield callee, call
+
+
+@register_project
+class StaticFromLoopRule(ProjectRule):
+    """``static_argnames`` fed from a loop variable → compile per
+    iteration. Blind spot: loops over a single-element iterable are
+    technically fine — suppress with a justification there."""
+
+    name = "jit-static-from-loop"
+    description = ("jit static argument fed from an enclosing for-loop "
+                   "variable (one compile per iteration)")
+
+    def check_project(self, index: ProjectIndex) -> Iterator:
+        for fn in index.graph.functions.values():
+            loops = [n for n in ast.walk(fn.node)
+                     if isinstance(n, (ast.For, ast.AsyncFor))]
+            if not loops:
+                continue
+            jit_calls = list(_resolved_jit_calls(index, fn))
+            if not jit_calls:
+                continue
+            for loop in loops:
+                targets = _loop_targets(loop)
+                if not targets:
+                    continue
+                body_calls = {id(n) for s in loop.body
+                              for n in ast.walk(s)
+                              if isinstance(n, ast.Call)}
+                for callee, call in jit_calls:
+                    if id(call) not in body_calls or \
+                            not callee.static_names:
+                        continue
+                    for param, arg in _map_args(callee, call):
+                        if param in callee.static_names and \
+                                _names_in(arg) & targets:
+                            v = self.report(
+                                index, fn.module.rel_path, call,
+                                f"static argument {param!r} of jitted "
+                                f"{callee.name}() is fed from loop "
+                                f"variable(s) "
+                                f"{sorted(_names_in(arg) & targets)} — "
+                                "one XLA compile per iteration; hoist "
+                                "the static out of the loop or make the "
+                                "argument traced")
+                            if v:
+                                yield v
+
+
+_SHAPE_ATTRS = {"shape", "size", "ndim"}
+
+
+def _is_shape_scalar(expr: ast.expr) -> bool:
+    """len(x), x.shape[i], x.size, x.ndim — shape-derived Python ints."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id == "len" and len(expr.args) == 1:
+        return True
+    if isinstance(expr, ast.Subscript):
+        base = expr.value
+        return (isinstance(base, ast.Attribute)
+                and base.attr == "shape")
+    if isinstance(expr, ast.Attribute) and expr.attr in ("size", "ndim"):
+        return True
+    return False
+
+
+@register_project
+class TracedShapeScalarRule(ProjectRule):
+    """A shape-derived Python int passed at a TRACED jit position.
+
+    Only fires when the callee declares ``static_argnames`` for other
+    parameters (the author is shape-aware — an undeclared-statics callee
+    may genuinely consume the value as data) and the argument is
+    *directly* ``len(...)``/``.shape[...]``/``.size``/``.ndim``."""
+
+    name = "jit-traced-shape-scalar"
+    description = ("shape-derived Python scalar (len/.shape/.size) "
+                   "passed at a traced jit position")
+
+    def check_project(self, index: ProjectIndex) -> Iterator:
+        for fn in index.graph.functions.values():
+            for callee, call in _resolved_jit_calls(index, fn):
+                if not callee.static_names:
+                    continue
+                for param, arg in _map_args(callee, call):
+                    if param is None or param in callee.static_names:
+                        continue
+                    if _is_shape_scalar(arg):
+                        v = self.report(
+                            index, fn.module.rel_path, call,
+                            f"argument {param!r} of jitted "
+                            f"{callee.name}() receives "
+                            f"{ast.unparse(arg) if hasattr(ast, 'unparse') else 'a shape scalar'} "
+                            "— a shape-derived Python int at a traced "
+                            "position (trace error if used for shape "
+                            "math, per-value constant otherwise); add "
+                            f"it to static_argnames or derive it inside "
+                            "from the traced operand")
+                        if v:
+                            yield v

@@ -1,0 +1,103 @@
+"""Sharding-readiness rule family (WARN tier) — paving the multi-chip PR.
+
+The ROADMAP's next tier shards the big Poisson/marching programs across
+chips (`parallel/` mesh + pjit patterns). Two properties make a jit
+entry point shard-ready, and both are annotations this rule can see:
+
+* **donation** — the megabyte-scale scratch buffers on the
+  ``poisson_sparse``/``marching_jax``/``scan360`` path should declare
+  ``donate_argnums``/``donate_argnames`` so XLA reuses input memory
+  instead of doubling the working set per chip;
+* **sharding annotations** — public jit entry points should carry
+  explicit ``in_shardings``/``out_shardings`` (or be wrapped by the
+  `parallel/` mesh helpers) so the multi-chip PR can flip them from
+  replicated to sharded without re-deriving the layout.
+
+These are *warnings*, ratcheted separately through the baseline:
+missing donation on today's single-chip path costs memory, not
+correctness, and CPU CI cannot validate donation semantics at all (XLA
+CPU ignores donation). The warn tier keeps the debt visible on every
+lint run without blocking unrelated PRs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .project import ProjectIndex, ProjectRule, register_project
+from .rules import dotted
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_DONATE_KEYS = {"donate_argnums", "donate_argnames"}
+_SHARD_KEYS = {"in_shardings", "out_shardings", "in_axis_resources",
+               "out_axis_resources"}
+
+
+def _kwarg_names(call: ast.Call) -> set[str]:
+    return {k.arg for k in call.keywords if k.arg}
+
+
+@register_project
+class ShardingReadinessRule(ProjectRule):
+    """jit sites on the heavy scan→mesh path missing donation and/or
+    sharding annotations. Scoped to the modules the multi-chip PR will
+    shard; one finding per jit site naming exactly what is missing."""
+
+    name = "sharding-readiness"
+    description = ("jit site on the poisson/marching/scan360 path "
+                   "missing donate_argnums and/or sharding annotations "
+                   "(warn tier — multi-chip paving)")
+    severity = "warn"
+    path_filter = ("ops/poisson_sparse", "ops/marching_jax",
+                   "models/pipeline", "models/scan360")
+
+    def check_project(self, index: ProjectIndex) -> Iterator:
+        seen_calls: set[int] = set()
+        # Decorated functions (both @jax.jit and @partial(jax.jit, …)).
+        for fn in index.graph.functions.values():
+            rel = fn.module.rel_path
+            if not self.applies_to(rel):
+                continue
+            if fn.jit_call is not None:
+                seen_calls.add(id(fn.jit_call))
+                kw = _kwarg_names(fn.jit_call)
+                v = self._site(index, rel, fn.jit_call, fn.name, kw)
+                if v:
+                    yield v
+            elif fn.jitted and any(dotted(d) in _JIT_NAMES
+                                   for d in fn.node.decorator_list):
+                dec = next(d for d in fn.node.decorator_list
+                           if dotted(d) in _JIT_NAMES)
+                v = self._site(index, rel, dec, fn.name, set())
+                if v:
+                    yield v
+        # Wrapping calls: `run = jax.jit(body, …)` — the scan360 idiom.
+        for mod in index.graph.modules.values():
+            if not self.applies_to(mod.rel_path):
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and id(node) not in \
+                        seen_calls and dotted(node.func) in _JIT_NAMES:
+                    label = (dotted(node.args[0]) if node.args else None) \
+                        or "<lambda>"
+                    v = self._site(index, mod.rel_path, node, label,
+                                   _kwarg_names(node))
+                    if v:
+                        yield v
+
+    def _site(self, index, rel_path, node, label, kwargs: set[str]):
+        missing = []
+        if not kwargs & _DONATE_KEYS:
+            missing.append("donate_argnums (buffer donation)")
+        if not kwargs & _SHARD_KEYS:
+            missing.append("in_shardings/out_shardings")
+        if not missing:
+            return None
+        return self.report(
+            index, rel_path, node,
+            f"jit site {label!r} on the scan->mesh path lacks "
+            f"{' and '.join(missing)} — the multi-chip PR needs "
+            "donation to keep per-chip memory flat and explicit "
+            "shardings to flip from replicated to sharded "
+            "(docs/JAXLINT.md, ROADMAP multi-chip item)")

@@ -23,6 +23,8 @@ host:
 ``diagnose``       support bundle: health + metrics + flight journal +
                    Perfetto spans + env manifest in one tarball
                    (docs/OBSERVABILITY.md)
+``lint``           jaxlint static-analysis gate: lexical + cross-module
+                   project rules, SARIF export (docs/JAXLINT.md)
 ================  ===========================================================
 
 Invoke via ``python -m structured_light_for_3d_model_replication_tpu.cli <tool> [args]``.
@@ -34,6 +36,7 @@ import sys
 
 _TOOLS = {
     "diagnose": "diagnose",
+    "lint": "lint",
     "process-cloud": "process_cloud",
     "read-calib": "read_calib",
     "merge-360": "merge_360",

@@ -1,0 +1,30 @@
+"""``cli lint`` — the jaxlint gate as a first-class CLI tool.
+
+A thin front end over ``python -m …analysis`` (docs/JAXLINT.md) so the
+static-analysis suite sits next to ``serve``/``diagnose`` in the
+operator's toolbox::
+
+    cli lint                 # full two-pass check of the repo (cwd)
+    cli lint --fast          # lexical rules only (seconds)
+    cli lint --sarif out.sarif
+    cli lint path/to/subtree --prune-baseline
+
+Arguments before the first ``--`` flag are the paths to check
+(default ``.``); every ``analysis`` flag passes through unchanged.
+Exit codes are the gate's: 0 clean (warnings allowed), 1 new
+error-tier violations, 2 usage / bad baseline / dead baseline entries.
+"""
+
+from __future__ import annotations
+
+
+def main(argv=None) -> int:
+    from ..analysis.__main__ import main as analysis_main
+
+    argv = list(argv or [])
+    if "--list-rules" in argv:
+        return analysis_main(["--list-rules"])
+    paths = []
+    while argv and not argv[0].startswith("-"):
+        paths.append(argv.pop(0))
+    return analysis_main(["--check", *(paths or ["."]), *argv])

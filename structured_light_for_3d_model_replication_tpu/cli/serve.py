@@ -81,6 +81,12 @@ def main(argv=None) -> int:
         ServeHTTPServer,
         fixed_calib_provider,
     )
+    from ..utils import sanitize
+
+    # SL_SANITIZE=1 arms the runtime sanitizers for a REAL service too
+    # (docs/JAXLINT.md): the lock-order checker must install before the
+    # service constructs its queue/cache/worker locks.
+    sanitize.install_if_enabled()
 
     proj = ProjectorConfig(width=args.proj_width, height=args.proj_height)
     buckets = _parse_buckets(args.buckets)

@@ -26,7 +26,7 @@ import numpy as np
 from ..health import QualityGates, ScanFault, StopQualityError
 from ..io.ply import PointCloud, write_ply
 from ..io.stl import write_stl
-from ..utils import events, trace
+from ..utils import events, sanitize, trace
 from ..utils.log import get_logger
 from .batcher import Batch, BucketBatcher
 from .cache import ProgramCache, ProgramKey
@@ -183,6 +183,12 @@ class DeviceWorker:
         keep = valid.astype(bool)
         cloud = PointCloud(points=points[keep].astype(np.float32),
                            colors=colors[keep].astype(np.uint8))
+        if sanitize.enabled():
+            # Valid-masked triangulations must be finite — a NaN here is
+            # a decode/triangulate bug, caught AT the containment
+            # boundary (fails this job only) instead of shipping as a
+            # poisoned mesh.
+            sanitize.assert_finite(cloud.points, "serve.postprocess")
         meta = {"points": int(len(cloud)), "coverage": round(coverage, 4)}
         if job.result_format == "ply":
             return _ply_bytes(cloud), meta

@@ -125,6 +125,11 @@ class DeviceTelemetry:
 
     # -- compile metering --------------------------------------------------
 
+    @property
+    def compiles_total(self) -> int:
+        """XLA compiles observed by THIS telemetry since install()."""
+        return int(self._compiles.value)
+
     def observe_compile(self, duration_s: float) -> None:
         self._compiles.inc()
         self._compile_s.observe(float(duration_s))

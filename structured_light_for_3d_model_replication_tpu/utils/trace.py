@@ -449,8 +449,8 @@ class MetricsRegistry:
     def prometheus_text(self, tracer: "Tracer | None" = None) -> str:
         """Prometheus exposition text of every registered metric, plus —
         when a tracer is given — its span aggregates as
-        ``sl_span_seconds_total`` / ``sl_span_count`` / ``sl_span_max_seconds``
-        families labelled by span path."""
+        ``sl_span_seconds_total`` / ``sl_span_count_total`` /
+        ``sl_span_max_seconds`` families labelled by span path."""
         lines: list[str] = []
         with self._lock:
             families = {n: (k, h, dict(c))
@@ -493,15 +493,6 @@ class MetricsRegistry:
                 for path, a in sorted(agg.items()):
                     lab = _render_labels((("span", path),))
                     lines.append(f"sl_span_count_total{lab} {a['count']}")
-                # DEPRECATED: sl_span_count predates the exposition-format
-                # `_total` counter suffix; kept one release for existing
-                # scrapes, then sl_span_count_total only.
-                lines.append("# HELP sl_span_count deprecated alias of "
-                             "sl_span_count_total (no _total suffix)")
-                lines.append("# TYPE sl_span_count counter")
-                for path, a in sorted(agg.items()):
-                    lab = _render_labels((("span", path),))
-                    lines.append(f"sl_span_count{lab} {a['count']}")
                 lines.append("# HELP sl_span_max_seconds longest single "
                              "span per tracer span path")
                 lines.append("# TYPE sl_span_max_seconds gauge")

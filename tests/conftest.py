@@ -39,6 +39,13 @@ import pytest  # noqa: E402
 
 from structured_light_for_3d_model_replication_tpu.config import ProjectorConfig  # noqa: E402
 from structured_light_for_3d_model_replication_tpu.models import synthetic  # noqa: E402
+from structured_light_for_3d_model_replication_tpu.utils import sanitize  # noqa: E402
+
+# Runtime sanitizers (docs/JAXLINT.md): SL_SANITIZE=1 installs the
+# lock-order checker before any test constructs a service, so every
+# lock the serve/chaos suites create is order-checked per instance (the
+# CI `sanitize` job runs exactly this way).
+sanitize.install_if_enabled()
 
 
 # Small projector keeps synthetic renders fast while exercising every code

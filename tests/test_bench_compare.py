@@ -1,0 +1,105 @@
+"""`scripts/bench_compare.py --strict` edge cases.
+
+The bench driver trusts this script's exit code; the edges that matter:
+archives whose tail carries no headline lines (crashed round), an empty
+trajectory (fresh repo / wiped archives), and the threshold boundary —
+a metric that regresses EXACTLY at the threshold must not flag (the
+contract is "beyond", multiplicative), one epsilon above must.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare",
+    Path(__file__).resolve().parents[1] / "scripts" / "bench_compare.py")
+bench_compare = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_compare)
+
+
+def _round(tmp_path: Path, n: int, tail: str) -> None:
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "tail": tail}), encoding="utf-8")
+
+
+def _headline(metric: str, value: float) -> str:
+    return json.dumps({"metric": metric, "value": value})
+
+
+def _fresh(tmp_path: Path, metric: str, value: float) -> str:
+    p = tmp_path / "fresh.log"
+    p.write_text(_headline(metric, value) + "\n", encoding="utf-8")
+    return str(p)
+
+
+def _run(tmp_path: Path, fresh: str, *extra) -> int:
+    return bench_compare.main([
+        "--fresh", fresh,
+        "--history", str(tmp_path / "BENCH_r*.json"), *extra])
+
+
+def test_missing_headline_keys_in_history(tmp_path, capsys):
+    """Rounds whose tail has no headline JSON lines (crashed bench, log
+    truncation) contribute nothing — the fresh metric is no-history and
+    --strict stays green instead of crashing on the malformed round."""
+    _round(tmp_path, 1, "Traceback (most recent call last):\n  boom\n")
+    _round(tmp_path, 2, '{"metric": "other_s"}\n'      # value missing
+                        '{"value": 1.0}\n'             # metric missing
+                        '{"metric": 7, "value": 1.0}\n')  # non-str metric
+    rc = _run(tmp_path, _fresh(tmp_path, "full_360_scan_to_mesh_s", 5.0),
+              "--strict")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no-history" in out
+
+
+def test_empty_trajectory_is_informational(tmp_path, capsys):
+    """No archives at all: every metric is no-history; --strict passes
+    (nothing to regress against), and the table still renders."""
+    rc = _run(tmp_path, _fresh(tmp_path, "full_360_scan_to_mesh_s", 5.0),
+              "--strict")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "history: 0 rounds" in out
+    assert "no-history" in out
+
+
+@pytest.mark.parametrize("factor,verdict,strict_rc", [
+    (1.10, "flat", 0),          # exactly AT threshold: not "beyond"
+    (1.101, "REGRESSION", 1),   # one epsilon beyond: fails strict
+    (0.90, "flat", 0),          # exactly at the improvement edge
+    (0.85, "improved", 0),
+])
+def test_threshold_boundary(tmp_path, capsys, factor, verdict, strict_rc):
+    _round(tmp_path, 1, _headline("full_360_scan_to_mesh_s", 4.0))
+    rc = _run(tmp_path,
+              _fresh(tmp_path, "full_360_scan_to_mesh_s", 4.0 * factor),
+              "--strict", "--threshold", "0.10")
+    out = capsys.readouterr().out
+    assert rc == strict_rc, out
+    assert verdict in out
+
+
+def test_strict_regression_vs_last_round_only(tmp_path, capsys):
+    """The comparison base is the LAST round, not the best: a metric
+    slower than round 1's best but inside threshold of round 2 is flat."""
+    _round(tmp_path, 1, _headline("full_360_scan_to_mesh_s", 3.0))
+    _round(tmp_path, 2, _headline("full_360_scan_to_mesh_s", 5.0))
+    rc = _run(tmp_path, _fresh(tmp_path, "full_360_scan_to_mesh_s", 5.2),
+              "--strict")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "flat" in out and "3.000" in out  # best column still shown
+
+
+def test_json_mode_counts_regressions(tmp_path, capsys):
+    _round(tmp_path, 1, _headline("full_360_scan_to_mesh_s", 1.0))
+    rc = _run(tmp_path, _fresh(tmp_path, "full_360_scan_to_mesh_s", 2.0),
+              "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["regressions"] == 1
+    assert doc["rows"][0]["verdict"] == "REGRESSION"

@@ -47,6 +47,22 @@ def test_dispatcher_help(capsys):
     assert cli.main(["bogus"]) == 2
 
 
+def test_cli_lint_dispatch(tmp_path, capsys):
+    """`cli lint` fronts the jaxlint gate: rule listing, a clean tree,
+    and flag passthrough (--fast, --sarif) all route through."""
+    assert cli.main(["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-order" in out and "pallas-import" in out
+
+    (tmp_path / "mod.py").write_text("x = 1\n", encoding="utf-8")
+    sarif = tmp_path / "lint.sarif"
+    assert cli.main(["lint", str(tmp_path), "--fast"]) == 0
+    assert cli.main(["lint", str(tmp_path),
+                     "--sarif", str(sarif)]) == 0
+    capsys.readouterr()
+    assert sarif.exists()
+
+
 def test_process_cloud_single(session, tmp_path):
     root, mat = session
     out = tmp_path / "single.ply"

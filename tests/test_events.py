@@ -474,6 +474,31 @@ def test_diagnose_bundle_members(tmp_path):
             json.load(tar.extractfile("perfetto.json")))
 
 
+def test_diagnose_probe_cpu_path(tmp_path):
+    """`cli diagnose --probe` regression (CPU path): the tiny synthetic
+    reconstruction must COMPLETE (triangulate real points at its
+    miniature 16x24 resolution) and land in the bundle + MANIFEST — a
+    probe that silently degrades to an `*_error` note would gut the
+    "fresh process ships real numbers" contract."""
+    out = tmp_path / "probe_bundle.tar.gz"
+    rc = diagnose.main(["-o", str(out), "--probe"])
+    assert rc == 0 and out.exists()
+
+    with tarfile.open(out) as tar:
+        manifest = json.load(tar.extractfile("MANIFEST.json"))
+        assert "probe.json" in manifest["members"]
+        assert not any(k.startswith("probe") for k in manifest["errors"])
+
+        probe = json.load(tar.extractfile("probe.json"))
+        assert probe["cam"] == [16, 24] and probe["proj"] == [32, 16]
+        assert probe["probe_points"] > 0  # reconstruction really ran
+
+        # The probe's span made it into the bundle's observability
+        # members — the point of probing before collecting.
+        spans = json.load(tar.extractfile("spans.json"))
+        assert "diagnose.probe" in spans["totals"]
+
+
 def test_diagnose_health_stub_without_sources(tmp_path):
     members = diagnose.collect()
     assert json.loads(members["health.json"])["source"] == "none"

@@ -14,10 +14,13 @@ from pathlib import Path
 import pytest
 
 from structured_light_for_3d_model_replication_tpu.analysis import (
+    PROJECT_REGISTRY,
     REGISTRY,
     apply_baseline,
     lint_file,
     make_baseline,
+    project_lint,
+    rule_severity,
 )
 from structured_light_for_3d_model_replication_tpu.analysis.__main__ import (
     main as jaxlint_main,
@@ -28,6 +31,12 @@ REPO_ROOT = Path(__file__).resolve().parents[1]
 EXPECTED_RULES = {
     "pallas-import", "host-sync-in-jit", "implicit-dtype",
     "static-argnames", "mutable-global", "key-reuse", "silent-except",
+}
+
+EXPECTED_PROJECT_RULES = {
+    "lock-order", "blocking-under-lock", "unlocked-shared-state",
+    "jit-static-from-loop", "jit-traced-shape-scalar",
+    "sharding-readiness",
 }
 
 # rule → (rel_path, triggering source, clean source, suppressed source).
@@ -300,6 +309,535 @@ def test_unreadable_file_is_reported_not_raised(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Project (cross-module) rules — v2 engine
+# ---------------------------------------------------------------------------
+
+# rule → (triggering {rel_path: source}, clean {…}, suppressed {…}).
+# Project rules lint a TREE, so fixtures are file sets; modules matter
+# (the call graph resolves imports), and the sharding family only
+# reports under its path_filter.
+PROJECT_FIXTURES = {
+    "lock-order": (
+        {"serve/locks.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def one(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+
+                def two(self):
+                    with self._lb:
+                        with self._la:
+                            pass
+            """},
+        {"serve/locks.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def one(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+
+                def two(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+            """},
+        {"serve/locks.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def one(self):
+                    with self._la:
+                        with self._lb:  # jaxlint: disable=lock-order -- startup only
+                            pass
+
+                def two(self):
+                    with self._lb:
+                        with self._la:  # jaxlint: disable=lock-order -- startup only
+                            pass
+            """},
+    ),
+    "blocking-under-lock": (
+        {"serve/cachez.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def load(self, path):
+                    with self._lock:
+                        return open(path).read()
+            """},
+        {"serve/cachez.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def load(self, path):
+                    with self._lock:
+                        cached = dict(x=1)
+                    return open(path).read()
+            """},
+        {"serve/cachez.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def load(self, path):
+                    with self._lock:
+                        return open(path).read()  # jaxlint: disable=blocking-under-lock -- tiny file
+            """},
+    ),
+    "unlocked-shared-state": (
+        {"serve/state.py": """
+            import threading
+
+            SHARED = {}
+
+            def worker():
+                SHARED["k"] = 1
+
+            def spawn():
+                threading.Thread(target=worker).start()
+
+            def main_path():
+                SHARED["j"] = 2
+            """},
+        {"serve/state.py": """
+            import threading
+
+            SHARED = {}
+            _LOCK = threading.Lock()
+
+            def worker():
+                with _LOCK:
+                    SHARED["k"] = 1
+
+            def spawn():
+                threading.Thread(target=worker).start()
+
+            def main_path():
+                with _LOCK:
+                    SHARED["j"] = 2
+            """},
+        {"serve/state.py": """
+            import threading
+
+            SHARED = {}
+
+            def worker():
+                SHARED["k"] = 1  # jaxlint: disable=unlocked-shared-state -- write-once init
+
+            def spawn():
+                threading.Thread(target=worker).start()
+
+            def main_path():
+                SHARED["j"] = 2  # jaxlint: disable=unlocked-shared-state -- write-once init
+            """},
+    ),
+    "jit-static-from-loop": (
+        {"ops/sweep.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("depth",))
+            def solve(x, depth):
+                return x * depth
+
+            def sweep(x, depths):
+                return [solve(x, depth=d) for d in ()] or [
+                    solve(x, depth=d2) for d2 in depths]
+
+            def sweep2(x, depths):
+                out = []
+                for d in depths:
+                    out.append(solve(x, depth=d))
+                return out
+            """},
+        {"ops/sweep.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("depth",))
+            def solve(x, depth):
+                return x * depth
+
+            def sweep2(x, xs, depth):
+                out = []
+                for chunk in xs:
+                    out.append(solve(chunk, depth=depth))
+                return out
+            """},
+        {"ops/sweep.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("depth",))
+            def solve(x, depth):
+                return x * depth
+
+            def sweep2(x, depths):
+                out = []
+                for d in depths:
+                    out.append(solve(x, depth=d))  # jaxlint: disable=jit-static-from-loop -- 2 depths max
+                return out
+            """},
+    ),
+    "jit-traced-shape-scalar": (
+        {"ops/shapes.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def gather_top(x, n, k=2):
+                return x[:k] + n
+
+            def run(x):
+                return gather_top(x, len(x))
+            """},
+        {"ops/shapes.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k", "n"))
+            def gather_top(x, n, k=2):
+                return x[:k] + n
+
+            def run(x):
+                return gather_top(x, len(x))
+            """},
+        {"ops/shapes.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def gather_top(x, n, k=2):
+                return x[:k] + n
+
+            def run(x):
+                return gather_top(x, len(x))  # jaxlint: disable=jit-traced-shape-scalar -- n is data here
+            """},
+    ),
+    "sharding-readiness": (
+        {"ops/poisson_sparse.py": """
+            import jax
+
+            @jax.jit
+            def _cg(x, b):
+                return x + b
+            """},
+        {"ops/poisson_sparse.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               out_shardings=None)
+            def _cg(x, b):
+                return x + b
+            """},
+        {"ops/poisson_sparse.py": """
+            import jax
+
+            @jax.jit  # jaxlint: disable=sharding-readiness -- scalar-only helper
+            def _cg(x, b):
+                return x + b
+            """},
+    ),
+}
+
+
+def _plint(tmp_path: Path, files: dict):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src), encoding="utf-8")
+    return project_lint(tmp_path)
+
+
+def test_project_registry_has_the_expected_rules():
+    assert EXPECTED_PROJECT_RULES == set(PROJECT_REGISTRY)
+    assert set(PROJECT_FIXTURES) == EXPECTED_PROJECT_RULES
+    # Tiers: sharding paves the multi-chip PR without gating; the
+    # concurrency/recompile families gate.
+    assert rule_severity("sharding-readiness") == "warn"
+    for rule in EXPECTED_PROJECT_RULES - {"sharding-readiness"}:
+        assert rule_severity(rule) == "error"
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_PROJECT_RULES))
+def test_project_rule_triggers(rule, tmp_path):
+    bad, _, _ = PROJECT_FIXTURES[rule]
+    hits = [v for v in _plint(tmp_path, bad) if v.rule == rule]
+    assert hits, f"{rule} fixture did not trigger"
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_PROJECT_RULES))
+def test_project_rule_clean_fixture(rule, tmp_path):
+    _, good, _ = PROJECT_FIXTURES[rule]
+    hits = [v for v in _plint(tmp_path, good) if v.rule == rule]
+    assert not hits, f"{rule} fired on the clean fixture: {hits}"
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_PROJECT_RULES))
+def test_project_rule_suppression_comment(rule, tmp_path):
+    _, _, suppressed = PROJECT_FIXTURES[rule]
+    hits = [v for v in _plint(tmp_path, suppressed) if v.rule == rule]
+    assert not hits, f"disable={rule} comment was not honored: {hits}"
+
+
+def test_project_rules_exempt_tests_and_scripts(tmp_path):
+    bad, _, _ = PROJECT_FIXTURES["blocking-under-lock"]
+    moved = {"tests/" + rel.split("/")[-1]: src for rel, src in bad.items()}
+    assert not [v for v in _plint(tmp_path, moved)
+                if v.rule == "blocking-under-lock"]
+
+
+def test_cross_module_lock_order(tmp_path):
+    """The cycle spans two modules through a resolved call — the reason
+    the engine is two-pass instead of per-file."""
+    files = {
+        "serve/a.py": """
+            import threading
+
+            from . import b
+
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self.helper = b.B()
+
+                def path1(self):
+                    with self._la:
+                        self.helper.grab()
+            """,
+        "serve/b.py": """
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._lb = threading.Lock()
+
+                def grab(self):
+                    with self._lb:
+                        pass
+
+                def path2(self, a):
+                    with self._lb:
+                        a.path1()
+            """,
+        "serve/__init__.py": "",
+    }
+    hits = [v for v in _plint(tmp_path, files) if v.rule == "lock-order"]
+    assert hits, "cross-module inversion not detected"
+
+
+def test_blocking_under_lock_sees_with_open(tmp_path):
+    """`with open(path) as f:` is the dominant file-I/O idiom — the
+    context expression executes under the held lock and must flag."""
+    files = {"serve/withopen.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def load(self, path):
+                with self._lock:
+                    with open(path) as f:
+                        return f.read()
+        """}
+    hits = [v for v in _plint(tmp_path, files)
+            if v.rule == "blocking-under-lock"]
+    assert hits, "with open(...) under a lock not detected"
+
+
+def test_unlocked_shared_state_is_per_access(tmp_path):
+    """One guarded access must not launder a later unguarded access in
+    the SAME function — guardedness is lexical per access."""
+    files = {"serve/mixed.py": """
+        import threading
+
+        SHARED = {}
+        _LOCK = threading.Lock()
+
+        def worker():
+            with _LOCK:
+                SHARED["k"] = 1
+            SHARED.pop("k", None)       # unguarded, two lines later
+
+        def spawn():
+            threading.Thread(target=worker).start()
+
+        def main_path():
+            with _LOCK:
+                SHARED["j"] = 2
+        """}
+    hits = [v for v in _plint(tmp_path, files)
+            if v.rule == "unlocked-shared-state"]
+    assert hits, "mixed guarded/unguarded access in one function missed"
+
+
+def test_fast_flag_skips_project_pass(tmp_path, capsys):
+    bad, _, _ = PROJECT_FIXTURES["lock-order"]
+    for rel, src in bad.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src), encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 1
+    assert jaxlint_main(["--check", str(tmp_path), "-q", "--fast"]) == 0
+    capsys.readouterr()
+
+
+def test_fast_run_does_not_kill_project_baseline_entries(tmp_path,
+                                                         capsys):
+    """A --fast run produces no project-rule findings; project-rule
+    baseline entries must be out of scope for it — neither DEAD
+    (exit 2) nor droppable by --prune/--update-baseline."""
+    bad, _, _ = PROJECT_FIXTURES["sharding-readiness"]
+    for rel, src in bad.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src), encoding="utf-8")
+    lex_rel, lex_bad, _, _ = FIXTURES["implicit-dtype"]
+    lex = tmp_path / lex_rel
+    lex.write_text(textwrap.dedent(lex_bad), encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--update-baseline"]) == 0
+    baseline = tmp_path / "jaxlint_baseline.json"
+    before = json.loads(baseline.read_text(encoding="utf-8"))
+    assert {e["rule"] for e in before["entries"]} == \
+        {"implicit-dtype", "sharding-readiness"}
+
+    # Fast check: green, not exit-2 on the "missing" project findings.
+    assert jaxlint_main(["--check", str(tmp_path), "-q", "--fast"]) == 0
+    # Fast prune/update: the project entry survives untouched.
+    assert jaxlint_main(["--check", str(tmp_path), "-q", "--fast",
+                         "--prune-baseline"]) == 0
+    assert jaxlint_main(["--check", str(tmp_path), "--fast",
+                         "--update-baseline"]) == 0
+    after = json.loads(baseline.read_text(encoding="utf-8"))
+    assert {e["rule"] for e in after["entries"]} == \
+        {"implicit-dtype", "sharding-readiness"}
+    # And the full run still gates green against it.
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 0
+    capsys.readouterr()
+
+
+def test_repo_fast_gate_is_green():
+    """Regression: the CI lint-fast job (`--check . --fast`) must not
+    trip over the committed project-rule baseline entries."""
+    rc = jaxlint_main(["--check", str(REPO_ROOT), "--fast", "-q"])
+    assert rc == 0
+
+
+def test_warn_tier_reports_but_does_not_gate(tmp_path, capsys):
+    bad, _, _ = PROJECT_FIXTURES["sharding-readiness"]
+    for rel, src in bad.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src), encoding="utf-8")
+    rc = jaxlint_main(["--check", str(tmp_path)])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "warning:" in out.out and "sharding-readiness" in out.out
+
+
+# ---------------------------------------------------------------------------
+# SARIF export
+# ---------------------------------------------------------------------------
+
+
+_SARIF_LEVELS = {"none", "note", "warning", "error"}
+
+
+def _validate_sarif_210(doc: dict) -> None:
+    """Structural validation against the SARIF 2.1.0 schema's required
+    properties (the full JSON schema needs a network fetch CI does not
+    have; these are the MUST constraints for tool output: §3.13 log
+    file, §3.14 runs, §3.19 tool/driver, §3.27 results, §3.28-3.30
+    locations)."""
+    assert doc["version"] == "2.1.0"
+    assert isinstance(doc["$schema"], str) and "sarif" in doc["$schema"]
+    assert isinstance(doc["runs"], list) and doc["runs"]
+    for run in doc["runs"]:
+        driver = run["tool"]["driver"]
+        assert isinstance(driver["name"], str) and driver["name"]
+        rules = driver.get("rules", [])
+        ids = [r["id"] for r in rules]
+        assert len(ids) == len(set(ids))
+        for rule in rules:
+            assert rule["shortDescription"]["text"]
+        for res in run.get("results", []):
+            assert isinstance(res["message"]["text"], str)
+            assert res["level"] in _SARIF_LEVELS
+            assert res["ruleId"] in ids
+            if "ruleIndex" in res:
+                assert rules[res["ruleIndex"]]["id"] == res["ruleId"]
+            for loc in res["locations"]:
+                phys = loc["physicalLocation"]
+                assert isinstance(
+                    phys["artifactLocation"]["uri"], str)
+                region = phys["region"]
+                assert region["startLine"] >= 1
+                assert region.get("startColumn", 1) >= 1
+
+
+def test_sarif_output_validates(tmp_path, capsys):
+    files = dict(PROJECT_FIXTURES["lock-order"][0])
+    files["ops/poisson_sparse.py"] = PROJECT_FIXTURES[
+        "sharding-readiness"][0]["ops/poisson_sparse.py"]
+    files["ops/lex.py"] = FIXTURES["implicit-dtype"][1]
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src), encoding="utf-8")
+    sarif_path = tmp_path / "out.sarif"
+    rc = jaxlint_main(["--check", str(tmp_path), "-q",
+                       "--sarif", str(sarif_path)])
+    capsys.readouterr()
+    assert rc == 1  # lock-order + implicit-dtype are error tier
+    doc = json.loads(sarif_path.read_text(encoding="utf-8"))
+    _validate_sarif_210(doc)
+    results = doc["runs"][0]["results"]
+    by_rule = {r["ruleId"]: r["level"] for r in results}
+    assert by_rule["lock-order"] == "error"
+    assert by_rule["sharding-readiness"] == "warning"
+    assert by_rule["implicit-dtype"] == "error"
+
+
+def test_sarif_written_even_when_clean(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text("x = 1\n", encoding="utf-8")
+    sarif_path = tmp_path / "clean.sarif"
+    assert jaxlint_main(["--check", str(tmp_path), "-q",
+                         "--sarif", str(sarif_path)]) == 0
+    capsys.readouterr()
+    doc = json.loads(sarif_path.read_text(encoding="utf-8"))
+    _validate_sarif_210(doc)
+    assert doc["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
 # Baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -323,6 +861,114 @@ def test_baseline_grandfathers_then_ratchets(tmp_path):
     # Fixing violations leaves a STALE entry (ratchet-down signal).
     new, grandfathered, stale = apply_baseline([], doc)
     assert not new and stale
+
+
+def test_dead_baseline_entry_fails_check_and_prunes(tmp_path, capsys):
+    """Baseline hygiene: entries matching NO current violation are dead
+    weight (the ratchet can never fire for them) — `--check` exits 2
+    until `--prune-baseline` rewrites the file."""
+    rel_path, bad, _, _ = FIXTURES["implicit-dtype"]
+    path = tmp_path / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(bad), encoding="utf-8")
+    baseline = tmp_path / "jaxlint_baseline.json"
+    baseline.write_text(json.dumps({"entries": [
+        {"path": rel_path, "rule": "implicit-dtype", "count": 1,
+         "justification": "live"},
+        {"path": "ops/gone.py", "rule": "implicit-dtype", "count": 2,
+         "justification": "file was deleted two PRs ago"},
+    ]}), encoding="utf-8")
+
+    assert jaxlint_main(["--check", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert "DEAD baseline entry ops/gone.py" in err
+
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--prune-baseline"]) == 0
+    capsys.readouterr()
+    doc = json.loads(baseline.read_text(encoding="utf-8"))
+    assert [e["path"] for e in doc["entries"]] == [rel_path]
+    assert doc["entries"][0]["justification"] == "live"  # survives
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 0
+    capsys.readouterr()
+
+
+def test_stale_but_alive_entry_only_warns(tmp_path, capsys):
+    """count dropped but > 0: a warning and a ratchet-down hint, not a
+    failure (distinguished from DEAD — the pair still matches code)."""
+    rel_path, bad, _, _ = FIXTURES["implicit-dtype"]
+    path = tmp_path / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(bad), encoding="utf-8")
+    (tmp_path / "jaxlint_baseline.json").write_text(json.dumps({
+        "entries": [{"path": rel_path, "rule": "implicit-dtype",
+                     "count": 3, "justification": "was three"}]}),
+        encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path)]) == 0
+    err = capsys.readouterr().err
+    assert "stale baseline entry" in err and "DEAD" not in err
+
+    # --prune-baseline ratchets the count down to what exists.
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--prune-baseline"]) == 0
+    capsys.readouterr()
+    doc = json.loads(
+        (tmp_path / "jaxlint_baseline.json").read_text(encoding="utf-8"))
+    assert doc["entries"][0]["count"] == 1
+
+
+def test_dead_entry_outside_subtree_coverage_is_kept(tmp_path, capsys):
+    """A subtree run must neither fail on nor prune entries for paths it
+    did not lint — it cannot know whether they are dead."""
+    rel_path, bad, _, _ = FIXTURES["implicit-dtype"]
+    path = tmp_path / "a" / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(bad), encoding="utf-8")
+    baseline = tmp_path / "jaxlint_baseline.json"
+    baseline.write_text(json.dumps({"entries": [
+        {"path": f"a/{rel_path}", "rule": "implicit-dtype", "count": 1,
+         "justification": "live"},
+        {"path": "b/ops/other.py", "rule": "implicit-dtype", "count": 1,
+         "justification": "b/ is not being linted here"},
+    ]}), encoding="utf-8")
+    assert jaxlint_main(["--check", str(tmp_path / "a")]) == 0
+    assert jaxlint_main(["--check", str(tmp_path / "a"),
+                         "--prune-baseline"]) == 0
+    capsys.readouterr()
+    doc = json.loads(baseline.read_text(encoding="utf-8"))
+    assert [e["path"] for e in doc["entries"]] == \
+        [f"a/{rel_path}", "b/ops/other.py"]
+
+
+def test_subtree_run_does_not_kill_filter_stripped_entries(tmp_path,
+                                                           capsys):
+    """`--check <pkg>/ops` renames `ops/decode.py` to `decode.py`, so
+    the `ops/`-path-filtered rule never runs there — its baseline entry
+    is OUT OF SCOPE for that run: not DEAD (exit 2) and untouchable by
+    --prune-baseline (the real-repo shape that once deleted the live
+    decode.py implicit-dtype entry)."""
+    rel_path, bad, _, _ = FIXTURES["implicit-dtype"]
+    path = tmp_path / "pkg" / rel_path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(bad), encoding="utf-8")
+    baseline = tmp_path / "jaxlint_baseline.json"
+    assert jaxlint_main(["--check", str(tmp_path),
+                         "--update-baseline"]) == 0
+    before = json.loads(baseline.read_text(encoding="utf-8"))
+    assert [e["path"] for e in before["entries"]] == [f"pkg/{rel_path}"]
+
+    # Subtree run where the rule's path_filter no longer matches:
+    # green, not exit-2.
+    assert jaxlint_main(["--check", str(tmp_path / "pkg" / "ops"),
+                         "-q"]) == 0
+    # And prune from that subtree leaves the live entry alone.
+    assert jaxlint_main(["--check", str(tmp_path / "pkg" / "ops"), "-q",
+                         "--prune-baseline"]) == 0
+    after = json.loads(baseline.read_text(encoding="utf-8"))
+    assert after["entries"] == before["entries"]
+    # Full run still gates green against the preserved entry.
+    assert jaxlint_main(["--check", str(tmp_path), "-q"]) == 0
+    capsys.readouterr()
 
 
 def test_make_baseline_keeps_justifications(tmp_path):

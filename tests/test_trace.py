@@ -202,14 +202,14 @@ def test_prometheus_text_includes_tracer_spans():
         time.sleep(0.002)
     text = reg.prometheus_text(tracer=tr)
     assert 'sl_span_seconds_total{span="scan360.register"}' in text
-    assert 'sl_span_count{span="scan360.register"} 1' in text
+    assert 'sl_span_count_total{span="scan360.register"} 1' in text
     assert 'sl_span_max_seconds{span="scan360.register"}' in text
 
 
 def test_prometheus_span_exposition_conformance():
     """Counters carry the `_total` suffix and every span family has a
-    HELP line; the unsuffixed sl_span_count stays one release as a
-    deprecated alias."""
+    HELP line. The PR-5 deprecated `sl_span_count` alias served its one
+    release and is GONE — dashboards scrape sl_span_count_total."""
     reg = trace.MetricsRegistry()
     tr = trace.Tracer()
     with tr.span("stage"):
@@ -219,10 +219,11 @@ def test_prometheus_span_exposition_conformance():
     assert "# HELP sl_span_count_total " in text
     assert "# TYPE sl_span_count_total counter" in text
     assert 'sl_span_count_total{span="stage"} 1' in text
-    assert "# HELP sl_span_count deprecated alias" in text
     assert "# HELP sl_span_max_seconds " in text
-    # Alias agrees with the conforming family.
-    assert 'sl_span_count{span="stage"} 1' in text
+    # The retired alias must not resurface (a bare sl_span_count sample
+    # or TYPE/HELP line would double-count spans on migrated dashboards).
+    assert "sl_span_count{" not in text
+    assert "# TYPE sl_span_count counter" not in text
 
 
 def test_label_escaping():

@@ -42,6 +42,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "screened Poisson; the full scan→print path in one "
                         "command)")
     p.add_argument("--mesh-depth", type=int, default=8)
+    s = p.add_argument_group("streaming (docs/STREAMING.md)")
+    s.add_argument("--stream", action="store_true",
+                   help="fuse stops INCREMENTALLY (stream/): per-stop "
+                        "coarse mesh previews while later stops are "
+                        "still being read, covisibility gate on "
+                        "redundant stops, same final merge math")
+    s.add_argument("--preview-out", default=None, metavar="PATH",
+                   help="progressive preview STL path (default "
+                        "<output>.preview.stl), rewritten after every "
+                        "fused stop")
+    s.add_argument("--preview-depth", type=int, default=6,
+                   help="coarse Poisson depth of the per-stop previews")
+    s.add_argument("--preview-every", type=int, default=1,
+                   help="emit a preview every N fused stops (0 = off)")
     g = p.add_argument_group("quality gates (docs/ROBUSTNESS.md)")
     g.add_argument("--no-gates", action="store_true",
                    help="disable the quality gates (abort-on-anything "
@@ -130,6 +144,10 @@ def main(argv=None) -> int:
             labs = [round(a / step_deg) for a in angles]
             if labs == sorted(set(labs)):
                 stop_labels = labs
+    if args.stream:
+        return _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
+                           health)
+
     params = scan360.Scan360Params(
         merge=merge.MergeParams(voxel_size=args.voxel_size,
                                 max_points=args.max_points,
@@ -164,6 +182,95 @@ def main(argv=None) -> int:
                             "merged cloud kept at %s", args.stl, args.output)
             print(f"meshed -> {args.stl} ({len(mesh.faces)} faces)",
                   file=sys.stderr)
+    health.emit()
+    if args.health_json:
+        health.write(args.health_json)
+    return 0
+
+
+def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
+                health) -> int:
+    """``--stream``: replay the stop folders through an incremental
+    session — progressive previews after every fused stop, same final
+    merge math as the batch path (stream/, docs/STREAMING.md)."""
+    import math
+    import time
+
+    from ..io import images as img_io
+    from ..io import matcal
+    from ..io import ply as ply_io
+    from ..io.stl import write_stl
+    from ..models import merge
+    from ..stream import IncrementalSession, StreamParams
+
+    first = img_io.load_stack(stop_dirs[0])
+    _, h, w = first.shape
+    cal = matcal.load_calibration_mat(args.calib, h, w)
+    col_bits = math.ceil(math.log2(cal.plane_cols.shape[0]))
+    row_bits = math.ceil(math.log2(cal.plane_rows.shape[0]))
+    expect = 2 + 2 * (col_bits + row_bits)
+    if first.shape[0] != expect:
+        raise SystemExit(
+            f"stack has {first.shape[0]} frames but {col_bits}+{row_bits} "
+            f"bits imply {expect}")
+    labels = stop_labels or list(range(len(stop_dirs)))
+    params = StreamParams(
+        merge=merge.MergeParams(voxel_size=args.voxel_size,
+                                max_points=args.max_points,
+                                step_deg=step_deg),
+        method=args.method,
+        gates=gates,
+        preview_depth=args.preview_depth,
+        preview_every=args.preview_every,
+        final_depth=args.mesh_depth,
+        expected_stops=max(labels) + 1)
+    sess = IncrementalSession(cal, col_bits, row_bits, params=params,
+                              health=health)
+    preview_path = args.preview_out or (args.output + ".preview.stl")
+    t0 = time.monotonic()
+    first_preview_s = None
+    for k, d in enumerate(stop_dirs):
+        stack = first if k == 0 else img_io.load_stack(d)
+        res = sess.add_stop(stack, stop=labels[k])
+        line = (f"stop {labels[k]}: {res.reason} "
+                f"(coverage {res.coverage:.3f}"
+                + (f", fitness {res.fitness:.3f}" if res.fitness is not None
+                   else "")
+                + f", {res.seconds:.1f}s)")
+        print(line, file=sys.stderr)
+        if res.preview and sess.preview is not None:
+            write_stl(preview_path, sess.preview)
+            if first_preview_s is None:
+                first_preview_s = time.monotonic() - t0
+                print(f"first preview {first_preview_s:.1f}s after stop "
+                      f"{labels[k]} -> {preview_path} "
+                      f"({len(sess.preview.faces)} faces)",
+                      file=sys.stderr)
+    from ..health import ScanFault
+
+    try:
+        fin = sess.finalize(mesh=bool(args.stl))
+    except ScanFault as e:
+        # Degraded-capture terminal guard: too few fused stops (gates /
+        # covisibility skipped the rest) must end with the health story
+        # and whatever preview exists, not a traceback.
+        health.note("stream finalize failed: %s", e)
+        print(f"finalize failed: {e}"
+              + (f" — latest preview kept at {preview_path}"
+                 if first_preview_s is not None else ""),
+              file=sys.stderr)
+        health.emit()
+        if args.health_json:
+            health.write(args.health_json)
+        return 1
+    ply_io.write_ply(args.output, fin.cloud)
+    print(f"{sess.stops_fused} fused / {sess.stops_skipped} skipped "
+          f"stops -> {args.output} ({len(fin.cloud)} points)",
+          file=sys.stderr)
+    if args.stl and fin.mesh is not None:
+        write_stl(args.stl, fin.mesh)
+        print(f"meshed -> {args.stl} ({len(fin.mesh.faces)} faces)",
+              file=sys.stderr)
     health.emit()
     if args.health_json:
         health.write(args.health_json)

@@ -59,6 +59,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "each shape will pay the compile)")
     p.add_argument("--mesh-depth", type=int, default=d.mesh_depth,
                    help="Poisson depth for STL results")
+    p.add_argument("--max-sessions", type=int, default=d.max_sessions,
+                   help="bounded live streaming-session registry "
+                        "(docs/STREAMING.md); above it POST /session "
+                        "gets a retryable 503")
+    p.add_argument("--preview-depth", type=int,
+                   default=d.stream.preview_depth,
+                   help="coarse Poisson depth of per-stop session "
+                        "previews (finalize uses the full depth)")
     p.add_argument("--proj-width", type=int, default=d.proj.width,
                    help="projector width (fixes the protocol bit count)")
     p.add_argument("--proj-height", type=int, default=d.proj.height)
@@ -98,6 +106,9 @@ def main(argv=None) -> int:
               f"{args.buckets!r} — pass the single HxW matching the "
               "calibration's camera", file=sys.stderr)
         return 2
+    import dataclasses
+
+    defaults = ServeConfig()
     config = ServeConfig(
         proj=proj,
         queue_depth=args.queue_depth,
@@ -106,7 +117,10 @@ def main(argv=None) -> int:
         buckets=buckets,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(",")),
         warmup=not args.no_warmup,
-        mesh_depth=args.mesh_depth)
+        mesh_depth=args.mesh_depth,
+        max_sessions=args.max_sessions,
+        stream=dataclasses.replace(defaults.stream,
+                                   preview_depth=args.preview_depth))
 
     calib_provider = None
     if args.calib is not None:

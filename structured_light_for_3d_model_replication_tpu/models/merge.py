@@ -502,6 +502,41 @@ def _preprocess_fn(voxel: float, normals_k: int, fpfh_max_nn: int,
 
 
 
+def preprocess_registration_view(points, valid, params: MergeParams):
+    """One scan's registration preprocess (voxel → normals → FPFH)
+    through the SAME compiled program the ring strategies use — the
+    per-stop half of an incremental (streaming) ring, where stops arrive
+    one at a time but must hit the already-warm programs. Returns the
+    ``(pts, valid, normals, feat)`` tuple the edge program consumes."""
+    prep = _preprocess_fn(params.voxel_size, params.normals_k,
+                          params.fpfh_max_nn, params.fpfh_engine,
+                          params.fpfh_slots, params.fpfh_max_cells)
+    out = prep(points, valid)
+    # prep is jitted, so the eager overflow warning inside _preprocess was
+    # silenced at trace time — surface the now-concrete count (same
+    # discipline as register_sequence's loop strategy).
+    features_brick.emit_overflow_warning(out[4], jnp.sum(out[1]))
+    return out[:4]
+
+
+def register_edge(src_prep, dst_prep, params: MergeParams, key=None,
+                  hint=None):
+    """One ring edge — src registered onto dst — through the compiled
+    edge program (`_edge_fn`): the per-edge half of an incremental ring.
+    ``src_prep``/``dst_prep`` are :func:`preprocess_registration_view`
+    outputs; ``hint`` seeds the RANSAC/ICP candidate set (pass the
+    previous edge's transform — a turntable advances by a constant step).
+    Returns ``(T, fitness, rmse, info)`` device values."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if hint is None:
+        hint = jnp.eye(4, dtype=jnp.float32)
+    s_pts, s_val, _, s_feat = src_prep
+    d_pts, d_val, d_nrm, d_feat = dst_prep
+    return _edge_fn(params)(s_pts, s_val, s_feat, d_pts, d_val, d_nrm,
+                            d_feat, key, hint)
+
+
 def _register_preprocessed(src, dst, params: MergeParams, key=None):
     """Pair registration on already-preprocessed (pts, valid, normals, feat)
     tuples — lets ring workflows preprocess each scan ONCE even though every

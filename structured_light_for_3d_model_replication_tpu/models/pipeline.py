@@ -76,11 +76,26 @@ def reconstruct_batch_fn(col_bits: int, row_bits: int,
     """Jitted vmapped batch variant: (B, F, H, W) stacks + shared calib →
     CloudResult batched on the leading axis. Memoized on the (hashable,
     frozen) config args so repeat calls hit jit's compile cache instead of
-    re-tracing a fresh closure."""
+    re-tracing a fresh closure.
+
+    The stack argument is DONATED: at 1080p a B=8 batch is ~760 MB of
+    uint8 that nothing reads after decode, and releasing it during
+    execution is the per-chip memory headroom the multi-chip plan needs
+    (sharding-readiness, docs/JAXLINT.md). Callers must stage a fresh
+    device buffer per call — every in-repo caller already does (serve
+    workers re-stage each batch, scan360 stages per chunk, the sharded
+    path device_puts per call). The uint8 input cannot alias the float32
+    outputs, so XLA notes the donation as "not usable" for aliasing at
+    compile time; the early release still stands. ``in_shardings=None``
+    leaves placement to propagation (committed shardings pass through —
+    the `parallel/` path relies on that) while making the annotation
+    explicit for the multi-chip flip."""
 
     def single(stack, calib):
         return reconstruct(stack, calib, col_bits, row_bits,
                            decode_cfg=decode_cfg, tri_cfg=tri_cfg,
                            downsample=downsample)
 
-    return jax.jit(jax.vmap(single, in_axes=(0, None)))
+    return jax.jit(jax.vmap(single, in_axes=(0, None)),
+                   donate_argnums=(0,),
+                   in_shardings=None, out_shardings=None)

@@ -174,7 +174,18 @@ def _subsample_views_body(view_cap: int, m_reg: int):
 
 @functools.lru_cache(maxsize=None)
 def _subsample_views_fn(view_cap: int, m_reg: int):
-    return jax.jit(_subsample_views_body(view_cap, m_reg))
+    # The dense per-stop decode buffers (N, ~2M, 3) are DONATED: nothing
+    # reads them after the subsample gathers (both callers take coverage
+    # and shapes beforehand), and at 24×1080p they are ~600 MB of HBM
+    # released during the gather instead of held to the end of the stage
+    # — the sharding-readiness contract (docs/JAXLINT.md). The gathered
+    # outputs are smaller than the inputs, so XLA reports the donation as
+    # un-aliasable at compile; the early release still stands. Callers
+    # must treat the passed arrays as consumed (every in-repo caller's
+    # buffers are dead after this call).
+    return jax.jit(_subsample_views_body(view_cap, m_reg),
+                   donate_argnums=(0, 1, 2),
+                   in_shardings=None, out_shardings=None)
 
 
 @functools.lru_cache(maxsize=None)
@@ -257,8 +268,14 @@ def _tail_body(params: Scan360Params, n: int, m_reg: int, view_cap: int):
 @functools.lru_cache(maxsize=None)
 def _fused_tail_fn(params: Scan360Params, n: int, m_reg: int,
                    view_cap: int):
-    """The post-decode tail as its own single launch (streaming path)."""
-    return jax.jit(_tail_body(params, n, m_reg, view_cap))
+    """The post-decode tail as its own single launch (streaming path).
+
+    The accumulated dense clouds are donated (same rationale and caller
+    contract as :func:`_subsample_views_fn` — the streaming path holds
+    the whole session's decode output only until this launch)."""
+    return jax.jit(_tail_body(params, n, m_reg, view_cap),
+                   donate_argnums=(0, 1, 2),
+                   in_shardings=None, out_shardings=None)
 
 
 @functools.lru_cache(maxsize=None)
@@ -398,6 +415,12 @@ def scan_stacks_to_cloud(
                 part = stacks[s:s + chunk]
                 if isinstance(part, np.ndarray):
                     part = jax.device_put(jnp.asarray(part))
+                elif part is stacks:
+                    # jnp full-range slicing short-circuits to the SAME
+                    # array, and the decode program donates its stack
+                    # argument — the caller's buffer must not be the one
+                    # handed over (single-chunk device sessions).
+                    part = jnp.array(part, copy=True)
                 r = recon(part, calib)
                 pts_p.append(r.points)
                 col_p.append(r.colors)
@@ -830,6 +853,54 @@ def scan_stream_to_cloud(
         timing["stops"] = n
         timing["chunk"] = chunk
     return result
+
+
+# ---------------------------------------------------------------------------
+# Incremental (per-stop) entry points — the building blocks of stream/
+# ---------------------------------------------------------------------------
+
+
+def decode_stop(stack, calib, col_bits: int, row_bits: int,
+                decode_cfg: DecodeConfig = DecodeConfig(),
+                tri_cfg: TriangulationConfig = TriangulationConfig()):
+    """ONE stop decoded+triangulated through the SAME compiled batch
+    program (B=1 lane) every other path uses — the per-stop half of an
+    incremental session (`stream/`). ``stack`` is (F, H, W) uint8, host
+    or device. Returns ``(points (P, 3) f32, colors (P, 3), valid (P,))``
+    device arrays; the staged batch copy is donated to the program, the
+    caller's ``stack`` is untouched."""
+    if stack.ndim != 3:
+        raise ValueError(f"stack must be (frames, H, W), got shape "
+                         f"{stack.shape}")
+    recon = pipeline_mod.reconstruct_batch_fn(col_bits, row_bits,
+                                              decode_cfg, tri_cfg)
+    if isinstance(stack, np.ndarray):
+        part = jax.device_put(jnp.asarray(stack[None]))
+    else:
+        part = stack[None]  # expand_dims executes → a fresh donated buffer
+    r = recon(part, calib)
+    return r.points[0], r.colors[0], r.valid[0]
+
+
+def subsample_stop(points, colors, valid, view_cap: int, m_reg: int):
+    """One stop's merge + registration views via the shared compiled
+    subsample program (stop axis of 1 — compiled once, reused every
+    stop). ``view_cap``/``m_reg`` must already be rounded the way the
+    batch path rounds them (see :func:`stop_view_sizes`). The staged
+    [None] copies are donated; the caller's arrays are untouched.
+    Returns ``(sub_pts, sub_col, sub_val, reg_pts, reg_val)``."""
+    out = _subsample_views_fn(view_cap, m_reg)(
+        points[None], colors[None], valid[None])
+    return tuple(a[0] for a in out)
+
+
+def stop_view_sizes(params: Scan360Params, n_pixels: int):
+    """The (view_cap, m_reg) the batch path derives for ``n_pixels``-pixel
+    stops — one derivation, so incremental sessions subsample identically
+    to :func:`scan_stacks_to_cloud`."""
+    m_reg = min(merge_mod._round_up(params.merge.max_points), n_pixels)
+    view_cap = merge_mod._round_up(min(params.view_cap, n_pixels))
+    return view_cap, m_reg
 
 
 def scan_folders_to_cloud(

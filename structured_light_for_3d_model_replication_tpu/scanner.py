@@ -292,6 +292,7 @@ class Scanner:
         on_progress: Callable[[ScanProgress], None] | None = None,
         health: ScanHealthReport | None = None,
         scan_id: str | None = None,
+        on_stop: Callable[[int, str], None] | None = None,
     ) -> list[str]:
         """The flagship capture loop (`server/gui.py:686-773`). Returns the
         list of per-stop folders (``{base}_{angle}deg_scan``) that hold a
@@ -310,6 +311,13 @@ class Scanner:
         at the correct angles iff the turntable starts at the 0° home
         position (re-home the table — or restart the virtual rig, whose
         simulated table boots at 0°).
+
+        ``on_stop`` is the STREAMING hook (docs/STREAMING.md): called with
+        ``(stop_index, folder)`` the moment a stop's complete stack is on
+        disk (captured or resumed) — feed it to a
+        `stream.IncrementalSession` to fuse stops while the turntable is
+        still moving. Consumer failures are CONTAINED (logged + journaled);
+        a broken preview pipeline must never abort a 20-minute capture.
         """
         health = health if health is not None else ScanHealthReport()
         scan_id = scan_id or uuid.uuid4().hex[:12]
@@ -331,14 +339,17 @@ class Scanner:
             # stop's capture — frame retries, exhausted stops — carries
             # the scan_id + stop index into the flight journal.
             with events.context(scan_id=scan_id, stop=i):
+                landed = False
                 if out in done_before:
                     log.info("stop %d/%d (%.0f°) already complete — "
                              "resumed past", i + 1, turns, angle)
                     rec.status = "resumed"
                     stops.append(out)
+                    landed = True
                 elif self._capture_stop(out, dwell_ms, rec):
                     captured += 1
                     stops.append(out)
+                    landed = True
                 else:
                     log.error("stop %d/%d (%.0f°) failed after %d stop "
                               "attempts — skipping (degraded ring)", i + 1,
@@ -348,6 +359,18 @@ class Scanner:
                         message=f"stop {i} exhausted "
                                 f"{self.retry.stop_attempts} attempts",
                         angle_deg=angle)
+
+                if landed and on_stop is not None:
+                    try:
+                        on_stop(i, out)
+                    except Exception as e:
+                        # Containment: the streaming consumer (fusion,
+                        # previews) is best-effort relative to capture.
+                        log.warning("on_stop consumer failed at stop %d:"
+                                    " %s", i, e)
+                        events.record("stream_consumer_failed",
+                                      severity="warning", message=str(e),
+                                      exc_type=type(e).__name__)
 
                 if on_progress is not None:
                     elapsed = time.monotonic() - t0

@@ -55,6 +55,7 @@ from .jobs import (
     StackFormatError,
 )
 from .service import ReconstructionService, ServeConfig, ServeHTTPServer
+from .sessions import SessionLimitError, SessionManager, UnknownSessionError
 from .worker import DeviceWorker
 
 __all__ = [
@@ -74,6 +75,9 @@ __all__ = [
     "ServeConfig",
     "ServeError",
     "ServeHTTPServer",
+    "SessionLimitError",
+    "SessionManager",
     "StackFormatError",
+    "UnknownSessionError",
     "bucket_for",
 ]

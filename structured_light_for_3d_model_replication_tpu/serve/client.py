@@ -141,6 +141,100 @@ class ServeClient:
                 f"job {job_id} failed: {st.get('error')}", st)
         return self.result(job_id), st
 
+    # -- streaming sessions (docs/STREAMING.md) ------------------------
+
+    def create_session(self, **options) -> str:
+        """POST /session → session id. ``options`` are the per-session
+        overrides the server allows (preview_depth, expected_stops, …)."""
+        req = urllib.request.Request(
+            self.base_url + "/session",
+            data=json.dumps(options).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        status, hdrs, body = self._request(req)
+        payload = self._payload(body)
+        if status in (429, 503):
+            raise BackpressureError(
+                f"session refused ({status})",
+                payload.get("error", {}).get("retry_after_s"), payload)
+        if status != 200:
+            raise ServeClientError(
+                f"create_session failed ({status}): {payload}", payload)
+        return payload["session_id"]
+
+    def submit_stop(self, session_id: str, stack: np.ndarray) -> str:
+        """POST one stop's capture stack into a session; returns the
+        stop job id (poll with :meth:`wait` — its result meta carries
+        the fuse/skip decision)."""
+        stack = np.asarray(stack)
+        if stack.dtype != np.uint8:
+            raise ServeClientError(
+                f"stack must be uint8, got {stack.dtype}")
+        buf = io.BytesIO()
+        np.save(buf, stack)
+        req = urllib.request.Request(
+            f"{self.base_url}/session/{session_id}/stop",
+            data=buf.getvalue(),
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST")
+        status, hdrs, body = self._request(req)
+        payload = self._payload(body)
+        if status in (429, 503):
+            retry = payload.get("error", {}).get("retry_after_s")
+            if retry is None and hdrs.get("Retry-After"):
+                retry = float(hdrs["Retry-After"])
+            raise BackpressureError(
+                f"stop refused ({status})", retry, payload)
+        if status != 200:
+            raise ServeClientError(
+                f"submit_stop failed ({status}): {payload}", payload)
+        return payload["job_id"]
+
+    def session_status(self, session_id: str) -> dict:
+        status, _, body = self._request(urllib.request.Request(
+            f"{self.base_url}/session/{session_id}"))
+        payload = self._payload(body)
+        if status != 200:
+            raise ServeClientError(
+                f"session_status failed ({status}): {payload}", payload)
+        return payload
+
+    def preview(self, session_id: str) -> tuple[bytes, dict] | None:
+        """Latest progressive preview STL, or None before the first
+        preview (HTTP 409)."""
+        status, hdrs, body = self._request(urllib.request.Request(
+            f"{self.base_url}/session/{session_id}/preview"))
+        if status == 409:
+            return None
+        if status != 200:
+            raise ServeClientError(
+                f"preview failed ({status})", self._payload(body))
+        meta = {k[2:].lower().replace("-", "_"): v
+                for k, v in hdrs.items() if k.startswith("X-")}
+        return body, meta
+
+    def finalize_session(self, session_id: str,
+                         result_format: str = "stl") -> dict:
+        """POST finalize; returns {"job_id", "status", "result"} — fetch
+        the artifact with :meth:`result`."""
+        req = urllib.request.Request(
+            f"{self.base_url}/session/{session_id}/finalize",
+            data=json.dumps({"result_format": result_format}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        status, _, body = self._request(req)
+        payload = self._payload(body)
+        if status != 200:
+            raise ServeClientError(
+                f"finalize failed ({status}): {payload}", payload)
+        return payload
+
+    def delete_session(self, session_id: str) -> None:
+        req = urllib.request.Request(
+            f"{self.base_url}/session/{session_id}", method="DELETE")
+        status, _, body = self._request(req)
+        if status != 200:
+            raise ServeClientError(
+                f"delete_session failed ({status})", self._payload(body))
+
     # ------------------------------------------------------------------
 
     def healthz(self) -> dict:

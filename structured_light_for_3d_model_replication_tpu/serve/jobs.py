@@ -144,6 +144,14 @@ class Job:
     # against submitted without every layer knowing the registry.
     on_terminal: "callable | None" = dataclasses.field(
         default=None, repr=False)
+    # Streaming interop (serve/sessions.py): when set, the worker hands
+    # this job's decoded dense arrays (points, colors, valid — the
+    # per-job batch lanes) to the sink instead of building a PLY/STL;
+    # the sink's dict return becomes the job's result meta and JSON
+    # payload. Session stops ride the SAME queue → batcher → program
+    # cache as one-shot jobs, so they coalesce into the same batches.
+    decode_sink: "callable | None" = dataclasses.field(
+        default=None, repr=False)
 
     submitted_t: float = 0.0
     started_t: float | None = None

@@ -42,6 +42,7 @@ import numpy as np
 
 from ..config import DecodeConfig, ProjectorConfig, TriangulationConfig
 from ..health import QualityGates
+from ..stream import StreamParams
 from ..utils import events, telemetry, trace
 from ..utils.log import get_logger
 from .batcher import BucketBatcher, BucketKey
@@ -55,13 +56,15 @@ from .jobs import (
     StackFormatError,
     error_payload,
 )
+from .sessions import SessionManager, UnknownSessionError
 from .worker import DeviceWorker
 
 log = get_logger(__name__)
 
 _PRIORITY_NAMES = {"high": 0, "normal": 1, "low": 2}
 _CONTENT_TYPES = {"ply": "application/x-ply",
-                  "stl": "model/stl"}
+                  "stl": "model/stl",
+                  "json": "application/json"}  # session-stop payloads
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,6 +93,15 @@ class ServeConfig:
     # sl_compile_seconds, device-memory gauges and the recompile-storm
     # detector on this service's /metrics.
     telemetry: bool = True
+    # Streaming sessions (docs/STREAMING.md): per-session incremental
+    # fusion defaults and the bounded live-session cap. Per-session
+    # overrides are limited to the non-compiling surface
+    # (`sessions.SESSION_OPTION_KEYS`).
+    stream: StreamParams = StreamParams()
+    max_sessions: int = 8
+    # Idle expiry for sessions (live AND finalized): a crashed client's
+    # abandoned session frees its slot + model buffers after this.
+    session_ttl_s: float = 3600.0
 
 
 def synthetic_calib_provider(proj: ProjectorConfig):
@@ -194,6 +206,10 @@ class ReconstructionService:
         self._events_seen: dict[str, int] = {}  # _sync_event_counters
         self._events_seen_lock = threading.Lock()
         self._warmup_report: dict = {}
+        self.sessions = SessionManager(
+            config.stream, config.proj, config.decode_cfg, config.tri_cfg,
+            max_sessions=config.max_sessions,
+            session_ttl_s=config.session_ttl_s)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -316,6 +332,112 @@ class ReconstructionService:
                 f"{list(cfg.buckets)} (min 8x8)")
         return stack
 
+    # -- streaming sessions (docs/STREAMING.md) ----------------------------
+
+    def create_session(self, options: dict | None = None) -> dict:
+        """``POST /session``: open a streaming session. Refused while
+        draining (same rule as submissions) or past ``max_sessions``."""
+        if self._draining:
+            from .jobs import QueueClosedError
+
+            self._jobs_total("rejected").inc()
+            raise QueueClosedError()
+        try:
+            entry = self.sessions.create(options)
+        except JobRejected:
+            self._jobs_total("rejected").inc()
+            raise
+        return {"session_id": entry.session_id,
+                "scan_id": entry.session.scan_id}
+
+    def submit_session_stop(self, session_id: str,
+                            stack: np.ndarray) -> Job:
+        """``POST /session/<id>/stop``: admit one stop through the SAME
+        queue → batcher → program-cache lane as one-shot jobs; the
+        decoded arrays are handed to the session instead of a writer.
+        Returns the live Job (its meta carries the fuse/skip decision)."""
+        entry = self.sessions.get(session_id)
+        cfg = self.config
+        try:
+            stack = self._validate_stack(stack)
+            job = Job(stack=stack, col_bits=cfg.proj.col_bits,
+                      row_bits=cfg.proj.row_bits,
+                      decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
+                      result_format="json")
+            job.decode_sink = entry.ingest
+            job.on_terminal = self._on_terminal
+            self.queue.submit(job)
+            self._register(job)
+        except JobRejected:
+            self._jobs_total("rejected").inc()
+            raise
+        entry.note_pending(job)
+        with entry.lock:
+            entry.stops_submitted += 1
+        self._jobs_total("submitted").inc()
+        self._queue_gauge.set(self.queue.depth())
+        return job
+
+    def session_preview(self, session_id: str):
+        """``GET /session/<id>/preview``: latest progressive STL bytes +
+        meta, or None before the first preview."""
+        return self.sessions.get(session_id).preview_bytes()
+
+    def finalize_session(self, session_id: str,
+                         result_format: str = "stl") -> Job:
+        """``POST /session/<id>/finalize``: close the ring, build the
+        final artifact, and land it as a terminal job in the ordinary
+        registry — the existing ``GET /result`` path serves it. Runs on
+        the calling thread (one full pose solve + merge + mesh)."""
+        if result_format not in ("ply", "stl"):
+            raise StackFormatError(
+                f"result_format must be 'ply' or 'stl', "
+                f"got {result_format!r}")
+        entry = self.sessions.get(session_id)
+        cfg = self.config
+        # Settle in-flight stops FIRST (without the session lock — their
+        # sinks need it): a stop the client already got a 200 for must
+        # be fused or journaled before the ring closes. A stop that
+        # cannot settle inside the timeout surfaces as a 409 from the
+        # session's own guards rather than a silent exclusion.
+        entry.settle_pending(timeout_s=120.0)
+        with entry.lock:
+            if entry.result_job_id is not None:
+                job = self.get_job(entry.result_job_id)
+                if job is not None:
+                    return job  # idempotent finalize
+                from .sessions import SessionResultEvicted
+
+                raise SessionResultEvicted(
+                    f"session {session_id} finalized but its result "
+                    "job fell out of the bounded registry — the "
+                    "artifact is gone; re-scan")
+            result = entry.session.finalize(mesh=result_format == "stl")
+            if result_format == "stl":
+                from .worker import _stl_bytes
+
+                payload = _stl_bytes(result.mesh)
+                meta = {"vertices": int(len(result.mesh.vertices)),
+                        "faces": int(len(result.mesh.faces))}
+            else:
+                from .worker import _ply_bytes
+
+                payload = _ply_bytes(result.cloud)
+                meta = {}
+            meta.update(points=len(result.cloud),
+                        stops_fused=result.stats["stops_fused"],
+                        stops_skipped=result.stats["stops_skipped"])
+            job = Job(stack=np.empty((0, 0, 0), np.uint8),
+                      col_bits=cfg.proj.col_bits,
+                      row_bits=cfg.proj.row_bits,
+                      result_format=result_format)
+            job.on_terminal = self._on_terminal
+            self._jobs_total("submitted").inc()  # counter conservation
+            job.complete(payload, **meta)
+            self._register(job)
+            entry.result_job_id = job.job_id
+        return job
+
     def check_admission(self) -> None:
         """Headers-time backpressure probe for the HTTP layer: raises the
         rejection `submit_array` would, AND counts it — a refusal must hit
@@ -389,6 +511,7 @@ class ReconstructionService:
             "workers_alive": sum(w.alive for w in self.workers),
             "cache": self.cache.stats(),
             "warmup": self._warmup_report,
+            "sessions": self.sessions.stats(),
         }
 
     def metrics_text(self) -> str:
@@ -462,59 +585,92 @@ class _ServeHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------------
 
-    def do_POST(self):
-        # Early-error paths below respond WITHOUT reading the (possibly
-        # ~95 MB) body; under HTTP/1.1 keep-alive the unread bytes would
-        # desync the next request on the connection, so those paths close
-        # it (flag + explicit header so the client knows too).
-        if urlparse(self.path).path != "/submit":
+    def _reject(self, e: JobRejected) -> None:
+        """JobRejected → response mapping shared by every POST route."""
+        payload = error_payload(e)
+        retry = payload.get("retry_after_s")
+        status = 400
+        headers = []
+        if e.retryable:
+            status = 503 if retry is None else 429
+            if retry is not None:
+                headers.append(("Retry-After", str(max(1, round(retry)))))
+        if self.close_connection:  # body was never read (length gate)
+            headers.append(("Connection", "close"))
+        self._json({"error": payload}, status, headers)
+
+    def _read_stack_body(self):
+        """Read + decode an ``.npy`` POST body behind the headers-time
+        gates (length bound, queue backpressure) — the early-error paths
+        respond WITHOUT reading the (possibly ~95 MB) body; under
+        HTTP/1.1 keep-alive the unread bytes would desync the next
+        request on the connection, so those paths close it."""
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > MAX_SUBMIT_BYTES:
             self.close_connection = True
-            self._json({"error": "not found"}, 404,
-                       headers=(("Connection", "close"),))
-            return
+            # Counted here because this refusal never reaches the
+            # service's own counting gates (check_admission /
+            # submit_array) — transport-level refusals must hit the
+            # rejected counter too.
+            self.service._jobs_total("rejected").inc()
+            raise StackFormatError(
+                f"Content-Length {length} outside (0, "
+                f"{MAX_SUBMIT_BYTES}]")
+        # Backpressure at HEADERS time: when the queue is full or
+        # draining, reject before buffering the (~95 MB at 1080p)
+        # body — N overloaded connections must cost N sockets, not
+        # N stacks of transient RSS. submit_array/submit_session_stop
+        # below remain the authoritative (race-free) gates.
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            if length <= 0 or length > MAX_SUBMIT_BYTES:
+            self.service.check_admission()
+        except JobRejected:
+            self.close_connection = True
+            raise
+        body = self.rfile.read(length)
+        return np.load(io.BytesIO(body), allow_pickle=False)
+
+    def _read_json_body(self) -> dict:
+        """Small JSON POST body ({} when absent)."""
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0:
+            return {}
+        if length > (1 << 20):
+            self.close_connection = True
+            raise StackFormatError(f"JSON body too large ({length} B)")
+        body = self.rfile.read(length)
+        try:
+            out = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            raise StackFormatError("body must be a JSON object")
+        if not isinstance(out, dict):
+            raise StackFormatError("body must be a JSON object")
+        return out
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if url.path == "/submit":
+                stack = self._read_stack_body()
+                deadline = self.headers.get("X-Deadline-S")
+                job = self.service.submit_array(
+                    stack,
+                    result_format=self.headers.get("X-Result-Format",
+                                                   "ply"),
+                    priority=self.headers.get("X-Priority", "normal"),
+                    deadline_s=float(deadline) if deadline else None)
+                self._json({"job_id": job.job_id, "status": job.status})
+            elif parts and parts[0] == "session":
+                self._post_session(parts)
+            else:
                 self.close_connection = True
-                # Counted here because this refusal never reaches the
-                # service's own counting gates (check_admission /
-                # submit_array) — transport-level refusals must hit the
-                # rejected counter too.
-                self.service._jobs_total("rejected").inc()
-                raise StackFormatError(
-                    f"Content-Length {length} outside (0, "
-                    f"{MAX_SUBMIT_BYTES}]")
-            # Backpressure at HEADERS time: when the queue is full or
-            # draining, reject before buffering the (~95 MB at 1080p)
-            # body — N overloaded connections must cost N sockets, not
-            # N stacks of transient RSS. submit_array below remains the
-            # authoritative (race-free) gate.
-            try:
-                self.service.check_admission()
-            except JobRejected:
-                self.close_connection = True
-                raise
-            body = self.rfile.read(length)
-            stack = np.load(io.BytesIO(body), allow_pickle=False)
-            deadline = self.headers.get("X-Deadline-S")
-            job = self.service.submit_array(
-                stack,
-                result_format=self.headers.get("X-Result-Format", "ply"),
-                priority=self.headers.get("X-Priority", "normal"),
-                deadline_s=float(deadline) if deadline else None)
+                self._json({"error": "not found"}, 404,
+                           headers=(("Connection", "close"),))
         except JobRejected as e:
-            payload = error_payload(e)
-            retry = payload.get("retry_after_s")
-            status = 400
-            headers = []
-            if e.retryable:
-                status = 503 if retry is None else 429
-                if retry is not None:
-                    headers.append(("Retry-After", str(max(1, round(retry)))))
-            if self.close_connection:  # body was never read (length gate)
-                headers.append(("Connection", "close"))
-            self._json({"error": payload}, status, headers)
-            return
+            self._reject(e)
+        except UnknownSessionError as e:
+            self._json({"error": {"type": type(e).__name__,
+                                  "message": str(e)}}, 404)
         except Exception as e:
             # Undecodable body, bad header values, … — client-side
             # errors. The body may not have been read (e.g. a garbage
@@ -524,8 +680,46 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._json({"error": {"type": type(e).__name__,
                                   "message": str(e)}}, 400,
                        headers=(("Connection", "close"),))
-            return
-        self._json({"job_id": job.job_id, "status": job.status})
+
+    def _post_session(self, parts: list[str]) -> None:
+        """POST /session | /session/<id>/stop | /session/<id>/finalize
+        (docs/STREAMING.md)."""
+        if len(parts) == 1:
+            out = self.service.create_session(self._read_json_body())
+            self._json(out)
+        elif len(parts) == 3 and parts[2] == "stop":
+            stack = self._read_stack_body()
+            job = self.service.submit_session_stop(parts[1], stack)
+            self._json({"job_id": job.job_id, "status": job.status,
+                        "session_id": parts[1]})
+        elif len(parts) == 3 and parts[2] == "finalize":
+            from .sessions import SessionResultEvicted
+
+            body = self._read_json_body()
+            try:
+                job = self.service.finalize_session(
+                    parts[1], body.get("result_format", "stl"))
+            except (JobRejected, UnknownSessionError):
+                raise
+            except SessionResultEvicted as e:
+                # The one-shot result-eviction semantics (HTTP 410):
+                # finalize happened, the artifact is gone for good.
+                self._json({"error": {"type": type(e).__name__,
+                                      "message": str(e)}}, 410)
+                return
+            except Exception as e:
+                # A finalize that cannot proceed (too few fused stops,
+                # meshing failure) is a client-visible conflict, not a
+                # server error — the session stays usable.
+                self._json({"error": {"type": type(e).__name__,
+                                      "message": str(e)}}, 409)
+                return
+            self._json({"job_id": job.job_id, "status": job.status,
+                        "result": dict(job.result_meta)})
+        else:
+            self.close_connection = True
+            self._json({"error": "not found"}, 404,
+                       headers=(("Connection", "close"),))
 
     def do_GET(self):
         url = urlparse(self.path)
@@ -562,6 +756,52 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 self._json(status)
         elif url.path == "/result":
             self._result((parse_qs(url.query).get("id") or [""])[0])
+        elif url.path.startswith("/session/"):
+            self._get_session([p for p in url.path.split("/") if p])
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def _get_session(self, parts: list[str]) -> None:
+        """GET /session/<id> (status) | /session/<id>/preview (latest
+        progressive STL)."""
+        try:
+            if len(parts) == 2:
+                self._json(self.service.sessions.get(
+                    parts[1]).status_dict())
+            elif len(parts) == 3 and parts[2] == "preview":
+                out = self.service.session_preview(parts[1])
+                if out is None:
+                    self._json({"session_id": parts[1],
+                                "error": "no preview yet (submit a "
+                                         "stop first)"}, 409)
+                    return
+                data, meta = out
+                self.send_response(200)
+                self.send_header("Content-Type", _CONTENT_TYPES["stl"])
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Preview-Stop", str(meta.get("stop")))
+                self.send_header("X-Preview-Faces",
+                                 str(meta.get("faces")))
+                self.send_header("X-Stops-Fused",
+                                 str(meta.get("stops_fused")))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._json({"error": "not found"}, 404)
+        except UnknownSessionError as e:
+            self._json({"error": {"type": type(e).__name__,
+                                  "message": str(e)}}, 404)
+
+    def do_DELETE(self):
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if len(parts) == 2 and parts[0] == "session":
+            try:
+                self.service.sessions.delete(parts[1])
+            except UnknownSessionError as e:
+                self._json({"error": {"type": type(e).__name__,
+                                      "message": str(e)}}, 404)
+                return
+            self._json({"session_id": parts[1], "deleted": True})
         else:
             self._json({"error": "not found"}, 404)
 

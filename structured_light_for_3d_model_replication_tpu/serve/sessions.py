@@ -1,0 +1,269 @@
+"""Multi-stop streaming sessions for the reconstruction service.
+
+One :class:`ServeSession` wraps one `stream.IncrementalSession` behind a
+lock: stops are submitted as ordinary jobs whose ``decode_sink`` hands
+the batch-decoded arrays to the session (so session stops ride the SAME
+admission queue → bucketed batcher → warmed program cache as one-shot
+jobs — full batcher interop, including coalescing stops from different
+sessions into one launch), previews are serialized lazily on demand, and
+finalize lands the result as a terminal job in the service's ordinary
+job registry so the existing ``GET /result`` path serves it.
+
+The registry is bounded two ways: at most ``max_sessions`` live
+(unfinalized) sessions — above it ``POST /session`` is refused with a
+retryable rejection (the admission-queue rule applied to sessions) —
+and EVERY session, live or finalized, expires ``session_ttl_s`` after
+its last activity (finalized ones are additionally evicted oldest-first
+past the cap). A client that crashes mid-scan therefore frees its slot
+and its model buffers after the idle TTL instead of pinning them
+forever.
+
+Ordering: within one worker, stops complete in submission order (batches
+preserve queue order and the postprocess loop is sequential). With
+``workers > 1`` two batches can interleave — submit a session's next
+stop after the previous stop's job is terminal (the natural capture
+cadence), or keep one worker per device.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+import uuid
+from collections import OrderedDict
+
+import numpy as np
+
+from ..io.stl import write_stl
+from ..stream import IncrementalSession, StreamParams
+from ..utils import events
+from ..utils.log import get_logger
+from .jobs import DONE, FAILED, JobRejected, ServeError, StackFormatError
+
+log = get_logger(__name__)
+
+#: ``POST /session`` body keys a client may override per session. The
+#: merge/registration surface stays server-side (it keys compiled
+#: programs; per-session drift would mint fresh compiles — exactly what
+#: the warmed steady state forbids).
+SESSION_OPTION_KEYS = ("preview_every", "preview_depth", "final_depth",
+                       "expected_stops", "method", "covis")
+
+
+class SessionLimitError(JobRejected):
+    """Session registry at capacity — finish or delete one, then retry."""
+
+    retryable = True
+
+    def __init__(self, limit: int):
+        super().__init__(f"session limit reached ({limit} live sessions); "
+                         "finalize or delete one and retry")
+        self.retry_after_s = None
+
+
+class UnknownSessionError(ServeError):
+    """No such session (never created, or evicted) — maps to HTTP 404."""
+
+
+class SessionResultEvicted(ServeError):
+    """The session finalized, but its terminal result job fell out of the
+    bounded job registry — the artifact is gone; re-scan. Maps to HTTP
+    410 (the one-shot result-eviction semantics applied to sessions)."""
+
+
+class ServeSession:
+    """One streaming session: lock, lifecycle stamps, lazy preview bytes."""
+
+    def __init__(self, session_id: str, session: IncrementalSession,
+                 bucket_pixels: int):
+        self.session_id = session_id
+        self.session = session
+        self.bucket_pixels = bucket_pixels
+        self.lock = threading.Lock()
+        self.created_t = time.monotonic()
+        self.last_t = self.created_t
+        self.stops_submitted = 0
+        self.result_job_id: str | None = None
+        self._preview_cache: tuple[int, bytes] | None = None
+        self._pending: list = []  # submitted stop Jobs not yet terminal
+
+    # ------------------------------------------------------------------
+
+    def ingest(self, points, colors, valid, coverage=None) -> dict:
+        """The job's ``decode_sink``: fuse one decoded stop. Runs on the
+        worker thread; the lock serializes against preview/finalize."""
+        with self.lock:
+            res = self.session.add_decoded(points, colors, valid,
+                                           coverage=coverage)
+            self.last_t = time.monotonic()
+            return {"session_id": self.session_id, **res.to_dict()}
+
+    @staticmethod
+    def _terminal(job) -> bool:
+        # Plain status read — the prune below runs under the session
+        # lock, where even a zero-timeout Event.wait is off-limits
+        # (jaxlint blocking-under-lock).
+        return job.status in (DONE, FAILED)
+
+    def note_pending(self, job) -> None:
+        with self.lock:
+            self._pending = [j for j in self._pending
+                             if not self._terminal(j)]
+            self._pending.append(job)
+            self.last_t = time.monotonic()
+
+    def settle_pending(self, timeout_s: float = 120.0) -> bool:
+        """Block until every already-submitted stop job is terminal —
+        finalize must not close the ring under a stop the client was
+        told 200 about. Called WITHOUT the session lock held (the
+        pending jobs' sinks need it to finish). True when all settled."""
+        deadline = time.monotonic() + timeout_s
+        with self.lock:
+            jobs = list(self._pending)
+        ok = True
+        for j in jobs:
+            ok = j.wait(max(0.0, deadline - time.monotonic())) and ok
+        with self.lock:
+            self._pending = [j for j in self._pending
+                             if not self._terminal(j)]
+        return ok
+
+    def preview_bytes(self) -> tuple[bytes, dict] | None:
+        """Latest progressive preview as STL bytes (serialized once per
+        emitted preview, then cached)."""
+        with self.lock:
+            mesh = self.session.preview
+            meta = dict(self.session.preview_meta)
+            if mesh is None:
+                return None
+            stamp = meta.get("stop", -1)
+            if self._preview_cache is None \
+                    or self._preview_cache[0] != stamp:
+                buf = io.BytesIO()
+                write_stl(buf, mesh)
+                self._preview_cache = (stamp, buf.getvalue())
+            return self._preview_cache[1], meta
+
+    def status_dict(self) -> dict:
+        with self.lock:
+            out = {"session_id": self.session_id,
+                   "stops_submitted": self.stops_submitted,
+                   "age_s": round(time.monotonic() - self.created_t, 3),
+                   **self.session.status_dict()}
+            if self.result_job_id is not None:
+                out["result_job_id"] = self.result_job_id
+            return out
+
+
+class SessionManager:
+    """Bounded registry of streaming sessions."""
+
+    def __init__(self, stream_params: StreamParams, proj,
+                 decode_cfg, tri_cfg, max_sessions: int = 8,
+                 session_ttl_s: float = 3600.0):
+        self.stream_params = stream_params
+        self.proj = proj
+        self.decode_cfg = decode_cfg
+        self.tri_cfg = tri_cfg
+        self.max_sessions = max(1, int(max_sessions))
+        self.session_ttl_s = float(session_ttl_s)
+        self._lock = threading.Lock()
+        self._sessions: OrderedDict[str, ServeSession] = OrderedDict()
+
+    # ------------------------------------------------------------------
+
+    def _params_for(self, options: dict) -> StreamParams:
+        import dataclasses
+
+        bad = sorted(set(options) - set(SESSION_OPTION_KEYS))
+        if bad:
+            raise StackFormatError(
+                f"unknown session option(s) {bad}; allowed: "
+                f"{sorted(SESSION_OPTION_KEYS)}")
+        overrides = {}
+        for k in SESSION_OPTION_KEYS:
+            if k in options and options[k] is not None:
+                overrides[k] = options[k]
+        if "method" in overrides \
+                and overrides["method"] not in ("sequential", "posegraph"):
+            raise StackFormatError(
+                f"method must be 'sequential' or 'posegraph', got "
+                f"{overrides['method']!r}")
+        for k in ("preview_every", "preview_depth", "final_depth",
+                  "expected_stops"):
+            if k in overrides:
+                try:
+                    overrides[k] = int(overrides[k])
+                except (TypeError, ValueError):
+                    raise StackFormatError(f"session option {k!r} must "
+                                           f"be an int")
+        if "covis" in overrides:
+            overrides["covis"] = bool(overrides["covis"])
+        return dataclasses.replace(self.stream_params, **overrides)
+
+    def create(self, options: dict | None = None) -> ServeSession:
+        params = self._params_for(dict(options or {}))
+        sid = uuid.uuid4().hex[:12]
+        session = IncrementalSession(
+            calib=None,  # serve stops arrive pre-decoded via the batcher
+            col_bits=self.proj.col_bits, row_bits=self.proj.row_bits,
+            params=params, decode_cfg=self.decode_cfg,
+            tri_cfg=self.tri_cfg, scan_id=f"serve-{sid}")
+        entry = ServeSession(sid, session, bucket_pixels=0)
+        expired: list[str] = []
+        with self._lock:
+            # Idle-TTL expiry first — an abandoned (crashed-client) live
+            # session must free its slot and model buffers, not pin them
+            # forever.
+            now = time.monotonic()
+            expired = [k for k, s in self._sessions.items()
+                       if now - s.last_t > self.session_ttl_s]
+            for k in expired:
+                del self._sessions[k]
+            live = sum(1 for s in self._sessions.values()
+                       if not s.session.finalized)
+            if live >= self.max_sessions:
+                raise SessionLimitError(self.max_sessions)
+            self._sessions[sid] = entry
+            # Evict oldest FINALIZED sessions past the cap (their result
+            # already lives in the job registry).
+            done = [k for k, s in self._sessions.items()
+                    if s.session.finalized]
+            excess = len(self._sessions) - self.max_sessions
+            for k in done[:max(0, excess)]:
+                del self._sessions[k]
+        for k in expired:
+            events.record("session_expired", session_id=k,
+                          severity="warning",
+                          ttl_s=self.session_ttl_s)
+        events.record("session_created", scan_id=session.scan_id,
+                      session_id=sid)
+        return entry
+
+    def get(self, session_id: str) -> ServeSession:
+        with self._lock:
+            entry = self._sessions.get(session_id)
+        if entry is None:
+            raise UnknownSessionError(
+                f"unknown session {session_id!r} (never created, "
+                "or evicted after finalize)")
+        return entry
+
+    def delete(self, session_id: str) -> None:
+        with self._lock:
+            entry = self._sessions.pop(session_id, None)
+        if entry is None:
+            raise UnknownSessionError(f"unknown session {session_id!r}")
+        events.record("session_deleted", session_id=session_id,
+                      stops_fused=entry.session.stops_fused)
+
+    def stats(self) -> dict:
+        with self._lock:
+            entries = list(self._sessions.values())
+        return {
+            "sessions": len(entries),
+            "live": sum(1 for e in entries
+                        if not e.session.finalized),
+            "max_sessions": self.max_sessions,
+        }

@@ -172,6 +172,21 @@ class DeviceWorker:
         The coverage gate reads the job's ORIGINAL (pre-padding) pixel
         region: padded pixels are black and decode invalid by design, so
         counting them would punish small-in-bucket jobs."""
+        if job.decode_sink is not None:
+            # Streaming session stop: the sink (the session's ingest,
+            # serve/sessions.py) owns gating — its covisibility/coverage
+            # decisions are skip-and-bridge, not per-job failures. Runs
+            # on this worker thread under the session lock. Coverage is
+            # measured over the job's ORIGINAL pre-padding region here
+            # (same rule as the one-shot gate below) and handed along —
+            # the session only sees the padded bucket lane.
+            import json as _json
+
+            _, h, w = job.stack.shape
+            vgrid = valid.reshape(key.height, key.width)[:h, :w]
+            meta = job.decode_sink(points, colors, valid,
+                                   coverage=float(vgrid.mean()))
+            return _json.dumps(meta).encode(), meta
         _, h, w = job.stack.shape
         vgrid = valid.reshape(key.height, key.width)[:h, :w]
         coverage = float(vgrid.mean())

@@ -1,0 +1,39 @@
+"""Streaming incremental reconstruction — the SLAM-shaped pipeline.
+
+A batch 360° scan is final the moment each stop lands, yet the batch
+pipeline (`models/scan360`) only merges after stop 24 — perceived latency
+is the whole scan. This package is the incremental version the retrieved
+SLAM line of work points at (S3-SLAM's incremental sparse-encoding
+updates, AGS's codec-assisted covisibility gating, RGBD GS-ICP SLAM —
+PAPERS.md): each stop is fused into a running model AS IT ARRIVES, the
+pose graph is updated incrementally (new edge against the running anchor
+set + a windowed local re-optimize instead of a full batch solve), a
+cheap covisibility/novelty gate skips redundant stops before they cost
+registration and fusion, and a progressive coarse-Poisson mesh preview
+is emitted after every stop — first preview after stop 1, not stop 24.
+
+Zero new steady-state compiles by construction: every device program an
+:class:`~.session.IncrementalSession` launches is either one of the
+batch pipeline's already-compiled programs reused at per-stop shapes
+(`models/pipeline.reconstruct_batch_fn` B=1, `models/merge._preprocess_fn`
+/ `_edge_fn`, the shared subsample) or a stream-local program with
+static shapes independent of the stop count (the model-fuse scatter, the
+fixed-window pose refine, the fixed-size preview mesher). After the
+warm-up stops, adding a stop compiles nothing — asserted by compile
+telemetry in tests and bench config [8].
+
+Entry points: :class:`~.session.IncrementalSession` (in-process),
+`serve/`'s multi-stop session API (``POST /session`` …, docs/SERVING.md),
+``cli scan-360 --stream``, and `scanner.auto_scan_360(on_stop=…)` for
+live capture. docs/STREAMING.md has the architecture and semantics.
+"""
+
+from .preview import PreviewMesher
+from .session import IncrementalSession, StopResult, StreamParams
+
+__all__ = [
+    "IncrementalSession",
+    "PreviewMesher",
+    "StopResult",
+    "StreamParams",
+]

@@ -1,0 +1,93 @@
+"""Progressive mesh previews at static shapes (zero steady-state compiles).
+
+The batch mesher (`models/meshing.mesh_from_cloud`) compacts to the
+cloud's exact point count on host, so every preview of a growing model
+would mint a fresh XLA program — a recompile per stop, exactly what the
+streaming acceptance bar forbids. This mesher keeps every device shape
+FIXED across the session: the running model is stratified-sampled into
+``points`` static slots (invalid slots masked, never compacted), normals
+are estimated and radially oriented in one jitted program over those
+slots, and the screened-Poisson solve runs at a constant ``depth`` — so
+the whole preview chain compiles once at the first preview and is pure
+execution for every stop after. Extraction stays the host NumPy
+marching-tets oracle (`ops/marching.extract`), whose data-dependent
+output size costs no compiles.
+
+Fidelity schedule (docs/STREAMING.md): per-stop previews are COARSE
+(default depth 6 — blocky but instant feedback while the turntable is
+still moving); the full-depth watertight mesh is built once at
+finalize through the ordinary batch mesher.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.stl import TriangleMesh
+from ..ops import marching, pointcloud, poisson
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@functools.lru_cache(maxsize=None)
+def _sample_normals_fn(m: int, k: int):
+    """Model buffer → ``m`` preview slots + oriented normals, one launch.
+
+    Stratified selection keeps the sample spatially spread however the
+    model grew; normals orient outward from the valid centroid (the
+    reference's radial trick — previews have no camera to orient by)."""
+
+    def run(pts, valid):
+        idx, v = pointcloud.stratified_indices(valid, m)
+        p = jnp.where(v[:, None], pts[idx], 0.0)
+        nv = jnp.maximum(jnp.sum(v.astype(jnp.float32)), 1.0)
+        center = jnp.sum(p, axis=0) / nv
+        normals, n_ok = pointcloud.estimate_normals(p, valid=v, k=k)
+        normals = pointcloud.orient_normals(p, normals, center,
+                                            outward=True)
+        return p, normals, v & n_ok
+
+    return jax.jit(run)
+
+
+class PreviewMesher:
+    """Coarse progressive previews of a running fused model.
+
+    One instance per session; ``__call__`` takes the session's model
+    buffer (static ``cap`` slots + valid mask) and returns a host
+    :class:`TriangleMesh`. All device work happens at shapes fixed by
+    ``(points, depth)`` — stop count never appears in a shape.
+    """
+
+    def __init__(self, points: int = 8192, depth: int = 6,
+                 quantile_trim: float = 0.05, normals_k: int = 16,
+                 cg_iters: int = 60):
+        if depth > 8:
+            raise ValueError(f"preview depth {depth} > 8: previews ride "
+                             "the dense Poisson grid (keep them coarse; "
+                             "finalize owns the deep solve)")
+        self.points = int(points)
+        self.depth = int(depth)
+        self.quantile_trim = float(quantile_trim)
+        self.normals_k = int(normals_k)
+        self.cg_iters = int(cg_iters)
+
+    def __call__(self, model_pts, model_valid) -> TriangleMesh:
+        p, normals, v = _sample_normals_fn(self.points, self.normals_k)(
+            model_pts, model_valid)
+        grid = poisson.reconstruct(p, normals, valid=v, depth=self.depth,
+                                   cg_iters=self.cg_iters)
+        mesh = marching.extract(grid, quantile_trim=self.quantile_trim)
+        log.debug("preview: %d sample slots -> %d faces (depth %d)",
+                  self.points, len(mesh.faces), self.depth)
+        return mesh
+
+    @staticmethod
+    def empty() -> TriangleMesh:
+        return TriangleMesh(vertices=np.zeros((0, 3), np.float32),
+                            faces=np.zeros((0, 3), np.int32))

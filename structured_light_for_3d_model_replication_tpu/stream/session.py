@@ -1,0 +1,777 @@
+"""IncrementalSession: fuse a 360° scan one stop at a time.
+
+The batch pipeline's math, re-staged so each stop is consumed the moment
+it lands:
+
+* **decode** — the same compiled batch program at B=1
+  (`models/scan360.decode_stop`);
+* **subsample** — the same shared stratified pass, stop axis of 1
+  (`models/scan360.subsample_stop`);
+* **register** — the same per-stop preprocess + per-edge programs the
+  batch loop strategy runs (`models/merge.preprocess_registration_view`,
+  `register_edge`), hint-chained and keyed identically, so a finalized
+  incremental session reproduces the batch ring bit-for-bit on a clean
+  scan (the parity bar in tests/test_stream.py);
+* **pose update** — chain for the new stop, then a WINDOWED local
+  re-optimize: the last `window` edges plus turntable-step prior edges
+  run through the existing pose-graph LM at a fixed padded shape
+  (compiled once, reused every stop) instead of a full batch solve;
+* **fuse** — the stop's merge view is pose-transformed and voxel-merged
+  into a fixed-capacity running model buffer in ONE donated-in/out
+  program (static shapes: stop count never appears);
+* **preview** — a coarse static-shape Poisson mesh of the running model
+  after every stop (`stream/preview.py`) — first preview after stop 1.
+
+**Covisibility/novelty gate** (AGS-style, PAPERS.md): before a stop pays
+for registration and fusion, two cheap host-side voxel-overlap tests run
+against what the session already holds — a camera-frame test against the
+previous accepted stop (a stuck turntable re-captures the same view;
+overlap ≈ 1) and a predicted-pose test against the fused model (a second
+lap, or stops commanded denser than the geometry needs). A redundant
+stop is SKIPPED: its decision is journaled (``stop_skipped_covisible``),
+its pose is predicted from the ring consensus, and the next real stop
+bridges across it exactly like the PR-3 degraded-ring path.
+
+Zero steady-state compiles: every program above is either already
+compiled by the batch path or compiled once at session warm-up with
+shapes independent of the stop count — asserted via compile telemetry in
+tests/test_stream.py and bench config [8].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import health as health_mod
+from ..config import DecodeConfig, TriangulationConfig
+from ..io import ply as ply_io
+from ..ops import pointcloud, posegraph, registration
+from ..utils import events, trace
+from ..utils.log import get_logger
+from ..models import merge as merge_mod
+from ..models import scan360 as scan360_mod
+from .preview import PreviewMesher
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamParams:
+    """Streaming knobs on top of the batch merge parameters.
+
+    Frozen/hashable (it keys compiled-program caches the same way
+    `Scan360Params` does)."""
+
+    merge: merge_mod.MergeParams = merge_mod.MergeParams()
+    method: str = "posegraph"           # finalize pose solve
+    view_cap: int = 131_072             # per-stop merge-view slots
+    # PR-3 quality gates: per-stop decode coverage (skip-and-bridge) and
+    # per-edge fitness/RMSE (consensus repair / down-weight) at finalize.
+    # None = gates off (batch ungated semantics).
+    gates: health_mod.QualityGates | None = None
+    # Running fused model: static slot capacity of the voxel-merged
+    # buffer previews sample from. Overflow degrades to a stratified
+    # subset (logged), never a recompile.
+    model_cap: int = 262_144
+    # -- covisibility / novelty gate (AGS-style) -------------------------
+    covis: bool = True
+    # Predicted-pose overlap with the fused model above which a stop is
+    # redundant (second lap / oversampled ring). Ring neighbors genuinely
+    # share most of their view, so the default only fires on near-total
+    # redundancy.
+    covis_model_overlap: float = 0.995
+    # Camera-frame overlap with the PREVIOUS accepted stop above which
+    # the turntable did not advance (stuck table, duplicate upload).
+    covis_duplicate_overlap: float = 0.98
+    covis_voxel_scale: float = 2.0      # gate voxel = scale × merge voxel
+    covis_min_points: int = 256         # below this the gate abstains
+    # -- windowed local re-optimize --------------------------------------
+    window: int = 6                     # edges in the local LM window
+    window_iterations: int = 10
+    # Prior-edge information scale relative to the window's measured
+    # edges: the turntable-step consensus votes gently, smoothing a bad
+    # live edge without overriding good ICP.
+    window_prior_scale: float = 0.05
+    # -- progressive previews --------------------------------------------
+    preview_every: int = 1              # 0 disables previews
+    preview_points: int = 8192
+    preview_depth: int = 6
+    preview_trim: float = 0.05
+    # -- finalize ---------------------------------------------------------
+    final_depth: int = 8
+    final_trim: float = 0.0
+    # Stop-count hint: with it the per-edge PRNG key schedule matches the
+    # batch path's `split(key, n)` exactly (bit-parity on clean scans);
+    # without it a generous schedule is pre-split and parity is
+    # tolerance-level only.
+    expected_stops: int | None = None
+    max_stops: int = 256
+
+
+@dataclasses.dataclass
+class StopResult:
+    """What happened to one submitted stop."""
+
+    stop: int
+    fused: bool
+    reason: str                  # fused | skipped_coverage |
+    #                              skipped_duplicate | skipped_covisible
+    coverage: float
+    overlap: float | None = None
+    fitness: float | None = None
+    rmse: float | None = None
+    gap: int = 1
+    preview: bool = False
+    model_points: int = 0
+    seconds: float = 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        for k in ("coverage", "overlap", "fitness", "rmse", "seconds"):
+            if d[k] is not None:
+                d[k] = round(float(d[k]), 4)
+        return d
+
+
+@dataclasses.dataclass
+class FinalizeResult:
+    cloud: ply_io.PointCloud
+    poses: np.ndarray            # (max_label+1, 4, 4); skipped stops carry
+    #                              their predicted pose, unseen stops I
+    mesh: "object | None"        # TriangleMesh at final_depth, if built
+    health: health_mod.ScanHealthReport
+    stats: dict
+
+
+# ---------------------------------------------------------------------------
+# Stream-local compiled programs (static shapes, stop count never appears)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fuse_fn(voxel: float, model_cap: int, view_cap: int):
+    """Model ∪ one pose-transformed stop view → model, ONE launch.
+
+    The model buffers are donated: in and out are the same (cap,) shapes,
+    so XLA aliases them — the running model updates in place, the classic
+    streaming donation win (sharding-readiness, docs/JAXLINT.md)."""
+
+    def run(m_pts, m_col, m_val, pose, s_pts, s_col, s_val):
+        moved = registration.transform_points(pose, s_pts)
+        moved = jnp.where(s_val[:, None], moved, 0.0)
+        allp = jnp.concatenate([m_pts, moved], axis=0)
+        allc = jnp.concatenate([m_col, s_col], axis=0)
+        allv = jnp.concatenate([m_val, s_val], axis=0)
+        dp, dc, dv, _ = pointcloud.voxel_downsample(
+            allp, voxel, valid=allv, attrs=allc, with_attrs=True)
+        idx, v2 = pointcloud.stratified_indices(dv, model_cap)
+        out_pts = jnp.where(v2[:, None], dp[idx], 0.0)
+        out_col = jnp.where(v2[:, None], dc[idx], 0.0)
+        return out_pts, out_col, v2, jnp.sum(dv.astype(jnp.int32)), moved
+
+    return jax.jit(run, donate_argnums=(0, 1, 2),
+                   in_shardings=None, out_shardings=None)
+
+
+@functools.lru_cache(maxsize=None)
+def _window_refine_fn(window: int, iterations: int):
+    """Fixed-window pose-graph LM: the last ``window`` chain edges plus
+    turntable-step prior edges, padded to a STATIC shape (zero-information
+    padding edges constrain nothing), compiled once per (window,
+    iterations) and reused every stop. Node 0 (the window anchor) is held
+    fixed, so outputs are poses relative to the window start."""
+    src = tuple(range(1, window + 1))
+    dst = tuple(range(window))
+
+    def run(edge_T, edge_info, prior_T, prior_info):
+        poses0 = posegraph.chain_poses(edge_T)
+        graph = posegraph.PoseGraph(
+            poses0,
+            jnp.asarray(src + src, jnp.int32),
+            jnp.asarray(dst + dst, jnp.int32),
+            jnp.concatenate([edge_T, prior_T], axis=0),
+            jnp.concatenate([edge_info, prior_info], axis=0))
+        return posegraph.optimize(graph, iterations=iterations)
+
+    return jax.jit(run)
+
+
+# ---------------------------------------------------------------------------
+# Covisibility gate helpers (host-side: a few thousand points per stop)
+# ---------------------------------------------------------------------------
+
+_VOX_BITS = 21
+_VOX_OFF = 1 << (_VOX_BITS - 1)
+
+
+def _voxel_keys(pts: np.ndarray, voxel: float) -> np.ndarray:
+    """Exact packed int64 voxel keys (21 signed bits per axis — ±1M
+    voxels; beyond that the gate would abstain long before overflow)."""
+    q = np.floor(pts / float(voxel)).astype(np.int64) + _VOX_OFF
+    q = np.clip(q, 0, (1 << _VOX_BITS) - 1)
+    return np.unique((q[:, 0] << (2 * _VOX_BITS))
+                     | (q[:, 1] << _VOX_BITS) | q[:, 2])
+
+
+def voxel_overlap(pts: np.ndarray, occupied: np.ndarray,
+                  voxel: float) -> float:
+    """Fraction of ``pts``'s occupied voxels already present in the
+    sorted key array ``occupied`` — the covisibility measure."""
+    if pts.shape[0] == 0 or occupied.size == 0:
+        return 0.0
+    keys = _voxel_keys(pts, voxel)
+    return float(np.isin(keys, occupied, assume_unique=True).mean())
+
+
+class _EdgeRec:
+    """One incremental ring edge (device transform + host scalars)."""
+
+    __slots__ = ("src", "dst", "gap", "T_dev", "T_np", "T_live", "fit",
+                 "rmse", "info")
+
+    def __init__(self, src, dst, gap, T_dev, fit, rmse, info):
+        self.src = src
+        self.dst = dst
+        self.gap = gap
+        self.T_dev = T_dev                     # raw measured (finalize)
+        self.T_np = np.asarray(T_dev, np.float64)
+        self.T_live = self.T_np                # possibly live-repaired
+        self.fit = float(fit)
+        self.rmse = float(rmse)
+        self.info = np.asarray(info, np.float64)
+
+
+class IncrementalSession:
+    """Consume one decoded stop at a time; keep a fused model, live
+    poses, and a progressive preview current throughout.
+
+    Not thread-safe by itself — concurrent callers (serve sessions) hold
+    a per-session lock. One session is one scan: ``finalize`` closes it.
+    """
+
+    def __init__(self, calib, col_bits: int, row_bits: int,
+                 params: StreamParams = StreamParams(),
+                 decode_cfg: DecodeConfig = DecodeConfig(),
+                 tri_cfg: TriangulationConfig = TriangulationConfig(),
+                 key=None, scan_id: str | None = None,
+                 health: health_mod.ScanHealthReport | None = None):
+        if params.method not in ("sequential", "posegraph"):
+            raise ValueError(f"method must be 'sequential' or 'posegraph',"
+                             f" got {params.method!r}")
+        self.calib = calib
+        self.col_bits = col_bits
+        self.row_bits = row_bits
+        self.params = params
+        self.decode_cfg = decode_cfg
+        self.tri_cfg = tri_cfg
+        self._key = key if key is not None else jax.random.PRNGKey(0)
+        self.scan_id = scan_id or f"stream-{id(self):x}"
+        self.health = health if health is not None \
+            else health_mod.ScanHealthReport()
+        if self.health.scan_id is None:
+            self.health.scan_id = self.scan_id
+        self._keys = None            # per-edge PRNG schedule (first stop)
+        self._n_pixels: int | None = None
+        self._view_cap = self._m_reg = 0
+        # Per-FUSED-stop state (parallel lists, index = fused order).
+        self._labels: list[int] = []
+        self._preps: list[tuple] = []
+        self._subs: list[tuple] = []
+        self._poses: list[np.ndarray] = []
+        self._edges: list[_EdgeRec] = []
+        self._hint = None
+        self._consensus: np.ndarray | None = None
+        # Skipped stops: label -> (reason, predicted pose).
+        self._skipped: dict[int, tuple[str, np.ndarray]] = {}
+        self._next_label = 0
+        # Running fused model + host voxel occupancy for the covis gate.
+        self._model: tuple | None = None      # (pts, col, val) device
+        self._model_points = 0
+        self._model_voxels = np.empty(0, np.int64)
+        self._prev_cam_voxels = np.empty(0, np.int64)
+        self._mesher = PreviewMesher(points=params.preview_points,
+                                     depth=params.preview_depth,
+                                     quantile_trim=params.preview_trim)
+        self.preview = None
+        self.preview_meta: dict = {}
+        self._finalized = False
+        self._t0 = time.monotonic()
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def stops_fused(self) -> int:
+        return len(self._labels)
+
+    @property
+    def stops_skipped(self) -> int:
+        return len(self._skipped)
+
+    @property
+    def finalized(self) -> bool:
+        return self._finalized
+
+    def live_poses(self) -> np.ndarray:
+        """Current (fused-stop) global poses — refined incrementally; the
+        authoritative poses come from :meth:`finalize`."""
+        return np.stack(self._poses) if self._poses else \
+            np.zeros((0, 4, 4))
+
+    def status_dict(self) -> dict:
+        return {
+            "scan_id": self.scan_id,
+            "stops_fused": self.stops_fused,
+            "stops_skipped": self.stops_skipped,
+            "skipped": {str(k): v[0] for k, v in self._skipped.items()},
+            "model_points": int(self._model_points),
+            "preview": dict(self.preview_meta) if self.preview_meta
+            else None,
+            "finalized": self._finalized,
+        }
+
+    # -- per-stop ingestion ------------------------------------------------
+
+    def add_stop(self, stack, stop: int | None = None) -> StopResult:
+        """Decode one (F, H, W) uint8 capture stack and fuse it (the
+        in-process path; serve sessions decode through the batcher and
+        call :meth:`add_decoded`)."""
+        pts, cols, vals = scan360_mod.decode_stop(
+            stack, self.calib, self.col_bits, self.row_bits,
+            decode_cfg=self.decode_cfg, tri_cfg=self.tri_cfg)
+        return self.add_decoded(pts, cols, vals, stop=stop)
+
+    def add_decoded(self, points, colors, valid,
+                    stop: int | None = None,
+                    coverage: float | None = None) -> StopResult:
+        """Fuse one stop's decoded dense arrays (device or host):
+        ``points`` (P, 3) f32, ``colors`` (P, 3), ``valid`` (P,) bool.
+        ``stop`` is the PHYSICAL stop label (strictly increasing;
+        defaults to the next commanded index) — capture-failed stops the
+        caller never submits show up as label gaps and bridge exactly
+        like the batch degraded-ring path. ``coverage`` overrides the
+        plain ``mean(valid)`` statistic — serve workers pass the
+        pre-padding region's coverage so bucket padding never dilutes
+        the gate."""
+        if self._finalized:
+            raise health_mod.StopQualityError(
+                f"session {self.scan_id} is finalized")
+        label = self._next_label if stop is None else int(stop)
+        if label < self._next_label:
+            raise ValueError(
+                f"stop labels must be strictly increasing: got {label} "
+                f"after {self._next_label - 1}")
+        self._next_label = label + 1
+        t0 = time.monotonic()
+        with events.context(scan_id=self.scan_id, stop=label):
+            res = self._ingest(label, points, colors, valid, coverage)
+        res.seconds = time.monotonic() - t0
+        return res
+
+    def _ingest(self, label: int, points, colors, valid,
+                coverage: float | None = None) -> StopResult:
+        p = self.params
+        mp = p.merge
+        points = jnp.asarray(points)
+        if self._n_pixels is None:
+            self._n_pixels = int(points.shape[0])
+            self._view_cap, self._m_reg = scan360_mod.stop_view_sizes(
+                scan360_mod.Scan360Params(merge=mp, view_cap=p.view_cap),
+                self._n_pixels)
+            n_keys = p.expected_stops if p.expected_stops else p.max_stops
+            self._keys = jax.random.split(self._key, n_keys)
+        elif int(points.shape[0]) != self._n_pixels:
+            raise ValueError(
+                f"stop {label} has {int(points.shape[0])} pixels; this "
+                f"session is locked to {self._n_pixels}")
+
+        if coverage is None:
+            coverage = float(jnp.mean(
+                jnp.asarray(valid).astype(jnp.float32)))
+        rec = self.health.stop(label)
+        rec.coverage = coverage
+
+        # -- decode-coverage gate (PR-3 semantics: skip and bridge) -------
+        if p.gates is not None and not p.gates.coverage_ok(coverage):
+            rec.status = "dropped"
+            events.record("stop_dropped", severity="warning",
+                          message="decode coverage below gate",
+                          coverage=round(coverage, 4),
+                          min_coverage=p.gates.min_coverage)
+            self._skipped[label] = ("skipped_coverage",
+                                    self._predict_pose(label))
+            return StopResult(stop=label, fused=False,
+                              reason="skipped_coverage", coverage=coverage,
+                              gap=self._gap_for(label))
+
+        sub = scan360_mod.subsample_stop(
+            points, jnp.asarray(colors), jnp.asarray(valid),
+            self._view_cap, self._m_reg)
+        sub_pts, sub_col, sub_val, reg_pts, reg_val = sub
+        reg_np = np.asarray(reg_pts)[np.asarray(reg_val)]
+
+        # -- covisibility / novelty gate ----------------------------------
+        overlap = self._covis_overlap(label, reg_np)
+        if overlap is not None:
+            kind, value = overlap
+            rec.status = kind
+            events.record(
+                "stop_skipped_covisible", severity="info",
+                message=f"redundant stop ({kind})",
+                overlap=round(value, 4), coverage=round(coverage, 4),
+                threshold=(p.covis_duplicate_overlap
+                           if kind == "skipped_duplicate"
+                           else p.covis_model_overlap))
+            self._skipped[label] = (kind, self._predict_pose(label))
+            return StopResult(stop=label, fused=False, reason=kind,
+                              coverage=coverage, overlap=value,
+                              gap=self._gap_for(label),
+                              model_points=self._model_points)
+
+        # -- register against the running anchor --------------------------
+        prep = merge_mod.preprocess_registration_view(reg_pts, reg_val, mp)
+        fit = rmse = None
+        gap = self._gap_for(label)
+        if self._labels:
+            edge = self._register_edge(label, prep, gap)
+            fit, rmse = edge.fit, edge.rmse
+            pose = self._poses[-1] @ edge.T_live
+            self._edges.append(edge)
+            self._update_consensus()
+        else:
+            pose = np.eye(4)
+        self._labels.append(label)
+        self._preps.append(prep)
+        self._subs.append((sub_pts, sub_col, sub_val))
+        self._poses.append(pose)
+        if len(self._edges) >= 2:
+            self._refine_window()
+
+        # -- fuse into the running model ----------------------------------
+        moved = self._fuse(sub_pts, sub_col, sub_val)
+        if p.covis:
+            cam_keys = _voxel_keys(reg_np, self._covis_voxel())
+            self._prev_cam_voxels = cam_keys
+            mv = moved[np.asarray(sub_val)]
+            self._model_voxels = np.union1d(
+                self._model_voxels, _voxel_keys(mv, self._covis_voxel()))
+
+        # -- progressive preview ------------------------------------------
+        did_preview = self._maybe_preview(label)
+        events.record("stop_fused", coverage=round(coverage, 4),
+                      fitness=None if fit is None else round(fit, 4),
+                      rmse=None if rmse is None else round(rmse, 4),
+                      gap=gap, model_points=self._model_points)
+        return StopResult(stop=label, fused=True, reason="fused",
+                          coverage=coverage, fitness=fit, rmse=rmse,
+                          gap=gap, preview=did_preview,
+                          model_points=self._model_points)
+
+    # -- gate internals ----------------------------------------------------
+
+    def _covis_voxel(self) -> float:
+        return self.params.covis_voxel_scale * self.params.merge.voxel_size
+
+    def _gap_for(self, label: int) -> int:
+        return label - self._labels[-1] if self._labels else 1
+
+    def _predict_pose(self, label: int) -> np.ndarray:
+        """Consensus-extrapolated global pose for a stop that was never
+        registered (skipped) — reporting only, never fused."""
+        if not self._poses:
+            return np.eye(4)
+        pose = self._poses[-1].copy()
+        if self._consensus is not None:
+            pose = pose @ health_mod._matrix_power_T(
+                self._consensus, self._gap_for(label))
+        return pose
+
+    def _covis_overlap(self, label: int, reg_np: np.ndarray):
+        """(reason, overlap) when the stop should be skipped, else None."""
+        p = self.params
+        if not p.covis or reg_np.shape[0] < p.covis_min_points \
+                or not self._labels:
+            return None
+        voxel = self._covis_voxel()
+        # Camera-frame duplicate: the turntable did not advance.
+        dup = voxel_overlap(reg_np, self._prev_cam_voxels, voxel)
+        if dup >= p.covis_duplicate_overlap:
+            return ("skipped_duplicate", dup)
+        # Predicted-pose redundancy against the fused model.
+        if self._consensus is not None and self._model_voxels.size:
+            predicted = self._predict_pose(label)
+            moved = reg_np @ predicted[:3, :3].T + predicted[:3, 3]
+            cov = voxel_overlap(moved, self._model_voxels, voxel)
+            if cov >= p.covis_model_overlap:
+                return ("skipped_covisible", cov)
+        return None
+
+    # -- registration internals -------------------------------------------
+
+    def _edge_key(self, idx: int):
+        if idx < self._keys.shape[0]:
+            return self._keys[idx]
+        # Off-schedule (more stops than expected): deterministic but no
+        # longer bit-parity with the batch split — documented in
+        # StreamParams.expected_stops.
+        return jax.random.fold_in(self._key, idx)
+
+    def _register_edge(self, label: int, prep, gap: int) -> _EdgeRec:
+        p = self.params
+        key = self._edge_key(len(self._edges))
+        hint = self._hint if self._hint is not None \
+            else jnp.eye(4, dtype=jnp.float32)
+        T, fit, rmse, info = merge_mod.register_edge(
+            prep, self._preps[-1], p.merge, key=key, hint=hint)
+        self._hint = T
+        edge = _EdgeRec(src=label, dst=self._labels[-1], gap=gap,
+                        T_dev=T, fit=np.asarray(fit),
+                        rmse=np.asarray(rmse), info=info)
+        # Live repair: a failing edge must not corrupt the LIVE pose chain
+        # (finalize re-gates the raw measurements exactly like the batch
+        # path, so this only shapes previews and the covis prediction).
+        if p.gates is not None and not p.gates.edge_ok(edge.fit, edge.rmse):
+            if self._consensus is not None:
+                edge.T_live = health_mod._matrix_power_T(
+                    self._consensus, gap)
+                events.record("edge_rejected", severity="warning",
+                              message=f"live edge {label}->{edge.dst} "
+                                      "replaced by ring consensus",
+                              fitness=round(edge.fit, 4),
+                              rmse=round(edge.rmse, 4), gap=gap)
+        return edge
+
+    def _update_consensus(self) -> None:
+        Ts = np.stack([e.T_np for e in self._edges if e.gap == 1]) \
+            if any(e.gap == 1 for e in self._edges) else None
+        if Ts is not None:
+            self._consensus = health_mod.consensus_step_np(
+                Ts, self.params.merge.step_deg)
+
+    def _refine_window(self) -> None:
+        """Local pose-graph re-optimize over the trailing window (see
+        `_window_refine_fn`) — runs only when a step consensus exists
+        (a pure chain is already the exact solution)."""
+        p = self.params
+        if self._consensus is None or p.window < 2:
+            return
+        w = min(p.window, len(self._edges))
+        if w < 2:
+            return
+        W = p.window
+        eT = np.tile(np.eye(4, dtype=np.float32), (W, 1, 1))
+        eI = np.zeros((W, 6, 6), np.float32)
+        pT = np.tile(np.eye(4, dtype=np.float32), (W, 1, 1))
+        pI = np.zeros((W, 6, 6), np.float32)
+        sel = self._edges[-w:]
+        scale = p.window_prior_scale * float(np.median(
+            [np.trace(e.info) / 6.0 for e in sel]))
+        eye6 = np.eye(6, dtype=np.float32)
+        for j, e in enumerate(sel):
+            eT[j] = e.T_live.astype(np.float32)
+            eI[j] = e.info.astype(np.float32)
+            pT[j] = health_mod._matrix_power_T(
+                self._consensus, e.gap).astype(np.float32)
+            pI[j] = scale * eye6
+        opt = np.asarray(_window_refine_fn(W, p.window_iterations)(
+            eT, eI, pT, pI), np.float64)
+        anchor = self._poses[-(w + 1)]
+        for j in range(1, w + 1):
+            self._poses[-(w + 1) + j] = anchor @ opt[j]
+
+    # -- fusion + preview --------------------------------------------------
+
+    def _fuse(self, sub_pts, sub_col, sub_val) -> np.ndarray:
+        p = self.params
+        if self._model is None:
+            cap = p.model_cap
+            self._model = (jnp.zeros((cap, 3), jnp.float32),
+                           jnp.zeros((cap, 3), jnp.float32),
+                           jnp.zeros((cap,), bool))
+        pose_dev = jnp.asarray(self._poses[-1], jnp.float32)
+        m_pts, m_col, m_val, n_model, moved = _fuse_fn(
+            p.merge.voxel_size, p.model_cap, self._view_cap)(
+            *self._model, pose_dev, sub_pts, sub_col, sub_val)
+        self._model = (m_pts, m_col, m_val)
+        n_model = int(n_model)
+        if n_model > p.model_cap:
+            log.warning("running model overflowed model_cap=%d "
+                        "(%d voxels) — previews sample a stratified "
+                        "subset", p.model_cap, n_model)
+        self._model_points = min(n_model, p.model_cap)
+        return np.asarray(moved)
+
+    def _maybe_preview(self, label: int) -> bool:
+        p = self.params
+        if not p.preview_every:
+            return False
+        n = len(self._labels)
+        if n != 1 and n % p.preview_every != 0:
+            return False
+        t0 = time.monotonic()
+        with trace.span("stream.preview", stop=label):
+            mesh = self._mesher(self._model[0], self._model[2])
+        dt = time.monotonic() - t0
+        self.preview = mesh
+        self.preview_meta = {
+            "stop": label, "stops_fused": n,
+            "faces": int(len(mesh.faces)),
+            "vertices": int(len(mesh.vertices)),
+            "depth": p.preview_depth,
+            "model_points": self._model_points,
+            "preview_s": round(dt, 3),
+        }
+        events.record("preview_emitted", faces=int(len(mesh.faces)),
+                      depth=p.preview_depth, stops_fused=n,
+                      preview_s=round(dt, 3),
+                      model_points=self._model_points)
+        return True
+
+    # -- finalize ----------------------------------------------------------
+
+    def finalize(self, mesh: bool = True) -> FinalizeResult:
+        """Close the ring: optional loop-closure edge, axis-prior re-pass
+        (clean rings) or edge gates (degraded rings), full pose solve,
+        full-resolution merge of every retained stop view, and the
+        full-depth watertight mesh — the SAME math `scan_stacks_to_cloud`
+        runs, staged from the per-stop state this session retained (the
+        parity contract of tests/test_stream.py)."""
+        if self._finalized:
+            raise health_mod.StopQualityError(
+                f"session {self.scan_id} already finalized")
+        if len(self._labels) < 2:
+            raise health_mod.StopQualityError(
+                f"need at least 2 fused stops to finalize, have "
+                f"{len(self._labels)}")
+        t0 = time.monotonic()
+        p = self.params
+        mp = p.merge
+        n = len(self._labels)
+        loop = p.method == "posegraph" and mp.loop_closure
+        with events.context(scan_id=self.scan_id), \
+                trace.span("stream.finalize", stops=n):
+            result = self._finalize_inner(n, loop, mp, mesh)
+        self._finalized = True
+        events.record("session_finalized", stops_fused=n,
+                      stops_skipped=len(self._skipped),
+                      cloud_points=len(result.cloud),
+                      mesh_faces=None if result.mesh is None
+                      else int(len(result.mesh.faces)),
+                      elapsed_s=round(time.monotonic() - t0, 3))
+        return result
+
+    def _finalize_inner(self, n: int, loop: bool, mp, want_mesh: bool):
+        p = self.params
+        outs_T = [e.T_dev for e in self._edges]
+        fit = [e.fit for e in self._edges]
+        rmse = [e.rmse for e in self._edges]
+        infos = [np.asarray(e.info, np.float32) for e in self._edges]
+        if loop:
+            key = self._edge_key(len(self._edges))
+            hint = self._hint if self._hint is not None \
+                else jnp.eye(4, dtype=jnp.float32)
+            T, f, r, info = merge_mod.register_edge(
+                self._preps[0], self._preps[-1], mp, key=key, hint=hint)
+            outs_T.append(T)
+            fit.append(float(np.asarray(f)))
+            rmse.append(float(np.asarray(r)))
+            infos.append(np.asarray(info, np.float32))
+        Ts = jnp.stack(outs_T)
+        fit = np.asarray(fit)
+        rmse = np.asarray(rmse)
+        infos_dev = jnp.stack([jnp.asarray(i) for i in infos])
+
+        bridged = any(e.gap != 1 for e in self._edges)
+        n_edges = Ts.shape[0]
+        if not bridged and mp.axis_prior and n_edges >= 3:
+            # Clean ring: the batch loop strategy's consensus re-pass,
+            # fed from the retained per-stop preprocesses. Keys are
+            # re-derived per edge (NOT self._keys[:E]) so a session that
+            # outgrew its expected_stops schedule — edges past the split
+            # fall back to fold_in — still hands _edge_xs exactly the E
+            # keys the edges actually used.
+            pre_stacked = tuple(
+                jnp.stack([self._preps[i][j] for i in range(n)])
+                for j in range(4))
+            keys_used = jnp.stack([self._edge_key(i)
+                                   for i in range(n_edges)])
+            xs = merge_mod._edge_xs(pre_stacked, n, loop, keys_used)
+            Ts, fit_j, rmse_j, infos_dev = merge_mod._axis_pass_fn(mp)(
+                xs, (Ts, jnp.asarray(fit, jnp.float32),
+                     jnp.asarray(rmse, jnp.float32), infos_dev))
+            fit = np.asarray(fit_j)
+            rmse = np.asarray(rmse_j)
+
+        if p.gates is not None:
+            edges_meta = health_mod.ring_edges(
+                self._labels, loop,
+                span=scan360_mod._ring_span(self._labels, mp.step_deg))
+            Ts2, infos2, _ = health_mod.gate_edges(
+                edges_meta, np.asarray(Ts), fit, rmse,
+                np.asarray(infos_dev), p.gates, step_deg=mp.step_deg,
+                report=self.health)
+            seq_T = jnp.asarray(Ts2[: n - 1], jnp.float32)
+            seq_info = jnp.asarray(infos2[: n - 1], jnp.float32)
+            loop_T = jnp.asarray(Ts2[n - 1], jnp.float32) if loop else None
+            loop_info = jnp.asarray(infos2[n - 1], jnp.float32) \
+                if loop else None
+        else:
+            seq_T, seq_info = Ts[: n - 1], infos_dev[: n - 1]
+            loop_T = Ts[n - 1] if loop else None
+            loop_info = infos_dev[n - 1] if loop else None
+
+        if p.method == "posegraph":
+            graph = posegraph.build_360_graph(seq_T, seq_info, loop_T,
+                                              loop_info)
+            poses = posegraph.optimize(
+                graph, iterations=mp.posegraph_iterations)
+        else:
+            poses = posegraph.chain_poses(seq_T)
+        poses_f = jnp.asarray(poses, jnp.float32)
+
+        sub_pts = jnp.stack([s[0] for s in self._subs])
+        sub_col = jnp.stack([s[1] for s in self._subs])
+        sub_val = jnp.stack([s[2] for s in self._subs])
+        moved = scan360_mod._transform_views_fn()(poses_f, sub_pts)
+        merged = merge_mod._finalize(
+            moved.reshape(-1, 3), sub_col.reshape(-1, 3),
+            sub_val.reshape(-1), mp, has_colors=True)
+
+        poses_np = np.asarray(poses)
+        all_poses = np.tile(np.eye(4, dtype=np.float32),
+                            (self._next_label, 1, 1))
+        for j, lab in enumerate(self._labels):
+            all_poses[lab] = poses_np[j].astype(np.float32)
+        for lab, (_, predicted) in self._skipped.items():
+            all_poses[lab] = predicted.astype(np.float32)
+
+        final_mesh = None
+        if want_mesh:
+            from ..models import meshing
+
+            final_mesh = meshing.mesh_from_cloud(
+                merged, mode="watertight", depth=p.final_depth,
+                quantile_trim=p.final_trim)
+        stats = {
+            "stops_fused": n,
+            "stops_skipped": len(self._skipped),
+            "edges": [
+                {"src": e.src, "dst": e.dst, "gap": e.gap,
+                 "fitness": round(e.fit, 4), "rmse": round(e.rmse, 4)}
+                for e in self._edges],
+            "min_fitness": round(float(fit.min()), 4) if len(fit) else None,
+            "cloud_points": len(merged),
+        }
+        log.info("stream finalize[%s]: %d fused / %d skipped stops -> "
+                 "%d points%s", self.scan_id, n, len(self._skipped),
+                 len(merged),
+                 "" if final_mesh is None
+                 else f", {len(final_mesh.faces)} mesh faces")
+        return FinalizeResult(cloud=merged, poses=all_poses,
+                              mesh=final_mesh, health=self.health,
+                              stats=stats)

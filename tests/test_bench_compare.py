@@ -95,6 +95,47 @@ def test_strict_regression_vs_last_round_only(tmp_path, capsys):
     assert "flat" in out and "3.000" in out  # best column still shown
 
 
+def test_multi_headline_rounds_track_every_metric(tmp_path, capsys):
+    """Bench config [8] adds `first_preview_s` and
+    `incremental_vs_batch_final_s` headline lines NEXT TO the scan→mesh
+    headline (plus its crash-hedge scan→cloud early print). A round's
+    tail with several metric lines must contribute EVERY metric to the
+    trajectory, later lines must win per metric (the re-printed final
+    headline), and --strict must judge each metric independently."""
+    tail = "\n".join([
+        _headline("full_360_scan_24x46_1080p_s", 1.5),   # crash hedge
+        _headline("full_360_scan_to_mesh_s", 6.0),       # early print
+        _headline("first_preview_s", 0.8),
+        _headline("incremental_vs_batch_final_s", 7.0),
+        "[8] streaming 24-stop session: first preview 0.80 s",  # log noise
+        _headline("full_360_scan_to_mesh_s", 5.9),       # final re-print
+    ])
+    _round(tmp_path, 1, tail)
+    # Later line wins per metric: the trajectory holds 5.9, not 6.0.
+    traj = bench_compare.load_history(
+        [str(tmp_path / "BENCH_r01.json")])
+    assert traj["full_360_scan_to_mesh_s"] == [(1, 5.9)]
+    assert traj["first_preview_s"] == [(1, 0.8)]
+    assert traj["incremental_vs_batch_final_s"] == [(1, 7.0)]
+
+    # Fresh run: preview regressed beyond threshold, headline improved —
+    # strict fails on the one regressed metric and says which.
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text("\n".join([
+        _headline("full_360_scan_to_mesh_s", 5.0),
+        _headline("first_preview_s", 1.2),
+        _headline("incremental_vs_batch_final_s", 7.1),
+    ]) + "\n", encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["first_preview_s"] == "REGRESSION"
+    assert by_metric["full_360_scan_to_mesh_s"] == "improved"
+    assert by_metric["incremental_vs_batch_final_s"] == "flat"
+    assert doc["regressions"] == 1
+
+
 def test_json_mode_counts_regressions(tmp_path, capsys):
     _round(tmp_path, 1, _headline("full_360_scan_to_mesh_s", 1.0))
     rc = _run(tmp_path, _fresh(tmp_path, "full_360_scan_to_mesh_s", 2.0),

@@ -103,6 +103,24 @@ def test_scan_360_cli(session, tmp_path):
     assert len(ply_io.read_ply(str(out))) > 200
 
 
+@pytest.mark.slow
+def test_scan_360_cli_stream(session, tmp_path):
+    """`--stream` replays the stop folders through stream/: progressive
+    preview STL rewritten per fused stop, merged PLY at the end."""
+    root, mat = session
+    out = tmp_path / "streamed.ply"
+    preview = tmp_path / "prog.stl"
+    rc = cli.main(["scan-360", "-i", str(root), "-c", str(mat),
+                   "-o", str(out), "--method", "sequential",
+                   "--voxel-size", "6.0", "--max-points", "1024",
+                   "--stream", "--preview-out", str(preview),
+                   "--preview-depth", "4"])
+    assert rc == 0
+    assert len(ply_io.read_ply(str(out))) > 200
+    # The progressive preview is a readable, non-empty binary STL.
+    assert preview.exists() and preview.stat().st_size > 84
+
+
 def test_merge_and_mesh_cli(session, tmp_path, rng):
     # Synthetic sphere cloud -> write plys -> merge -> mesh.
     clouds = tmp_path / "clouds"

@@ -41,6 +41,13 @@ _DETAILS_ALIASES = {
 }
 
 
+def higher_is_better(metric: str) -> bool:
+    """Most headline metrics are seconds (lower wins); throughput lines
+    (config [9]'s ``soak_scans_per_s``) invert — going UP is the
+    improvement, going down the regression."""
+    return metric.endswith("_per_s")
+
+
 def _headline_metrics(text: str) -> dict[str, float]:
     """Every ``{"metric": ..., "value": ...}`` JSON line in ``text``;
     later lines win per metric (bench prints the crash-hedge scan→cloud
@@ -110,7 +117,8 @@ def compare(fresh: dict[str, float],
             traj: dict[str, list[tuple[int, float]]],
             threshold: float) -> list[dict]:
     """One row per fresh metric: verdict vs the last round and the best
-    round. Lower is better (every headline is seconds/milliseconds)."""
+    round. Latency metrics are lower-is-better; ``*_per_s`` throughput
+    metrics are higher-is-better (:func:`higher_is_better`)."""
     rows = []
     for metric in sorted(fresh):
         value = fresh[metric]
@@ -118,14 +126,20 @@ def compare(fresh: dict[str, float],
         row: dict = {"metric": metric, "fresh": value,
                      "rounds": len(history)}
         if history:
+            hib = higher_is_better(metric)
             last_n, last_v = history[-1]
-            best_n, best_v = min(history, key=lambda nv: nv[1])
+            best_n, best_v = (max if hib else min)(
+                history, key=lambda nv: nv[1])
             row.update(last=last_v, last_round=last_n,
                        best=best_v, best_round=best_n,
                        vs_last=round(value / last_v, 3) if last_v else None)
-            if last_v and value > last_v * (1 + threshold):
+            worse = (value < last_v * (1 - threshold) if hib
+                     else value > last_v * (1 + threshold))
+            better = (value > last_v * (1 + threshold) if hib
+                      else value < last_v * (1 - threshold))
+            if last_v and worse:
                 row["verdict"] = "REGRESSION"
-            elif last_v and value < last_v * (1 - threshold):
+            elif last_v and better:
                 row["verdict"] = "improved"
             else:
                 row["verdict"] = "flat"

@@ -95,8 +95,12 @@ def main() -> int:
                                      CAM_H, CAM_W, proj)
     client = ServeClient(f"http://127.0.0.1:{port[0]}", timeout_s=60.0)
     health = client.healthz()
-    if not health.get("ok"):
-        _fail(f"unhealthy server: {health}", proc, stderr_lines)
+    # /healthz is liveness (always ok while answering); READINESS —
+    # warmup done, worker lanes alive — is the /readyz contract.
+    ready = client.readyz()
+    if not health.get("ok") or not ready.get("ready"):
+        _fail(f"server not ready: health={health.get('ok')} "
+              f"ready={ready}", proc, stderr_lines)
 
     data, status = client.run(stack, result_format="stl",
                               timeout_s=DEADLINE_S)

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""CI soak/chaos smoke for the durable serving layer: real process, real
+SIGKILL, real recovery.
+
+A ~60 s offered-load run against `cli serve --store-dir` on a tiny rig:
+
+1. sustained submits (duplicates mixed in → content-cache hits; a
+   seeded fraction corrupted via the hw/faults chaos schedule → contained
+   per-job failures) plus a live 2-stop streaming session;
+2. a burst of un-awaited jobs, then **SIGKILL** — no drain, no cleanup;
+3. restart with ``--recover``: the journal replays — recovered burst
+   jobs complete under their ORIGINAL ids, the session accepts stop 3
+   and finalizes, a duplicate submit hits the persistent content cache;
+4. more load, then SIGTERM → clean graceful drain (exit 0) and a
+   journal-clean volume (zero live jobs/sessions on disk).
+
+Asserted throughout: zero recompile storms (`sl_recompile_storms_total`)
+and zero steady-state program-cache misses after each warmup. CI runs
+this as the `soak-smoke` job with SL_SANITIZE=1 (ci.yml), uploading a
+`cli diagnose` bundle on failure. The bench-scale version (minutes of
+load, RSS/device-memory bounds) is bench.py config [9].
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+DEADLINE_S = 540.0
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROJ_W, PROJ_H = 64, 32          # 6+5 bits, 24 frames
+CAM_H, CAM_W = 24, 40
+
+#: Small-rig session tuning — the SINGLE source the durability tests
+#: (tests/test_durability.py imports this module) and this smoke share,
+#: so both gates always exercise the same compiled-program keys.
+STREAM_PARAMS = {
+    "method": "posegraph", "view_cap": 1024, "preview_points": 1024,
+    "preview_depth": 4, "final_depth": 5, "model_cap": 8192, "window": 3,
+    "merge": {"voxel_size": 4.0, "ransac_iterations": 512,
+              "icp_iterations": 8, "fpfh_max_nn": 24, "normals_k": 8,
+              "max_points": 1024, "posegraph_iterations": 10,
+              "step_deg": 12.0},
+}
+
+
+def _fail(msg, procs=(), stderr_lines=None):
+    print(f"SOAK SMOKE FAIL: {msg}", file=sys.stderr)
+    if stderr_lines:
+        print("--- server stderr ---", file=sys.stderr)
+        print("".join(stderr_lines[-60:]), file=sys.stderr)
+    for p in procs:
+        if p is not None and p.poll() is None:
+            p.kill()
+    sys.exit(1)
+
+
+class SpawnError(RuntimeError):
+    """Serve subprocess never reached its readiness line."""
+
+
+def spawn_serve(store_dir, recover=False, extra=(), sanitize=True,
+                timeout_s=300.0):
+    """Start a tiny-rig `cli serve` subprocess over ``store_dir`` and
+    wait for its readiness line; returns (proc, port, stderr_lines).
+    Shared with tests/test_durability.py — one spawn recipe, one set of
+    session params, no drift between the two durability gates."""
+    cmd = [sys.executable, "-m",
+           "structured_light_for_3d_model_replication_tpu.cli", "serve",
+           "--port", "0", "--proj-width", str(PROJ_W),
+           "--proj-height", str(PROJ_H),
+           "--buckets", f"{CAM_H}x{CAM_W}", "--batch-sizes", "1,2",
+           "--linger-ms", "5", "--mesh-depth", "6",
+           "--store-dir", store_dir, "--preview-depth", "4",
+           "--stream-json", json.dumps(STREAM_PARAMS),
+           "--drain-timeout", "60"]
+    if recover:
+        cmd.append("--recover")
+    cmd += list(extra)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if sanitize:
+        env.setdefault("SL_SANITIZE", "1")
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stderr=subprocess.PIPE, text=True)
+    lines: list[str] = []
+    port = [None]
+    got = threading.Event()
+
+    def pump():
+        for line in proc.stderr:
+            lines.append(line)
+            m = re.search(r"serving on :(\d+)", line)
+            if m:
+                port[0] = int(m.group(1))
+                got.set()
+        got.set()
+
+    threading.Thread(target=pump, daemon=True).start()
+    if not got.wait(timeout_s) or port[0] is None:
+        proc.kill()
+        raise SpawnError("server never announced its port:\n"
+                         + "".join(lines[-30:]))
+    return proc, port[0], lines
+
+
+def _metric(text: str, name: str) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except (ValueError, IndexError):
+                pass
+    return total
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    sys.path.insert(0, REPO)
+    import tempfile
+
+    import numpy as np
+
+    from structured_light_for_3d_model_replication_tpu.config import (
+        ProjectorConfig,
+    )
+    from structured_light_for_3d_model_replication_tpu.hw.faults import (
+        CallSchedule,
+    )
+    from structured_light_for_3d_model_replication_tpu.models import (
+        synthetic,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve import (
+        read_live_state,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClient,
+        ServeClientError,
+    )
+
+    proj = ProjectorConfig(width=PROJ_W, height=PROJ_H)
+    cam = synthetic.default_calibration(CAM_H, CAM_W, proj)
+    stack, _ = synthetic.render_scan(synthetic.Scene(), *cam,
+                                     CAM_H, CAM_W, proj)
+    scene = synthetic.Scene(
+        wall_z=None,
+        spheres=(synthetic.Sphere((0.0, 2.0, 500.0), 80.0, 0.9),
+                 synthetic.Sphere((55.0, -30.0, 460.0), 35.0, 0.7)))
+    ring = [s for s, _ in synthetic.render_turntable_scans(
+        scene, n_stops=3, degrees_per_stop=12.0, cam_K=cam[0],
+        proj_K=cam[1], R=cam[2], T=cam[3], cam_height=CAM_H,
+        cam_width=CAM_W, proj=proj)]
+    variants = [stack + np.uint8(1 + i) for i in range(4)]
+    # Seeded chaos schedule (hw/faults): which offered submissions get a
+    # corrupted stack — black (coverage-gate failure, contained) or
+    # truncated (frame-count 400 at the door).
+    chaos = CallSchedule.seeded(7, n_calls=64,
+                                rates={"black": 0.08, "truncate": 0.07})
+
+    store_dir = tempfile.mkdtemp(prefix="sl-soak-smoke-")
+    try:
+        proc, port, lines = spawn_serve(store_dir)
+    except SpawnError as e:
+        _fail(str(e))
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout_s=60.0)
+
+    def offered_load(client, proc, lines, seconds, phase):
+        # proc/lines are the CURRENT server's (phase 2 runs against the
+        # recovered process — a failure must kill and dump that one,
+        # not the long-dead phase-1 process).
+        ok = dup_hits = contained = rejected = 0
+        deadline = time.monotonic() + seconds
+        i = 0
+        while time.monotonic() < deadline:
+            kind = chaos.next()
+            try:
+                if kind == "black":
+                    jid = client.submit(np.zeros_like(stack))
+                    st = client.wait(jid, timeout_s=60.0)
+                    if st["status"] == "failed" and "StopQualityError" \
+                            in st["error"]["taxonomy"]:
+                        contained += 1
+                    else:
+                        _fail(f"black stack not contained: {st}",
+                              (proc,), lines)
+                elif kind == "truncate":
+                    try:
+                        client.submit(variants[i % 4][:3])
+                        _fail("truncated stack accepted", (proc,), lines)
+                    except ServeClientError:
+                        rejected += 1
+                else:
+                    jid = client.submit(variants[i % 4])
+                    st = client.wait(jid, timeout_s=60.0)
+                    if st["status"] != "done":
+                        _fail(f"job failed in phase {phase}: {st}",
+                              (proc,), lines)
+                    if st["result"].get("content_cache_hit"):
+                        dup_hits += 1
+                    ok += 1
+            except ServeClientError as e:
+                _fail(f"load error in phase {phase}: {e}", (proc,), lines)
+            i += 1
+        return ok, dup_hits, contained, rejected
+
+    # Phase 1: warm the session lane first (its per-stop programs
+    # compile on first use — expected, NOT a steady-state storm), then
+    # sustained load with a zero-new-storms assertion over it.
+    sid = client.create_session()
+    for s in ring[:2]:
+        st = client.wait(client.submit_stop(sid, s), timeout_s=120.0)
+        if st["status"] != "done":
+            _fail(f"stop failed: {st}", (proc,), lines)
+    storms0 = _metric(client.metrics(), "sl_recompile_storms_total")
+    ok1, hits1, contained1, rejected1 = offered_load(client, proc, lines,
+                                                     20.0, 1)
+    if ok1 < 4 or hits1 < 1 or (contained1 + rejected1) < 1:
+        _fail(f"phase 1 too quiet: ok={ok1} hits={hits1} "
+              f"chaos={contained1}+{rejected1}", (proc,), lines)
+    storms1 = _metric(client.metrics(), "sl_recompile_storms_total")
+    if storms1 > storms0:
+        _fail("recompile storm during steady-state load", (proc,), lines)
+    burst = [client.submit(stack + np.uint8(40 + i)) for i in range(6)]
+    proc.kill()                                   # SIGKILL — no drain
+    proc.wait(timeout=30.0)
+    print(f"phase 1: {ok1} jobs ({hits1} duplicate hits, {contained1} "
+          f"contained, {rejected1} rejected), session {sid} @2 stops, "
+          f"killed -9 with {len(burst)} in flight "
+          f"({time.monotonic() - t0:.0f}s)")
+
+    # Phase 2: recover and carry on.
+    try:
+        proc2, port2, lines2 = spawn_serve(store_dir, recover=True)
+    except SpawnError as e:
+        _fail(str(e))
+    client = ServeClient(f"http://127.0.0.1:{port2}", timeout_s=60.0)
+    if not client.readyz().get("ready"):
+        _fail("recovered server not ready", (proc2,), lines2)
+    if not any("recovered from" in ln for ln in lines2):
+        _fail("no recovery line on stderr", (proc2,), lines2)
+    recovered = 0
+    for jid in burst:
+        try:
+            st = client.wait(jid, timeout_s=120.0)
+        except ServeClientError:
+            continue                               # finished pre-kill
+        if st["status"] != "done":
+            _fail(f"recovered job {jid} failed: {st}", (proc2,), lines2)
+        recovered += 1
+    st = client.session_status(sid)
+    if st.get("stops_fused") != 2:
+        _fail(f"session not recovered: {st}", (proc2,), lines2)
+    stj = client.wait(client.submit_stop(sid, ring[2]), timeout_s=120.0)
+    if stj["status"] != "done":
+        _fail(f"post-recovery stop failed: {stj}", (proc2,), lines2)
+    fin = client.finalize_session(sid, result_format="ply")
+    data = client.result(fin["job_id"])
+    if not data.startswith(b"ply"):
+        _fail("finalize artifact not a PLY", (proc2,), lines2)
+    # Cross-restart duplicate → persistent content cache.
+    jdup = client.submit(variants[0])
+    stdup = client.wait(jdup, timeout_s=60.0)
+    if not stdup["result"].get("content_cache_hit"):
+        _fail(f"no cross-restart content hit: {stdup}", (proc2,), lines2)
+    storms0 = _metric(client.metrics(), "sl_recompile_storms_total")
+    ok2, hits2, contained2, rejected2 = offered_load(client, proc2,
+                                                     lines2, 15.0, 2)
+    metrics = client.metrics()
+    if _metric(metrics, "sl_recompile_storms_total") > storms0:
+        _fail("recompile storm during post-recovery steady state",
+              (proc2,), lines2)
+    if _metric(metrics, "serve_content_cache_hits_total") < 1:
+        _fail("content cache counters missing", (proc2,), lines2)
+    print(f"phase 2: recovered {recovered} burst job(s), session "
+          f"finalized ({len(data)} B), {ok2} more jobs "
+          f"({hits2} duplicate hits)")
+
+    # Graceful drain + journal-clean volume.
+    proc2.send_signal(signal.SIGTERM)
+    try:
+        rc = proc2.wait(timeout=max(10.0, DEADLINE_S
+                                    - (time.monotonic() - t0)))
+    except subprocess.TimeoutExpired:
+        _fail("no exit after SIGTERM", (proc2,), lines2)
+    if rc != 0:
+        _fail(f"server exited {rc} after SIGTERM", None, lines2)
+    time.sleep(0.2)
+    if not any("drained clean" in ln for ln in lines2):
+        _fail("no 'drained clean' on stderr", None, lines2)
+    state = read_live_state(store_dir)
+    if state.jobs or state.sessions:
+        _fail(f"journal not clean after drain: {len(state.jobs)} jobs, "
+              f"{len(state.sessions)} sessions", None, lines2)
+    print(f"SOAK SMOKE PASS in {time.monotonic() - t0:.0f}s "
+          "(load + chaos → SIGKILL → recover → finalize → clean drain, "
+          "journal empty)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

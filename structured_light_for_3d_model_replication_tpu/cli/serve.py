@@ -76,7 +76,44 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout", type=float, default=30.0,
                    help="max seconds to wait for in-flight jobs on "
                         "SIGTERM")
+    # -- durability (docs/SERVING.md § durability) ----------------------
+    p.add_argument("--store-dir", default=None,
+                   help="journal volume (crash-safe WAL of admissions + "
+                        "session stops, persistent content cache); "
+                        "unset = in-memory service")
+    p.add_argument("--recover", action="store_true",
+                   help="replay the --store-dir journal at startup: "
+                        "re-queue non-terminal jobs, rebuild live "
+                        "sessions (requires --store-dir)")
+    p.add_argument("--no-content-cache", action="store_true",
+                   help="disable the content-hash result cache "
+                        "(duplicate submits recompute)")
+    p.add_argument("--stream-json", default=None,
+                   help="JSON overrides for the session StreamParams, "
+                        "e.g. '{\"method\":\"sequential\",\"merge\":"
+                        "{\"voxel_size\":4.0}}' — a 'merge' sub-object "
+                        "overrides MergeParams. Fixed at startup (it "
+                        "keys compiled programs)")
     return p
+
+
+def _stream_params(base, spec: str | None):
+    """Apply ``--stream-json`` overrides onto the default StreamParams
+    (nested ``merge`` dict → MergeParams replace)."""
+    import dataclasses
+
+    if not spec:
+        return base
+    import json
+
+    doc = json.loads(spec)
+    if not isinstance(doc, dict):
+        raise ValueError("--stream-json must be a JSON object")
+    merge_over = doc.pop("merge", None)
+    merge = base.merge
+    if merge_over:
+        merge = dataclasses.replace(merge, **merge_over)
+    return dataclasses.replace(base, merge=merge, **doc)
 
 
 def main(argv=None) -> int:
@@ -106,9 +143,21 @@ def main(argv=None) -> int:
               f"{args.buckets!r} — pass the single HxW matching the "
               "calibration's camera", file=sys.stderr)
         return 2
+    if args.recover and args.store_dir is None:
+        print("error: --recover requires --store-dir (the journal "
+              "volume to replay)", file=sys.stderr)
+        return 2
     import dataclasses
 
     defaults = ServeConfig()
+    try:
+        stream = _stream_params(
+            dataclasses.replace(defaults.stream,
+                                preview_depth=args.preview_depth),
+            args.stream_json)
+    except (ValueError, TypeError) as e:
+        print(f"error: bad --stream-json: {e}", file=sys.stderr)
+        return 2
     config = ServeConfig(
         proj=proj,
         queue_depth=args.queue_depth,
@@ -119,8 +168,9 @@ def main(argv=None) -> int:
         warmup=not args.no_warmup,
         mesh_depth=args.mesh_depth,
         max_sessions=args.max_sessions,
-        stream=dataclasses.replace(defaults.stream,
-                                   preview_depth=args.preview_depth))
+        store_dir=args.store_dir,
+        content_cache=not args.no_content_cache,
+        stream=stream)
 
     calib_provider = None
     if args.calib is not None:
@@ -133,7 +183,13 @@ def main(argv=None) -> int:
     service = ReconstructionService(config, calib_provider=calib_provider)
     print("warming program cache..." if config.warmup else
           "warmup skipped (--no-warmup)", file=sys.stderr, flush=True)
-    service.start()
+    service.start(recover_from=True if args.recover else None)
+    if args.recover:
+        st = service.stats()
+        print(f"recovered from {args.store_dir}: "
+              f"{st['queue_depth']} job(s) re-queued, "
+              f"{st['sessions']['live']} live session(s)",
+              file=sys.stderr, flush=True)
     http = ServeHTTPServer(service, host=args.host, port=args.port).start()
     # Machine-parseable readiness line (the CI smoke script greps it).
     print(f"serving on :{http.port}", file=sys.stderr, flush=True)

@@ -43,8 +43,10 @@ architecture and tuning guide.
 """
 
 from .batcher import Batch, BucketBatcher, BucketKey, bucket_for
-from .cache import ProgramCache, ProgramKey
+from .cache import ContentCache, ProgramCache, ProgramKey, content_key
 from .client import ServeClient
+from .governor import BreakerOpenError, GovernorParams, LoadShedError, \
+    OverloadGovernor
 from .jobs import (
     AdmissionQueue,
     Job,
@@ -56,21 +58,29 @@ from .jobs import (
 )
 from .service import ReconstructionService, ServeConfig, ServeHTTPServer
 from .sessions import SessionLimitError, SessionManager, UnknownSessionError
+from .store import JournalStore, RecoveredState, read_live_state
 from .worker import DeviceWorker
 
 __all__ = [
     "AdmissionQueue",
     "Batch",
+    "BreakerOpenError",
     "BucketBatcher",
     "BucketKey",
+    "ContentCache",
     "DeviceWorker",
+    "GovernorParams",
     "Job",
     "JobRejected",
+    "JournalStore",
+    "LoadShedError",
+    "OverloadGovernor",
     "ProgramCache",
     "ProgramKey",
     "QueueClosedError",
     "QueueFullError",
     "ReconstructionService",
+    "RecoveredState",
     "ServeClient",
     "ServeConfig",
     "ServeError",
@@ -80,4 +90,6 @@ __all__ = [
     "StackFormatError",
     "UnknownSessionError",
     "bucket_for",
+    "content_key",
+    "read_live_state",
 ]

@@ -28,6 +28,9 @@ compiles outside the registry lock.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -196,3 +199,206 @@ class ProgramCache:
             "evictions": int(self._evictions.value),
             "compile_seconds_total": round(self._compile_s.value, 3),
         }
+
+
+# ---------------------------------------------------------------------------
+# Content-hash result cache
+# ---------------------------------------------------------------------------
+
+
+def content_key(stack, config_sig: str) -> str:
+    """SHA-256 over the capture stack (shape + dtype + raw bytes) and
+    the reconstruction config signature: two submits with identical
+    pixels AND identical processing settings name the same artifact.
+    Shape/dtype are part of the key — raw bytes alone would let two
+    different-shaped stacks over the same buffer collide."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    h.update(config_sig.encode())
+    h.update(f"{stack.shape}/{stack.dtype}".encode())
+    h.update(np.ascontiguousarray(stack).tobytes())
+    return h.hexdigest()
+
+
+class ContentCache:
+    """Byte-bounded LRU of finished result artifacts keyed by content
+    hash — the admission-time duplicate detector.
+
+    A duplicate submit returns the cached mesh without touching the
+    queue, which makes it independent of BOTH bounds the job registry
+    enforces (``completed_cap`` / ``result_cache_bytes``): a result
+    evicted from the registry's byte budget still answers a resubmit
+    with 200 instead of 410. With a directory (the journal volume's
+    ``content/``) the cache also survives restarts: payloads live on
+    disk (``<key>.bin`` + ``<key>.json`` sidecar, tmp + atomic rename),
+    the in-memory index is rebuilt from the sidecars at open, and hits
+    read the payload back lazily. Without a directory it is memory-only
+    with the same budget.
+
+    Failed jobs are never cached (their taxonomy payload is the honest
+    answer), and session stops never consult it (a duplicate stop is the
+    covisibility gate's decision, not the cache's).
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20, dir: str | None = None,
+                 registry: "trace.MetricsRegistry | None" = None):
+        self.max_bytes = int(max_bytes)
+        self.dir = dir
+        self.registry = registry if registry is not None else trace.REGISTRY
+        self._lock = threading.Lock()
+        # key -> {"bytes": int, "format": str, "meta": dict,
+        #         "payload": bytes | None}   (payload None = on disk)
+        self._index: OrderedDict[str, dict] = OrderedDict()
+        self._held = 0
+        self._hits = self.registry.counter(
+            "serve_content_cache_hits_total",
+            "admissions answered from the content-hash result cache")
+        self._misses = self.registry.counter(
+            "serve_content_cache_misses_total",
+            "admissions that found no cached artifact")
+        self._evictions = self.registry.counter(
+            "serve_content_cache_evictions_total",
+            "artifacts dropped by the byte budget")
+        self._bytes_gauge = self.registry.gauge(
+            "serve_content_cache_bytes", "retained artifact bytes")
+        if dir is not None:
+            os.makedirs(dir, exist_ok=True)
+            self._load_index()
+
+    # ------------------------------------------------------------------
+
+    def _payload_path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.bin")
+
+    def _load_index(self) -> None:
+        """Rebuild the index from sidecars, oldest first (so LRU order
+        approximates the previous process's write order)."""
+        sidecars = []
+        for fname in os.listdir(self.dir):
+            if not fname.endswith(".json"):
+                continue
+            path = os.path.join(self.dir, fname)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            key = fname[:-5]
+            if not os.path.exists(self._payload_path(key)):
+                continue
+            sidecars.append((float(doc.get("t", 0.0)), key, doc))
+        for _, key, doc in sorted(sidecars):
+            n = int(doc.get("bytes", 0))
+            self._index[key] = {"bytes": n,
+                                "format": doc.get("format", "ply"),
+                                "meta": dict(doc.get("meta") or {}),
+                                "payload": None}
+            self._held += n
+        # Enforce the budget at load too: a lowered max_bytes (or a
+        # previous process's fuller budget) must not survive the
+        # restart — evict oldest exactly like put() does.
+        while self._held > self.max_bytes and len(self._index) > 1:
+            victim, entry = self._index.popitem(last=False)
+            self._held -= entry["bytes"]
+            self._evictions.inc()
+            for suffix in (".bin", ".json"):
+                try:
+                    os.remove(os.path.join(self.dir, f"{victim}{suffix}"))
+                except OSError:
+                    pass
+        self._bytes_gauge.set(self._held)
+        if self._index:
+            log.info("content cache: %d artifacts (%d MB) recovered "
+                     "from %s", len(self._index), self._held >> 20,
+                     self.dir)
+
+    # ------------------------------------------------------------------
+
+    def get(self, key: str) -> tuple[bytes, dict, str] | None:
+        """(payload, meta, format) for ``key``, or None. Counts the
+        hit/miss; disk reads happen outside the index lock."""
+        with self._lock:
+            entry = self._index.get(key)
+            if entry is not None:
+                self._index.move_to_end(key)
+                payload = entry["payload"]
+                meta, fmt = dict(entry["meta"]), entry["format"]
+        if entry is None:
+            self._misses.inc()
+            return None
+        if payload is None:
+            try:
+                with open(self._payload_path(key), "rb") as f:
+                    payload = f.read()
+            except OSError as e:
+                log.warning("content cache payload %s unreadable: %s",
+                            key[:12], e)
+                with self._lock:
+                    gone = self._index.pop(key, None)
+                    if gone is not None:
+                        self._held -= gone["bytes"]
+                        self._bytes_gauge.set(self._held)
+                self._misses.inc()
+                return None
+        self._hits.inc()
+        return payload, meta, fmt
+
+    def put(self, key: str, payload: bytes, meta: dict, fmt: str) -> None:
+        """Retain one finished artifact; evicts oldest past the byte
+        budget. File writes happen before the index insert so a hit can
+        never race a half-written payload."""
+        if len(payload) > self.max_bytes:
+            return  # one artifact over the whole budget: not cacheable
+        stored: bytes | None = payload
+        if self.dir is not None:
+            path = self._payload_path(key)
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, path)
+                side = os.path.join(self.dir, f"{key}.json")
+                with open(side + ".tmp", "w", encoding="utf-8") as f:
+                    json.dump({"format": fmt, "meta": meta,
+                               "bytes": len(payload),
+                               "t": time.time()}, f)
+                os.replace(side + ".tmp", side)
+            except OSError as e:
+                log.warning("content cache write failed: %s", e)
+                return
+            stored = None
+        victims: list[str] = []
+        with self._lock:
+            prior = self._index.pop(key, None)
+            if prior is not None:
+                self._held -= prior["bytes"]
+            self._index[key] = {"bytes": len(payload), "format": fmt,
+                                "meta": dict(meta), "payload": stored}
+            self._held += len(payload)
+            while self._held > self.max_bytes and len(self._index) > 1:
+                victim, entry = self._index.popitem(last=False)
+                self._held -= entry["bytes"]
+                victims.append(victim)
+            self._bytes_gauge.set(self._held)
+        for victim in victims:
+            self._evictions.inc()
+            if self.dir is not None:
+                for suffix in (".bin", ".json"):
+                    try:
+                        os.remove(os.path.join(self.dir,
+                                               f"{victim}{suffix}"))
+                    except OSError:
+                        pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._index),
+                "bytes": self._held,
+                "max_bytes": self.max_bytes,
+                "persistent": self.dir is not None,
+                "hits": int(self._hits.value),
+                "misses": int(self._misses.value),
+                "evictions": int(self._evictions.value),
+            }

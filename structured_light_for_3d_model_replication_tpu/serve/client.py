@@ -7,12 +7,21 @@ the submit → poll → fetch dance for tests, the bench offered-load sweep
 (config [7]) and the CI smoke script, with honest error surfacing:
 backpressure (429/503) raises :class:`BackpressureError` carrying the
 server's retry-after hint instead of burying it in response prose.
+
+Submitting calls (``submit`` / ``submit_stop`` / ``create_session``)
+retry backpressure themselves by default: the server's ``Retry-After``
+hint is honored when present (else exponential backoff), jittered so a
+rejected burst does not re-arrive as the same burst, and bounded by BOTH
+an attempt count (``retries``) and a wall-clock budget
+(``retry_budget_s``). ``retries=0`` restores surface-immediately
+semantics.
 """
 
 from __future__ import annotations
 
 import io
 import json
+import random
 import time
 import urllib.error
 import urllib.request
@@ -38,11 +47,42 @@ class BackpressureError(ServeClientError):
 
 
 class ServeClient:
-    def __init__(self, base_url: str, timeout_s: float = 30.0):
+    def __init__(self, base_url: str, timeout_s: float = 30.0,
+                 retries: int = 4, retry_backoff_s: float = 0.25,
+                 retry_budget_s: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_budget_s = float(retry_budget_s)
+        # Injectable for deterministic tests.
+        self._sleep = time.sleep
+        self._rng = random.Random()
 
     # ------------------------------------------------------------------
+
+    def _retrying(self, fn):
+        """Run ``fn`` with jittered backoff on backpressure: the server's
+        Retry-After hint (when present) sets the base delay, otherwise
+        exponential from ``retry_backoff_s``; every delay is jittered
+        ±50% so N rejected clients don't re-arrive in lockstep. Bounded
+        by attempts AND wall clock; the LAST rejection is re-raised
+        intact (hint included) when the budget is spent."""
+        deadline = time.monotonic() + self.retry_budget_s
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except BackpressureError as e:
+                if attempt >= self.retries:
+                    raise
+                base = (e.retry_after_s if e.retry_after_s
+                        else self.retry_backoff_s * (2 ** attempt))
+                delay = base * self._rng.uniform(0.5, 1.5)
+                if time.monotonic() + delay > deadline:
+                    raise
+                self._sleep(delay)
+                attempt += 1
 
     def _request(self, req: urllib.request.Request):
         try:
@@ -63,7 +103,9 @@ class ServeClient:
     def submit(self, stack: np.ndarray, result_format: str = "ply",
                priority: str = "normal",
                deadline_s: float | None = None) -> str:
-        """POST one capture stack; returns the job id."""
+        """POST one capture stack; returns the job id. Backpressure
+        (429/503) is retried per the client's retry policy before a
+        :class:`BackpressureError` surfaces."""
         stack = np.asarray(stack)
         if stack.dtype != np.uint8:
             # No silent coercion: casting float [0,1] data (or aliasing
@@ -80,23 +122,32 @@ class ServeClient:
                    "X-Priority": priority}
         if deadline_s is not None:
             headers["X-Deadline-S"] = str(deadline_s)
-        req = urllib.request.Request(self.base_url + "/submit",
-                                     data=buf.getvalue(), headers=headers,
-                                     method="POST")
-        status, hdrs, body = self._request(req)
-        payload = self._payload(body)
-        if status in (429, 503):
-            retry = payload.get("error", {}).get("retry_after_s")
-            if retry is None and hdrs.get("Retry-After"):
-                retry = float(hdrs["Retry-After"])
-            raise BackpressureError(
-                f"submit refused ({status}): "
-                f"{payload.get('error', {}).get('message', 'overloaded')}",
-                retry, payload)
-        if status != 200:
-            raise ServeClientError(f"submit failed ({status}): {payload}",
-                                   payload)
-        return payload["job_id"]
+        data = buf.getvalue()
+
+        def once():
+            req = urllib.request.Request(self.base_url + "/submit",
+                                         data=data, headers=headers,
+                                         method="POST")
+            status, hdrs, body = self._request(req)
+            payload = self._payload(body)
+            if status in (429, 503):
+                msg = payload.get("error", {}).get("message", "overloaded")
+                raise BackpressureError(
+                    f"submit refused ({status}): {msg}",
+                    self._retry_hint(payload, hdrs), payload)
+            if status != 200:
+                raise ServeClientError(
+                    f"submit failed ({status}): {payload}", payload)
+            return payload["job_id"]
+
+        return self._retrying(once)
+
+    @staticmethod
+    def _retry_hint(payload: dict, hdrs: dict) -> float | None:
+        retry = payload.get("error", {}).get("retry_after_s")
+        if retry is None and hdrs.get("Retry-After"):
+            retry = float(hdrs["Retry-After"])
+        return retry
 
     def status(self, job_id: str) -> dict:
         status, _, body = self._request(urllib.request.Request(
@@ -146,20 +197,25 @@ class ServeClient:
     def create_session(self, **options) -> str:
         """POST /session → session id. ``options`` are the per-session
         overrides the server allows (preview_depth, expected_stops, …)."""
-        req = urllib.request.Request(
-            self.base_url + "/session",
-            data=json.dumps(options).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
-        status, hdrs, body = self._request(req)
-        payload = self._payload(body)
-        if status in (429, 503):
-            raise BackpressureError(
-                f"session refused ({status})",
-                payload.get("error", {}).get("retry_after_s"), payload)
-        if status != 200:
-            raise ServeClientError(
-                f"create_session failed ({status}): {payload}", payload)
-        return payload["session_id"]
+        def once():
+            req = urllib.request.Request(
+                self.base_url + "/session",
+                data=json.dumps(options).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            status, hdrs, body = self._request(req)
+            payload = self._payload(body)
+            if status in (429, 503):
+                raise BackpressureError(
+                    f"session refused ({status})",
+                    self._retry_hint(payload, hdrs), payload)
+            if status != 200:
+                raise ServeClientError(
+                    f"create_session failed ({status}): {payload}",
+                    payload)
+            return payload["session_id"]
+
+        return self._retrying(once)
 
     def submit_stop(self, session_id: str, stack: np.ndarray) -> str:
         """POST one stop's capture stack into a session; returns the
@@ -171,23 +227,26 @@ class ServeClient:
                 f"stack must be uint8, got {stack.dtype}")
         buf = io.BytesIO()
         np.save(buf, stack)
-        req = urllib.request.Request(
-            f"{self.base_url}/session/{session_id}/stop",
-            data=buf.getvalue(),
-            headers={"Content-Type": "application/octet-stream"},
-            method="POST")
-        status, hdrs, body = self._request(req)
-        payload = self._payload(body)
-        if status in (429, 503):
-            retry = payload.get("error", {}).get("retry_after_s")
-            if retry is None and hdrs.get("Retry-After"):
-                retry = float(hdrs["Retry-After"])
-            raise BackpressureError(
-                f"stop refused ({status})", retry, payload)
-        if status != 200:
-            raise ServeClientError(
-                f"submit_stop failed ({status}): {payload}", payload)
-        return payload["job_id"]
+        data = buf.getvalue()
+
+        def once():
+            req = urllib.request.Request(
+                f"{self.base_url}/session/{session_id}/stop",
+                data=data,
+                headers={"Content-Type": "application/octet-stream"},
+                method="POST")
+            status, hdrs, body = self._request(req)
+            payload = self._payload(body)
+            if status in (429, 503):
+                raise BackpressureError(
+                    f"stop refused ({status})",
+                    self._retry_hint(payload, hdrs), payload)
+            if status != 200:
+                raise ServeClientError(
+                    f"submit_stop failed ({status}): {payload}", payload)
+            return payload["job_id"]
+
+        return self._retrying(once)
 
     def session_status(self, session_id: str) -> dict:
         status, _, body = self._request(urllib.request.Request(
@@ -238,8 +297,16 @@ class ServeClient:
     # ------------------------------------------------------------------
 
     def healthz(self) -> dict:
+        """Liveness: 200 with stats while the process answers."""
         _, _, body = self._request(urllib.request.Request(
             self.base_url + "/healthz"))
+        return self._payload(body)
+
+    def readyz(self) -> dict:
+        """Readiness: ``{"ready": bool, "reasons": [...]}`` — 503-bodied
+        during warmup/recovery, drain, or with no worker lanes alive."""
+        _, _, body = self._request(urllib.request.Request(
+            self.base_url + "/readyz"))
         return self._payload(body)
 
     def metrics(self) -> str:

@@ -152,6 +152,16 @@ class Job:
     # cache as one-shot jobs, so they coalesce into the same batches.
     decode_sink: "callable | None" = dataclasses.field(
         default=None, repr=False)
+    # Durability plumbing (serve/store.py): the content-hash cache key of
+    # this job's artifact (None = uncacheable, e.g. session stops), and
+    # which journal vocabulary its terminal transition appends under
+    # ("job" — one-shot, recoverable; "stop" and None journal nothing at
+    # terminal: stops are tracked per session, synthesized jobs not at
+    # all). ``recovered`` marks a job re-queued from the journal.
+    content_key: str | None = dataclasses.field(default=None, repr=False)
+    journal_kind: str | None = dataclasses.field(default=None, repr=False)
+    session_id: str | None = dataclasses.field(default=None, repr=False)
+    recovered: bool = dataclasses.field(default=False, repr=False)
 
     submitted_t: float = 0.0
     started_t: float | None = None
@@ -356,6 +366,13 @@ class AdmissionQueue:
         with self._lock:
             self._service_ema_s = (0.8 * self._service_ema_s
                                    + 0.2 * max(1e-3, seconds))
+
+    def retry_hint(self) -> float:
+        """Honest retry-after estimate at the CURRENT depth (what a
+        QueueFullError would carry) — for rejections decided outside the
+        queue, e.g. the overload governor's shedding tiers."""
+        with self._lock:
+            return max(0.05, max(1, len(self._heap)) * self._service_ema_s)
 
     def depth(self) -> int:
         with self._lock:

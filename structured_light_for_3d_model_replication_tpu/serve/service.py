@@ -32,6 +32,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import json
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -46,7 +47,8 @@ from ..stream import StreamParams
 from ..utils import events, telemetry, trace
 from ..utils.log import get_logger
 from .batcher import BucketBatcher, BucketKey
-from .cache import ProgramCache
+from .cache import ContentCache, ProgramCache, ProgramKey, content_key
+from .governor import GovernorParams, OverloadGovernor
 from .jobs import (
     DONE,
     FAILED,
@@ -57,6 +59,7 @@ from .jobs import (
     error_payload,
 )
 from .sessions import SessionManager, UnknownSessionError
+from .store import JournalStore
 from .worker import DeviceWorker
 
 log = get_logger(__name__)
@@ -102,6 +105,23 @@ class ServeConfig:
     # Idle expiry for sessions (live AND finalized): a crashed client's
     # abandoned session frees its slot + model buffers after this.
     session_ttl_s: float = 3600.0
+    # -- durability (serve/store.py; docs/SERVING.md § durability) --------
+    # Journal volume: crash-safe WAL of job admissions/terminals and
+    # per-session accepted stops, plus the persistent half of the
+    # content cache. None = in-memory service (the historical behavior);
+    # set it and restart with start(recover_from=...) / `--recover` to
+    # survive kill -9.
+    store_dir: str | None = None
+    # Content-hash result cache: duplicate submits (same stack bytes +
+    # same processing config) return the finished artifact at admission
+    # without touching the queue — and, with a store_dir, across
+    # restarts and past result-registry eviction.
+    content_cache: bool = True
+    content_cache_bytes: int = 256 << 20
+    # Overload governor (serve/governor.py): circuit breaker on the
+    # worker-exception rate, graduated load shedding (previews first,
+    # then low-priority admissions), worker watchdog.
+    governor: GovernorParams = GovernorParams()
 
 
 def synthetic_calib_provider(proj: ProjectorConfig):
@@ -171,12 +191,30 @@ class ReconstructionService:
         self.cache = ProgramCache(self.calib_provider,
                                   max_entries=config.max_cache_entries,
                                   registry=self.registry)
-        self.workers = [
-            DeviceWorker(self.batcher, self.cache, gates=config.gates,
-                         mesh_depth=config.mesh_depth,
-                         registry=self.registry, tracer=self.tracer,
-                         name=f"serve-worker-{i}")
-            for i in range(max(1, config.workers))]
+        # Durability journal + persistent content cache share one volume.
+        self.store: JournalStore | None = (
+            JournalStore(config.store_dir)
+            if config.store_dir is not None else None)
+        self.content_cache: ContentCache | None = (
+            ContentCache(max_bytes=config.content_cache_bytes,
+                         dir=(self.store.content_dir
+                              if self.store is not None else None),
+                         registry=self.registry)
+            if config.content_cache else None)
+        # Constructed here (its counter families must exist in the
+        # registry from the first scrape) but installed into the compile-
+        # event dispatch only for the start→drain window, so an abandoned
+        # or failed service never keeps receiving process-wide events.
+        self.telemetry: "telemetry.DeviceTelemetry | None" = (
+            telemetry.DeviceTelemetry(registry=self.registry)
+            if config.telemetry else None)
+        self.governor = OverloadGovernor(
+            config.governor, self.queue, self.registry,
+            telemetry=self.telemetry, store=self.store)
+        self._workers_lock = threading.Lock()
+        self._worker_seq = max(1, config.workers)
+        self.workers = [self._make_worker(f"serve-worker-{i}")
+                        for i in range(max(1, config.workers))]
         self._jobs_lock = threading.Lock()
         self._jobs: OrderedDict[str, Job] = OrderedDict()
         self._draining = False
@@ -196,24 +234,76 @@ class ReconstructionService:
         self._run_s = self.registry.histogram(
             "serve_job_run_seconds", "start-to-terminal time per job",
             buckets=trace.LATENCY_SECONDS_BUCKETS)
-        # Constructed here (its counter families must exist in the
-        # registry from the first scrape) but installed into the compile-
-        # event dispatch only for the start→drain window, so an abandoned
-        # or failed service never keeps receiving process-wide events.
-        self.telemetry: "telemetry.DeviceTelemetry | None" = (
-            telemetry.DeviceTelemetry(registry=self.registry)
-            if config.telemetry else None)
         self._events_seen: dict[str, int] = {}  # _sync_event_counters
         self._events_seen_lock = threading.Lock()
         self._warmup_report: dict = {}
+        self._ready = False  # /readyz: warmup + recovery complete
         self.sessions = SessionManager(
             config.stream, config.proj, config.decode_cfg, config.tri_cfg,
             max_sessions=config.max_sessions,
-            session_ttl_s=config.session_ttl_s)
+            session_ttl_s=config.session_ttl_s,
+            store=self.store,
+            preview_shed=self.governor.shed_previews)
+
+    def _make_worker(self, name: str) -> DeviceWorker:
+        return DeviceWorker(self.batcher, self.cache,
+                            gates=self.config.gates,
+                            mesh_depth=self.config.mesh_depth,
+                            registry=self.registry, tracer=self.tracer,
+                            name=name, governor=self.governor)
+
+    def _restart_worker(self, wedged: DeviceWorker) -> DeviceWorker:
+        """Watchdog callback: replace one wedged worker with a fresh
+        lane. The wedged thread is asked to stop but cannot be killed —
+        if its launch ever returns, Job's first-terminal-wins rule makes
+        the race harmless."""
+        wedged.request_stop()
+        wedged.abort()
+        with self._workers_lock:
+            self._worker_seq += 1
+            repl = self._make_worker(
+                f"serve-worker-r{self._worker_seq}")
+            self.workers = [repl if w is wedged else w
+                            for w in self.workers]
+        repl.start()
+        return repl
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self) -> "ReconstructionService":
+    def start(self, recover_from: "str | bool | None" = None
+              ) -> "ReconstructionService":
+        """Warm up, optionally recover a journal volume, start workers.
+
+        ``recover_from``: True replays this service's own ``store_dir``;
+        a path opens (and adopts) that volume. Recovery runs AFTER
+        warmup — the replay rides the already-compiled B=1 lane — and
+        BEFORE the workers start, so recovered jobs re-queue exactly
+        once, ahead of fresh traffic. ``/readyz`` reports 503 until this
+        method completes."""
+        if recover_from and recover_from is not True \
+                and self.store is not None and os.path.abspath(
+                    str(recover_from)) != os.path.abspath(self.store.root):
+            # Silently replaying the CONFIGURED volume while the caller
+            # named a different one would "recover" nothing they asked
+            # for and journal new state to the wrong disk.
+            raise ValueError(
+                f"recover_from={recover_from!r} conflicts with the "
+                f"configured store_dir {self.store.root!r} — a service "
+                "journals to exactly one volume")
+        if recover_from and self.store is None:
+            if recover_from is True:
+                raise ValueError("recover_from=True needs a configured "
+                                 "store_dir")
+            self.store = JournalStore(str(recover_from))
+            self.sessions.store = self.store
+            self.governor.store = self.store
+            if self.content_cache is not None:
+                # Adopting the volume adopts its persistent content
+                # cache too — the memory-only cache built when store_dir
+                # was unset would miss every pre-restart artifact.
+                self.content_cache = ContentCache(
+                    max_bytes=self.config.content_cache_bytes,
+                    dir=self.store.content_dir, registry=self.registry)
         if self.telemetry is not None:
             self.telemetry.install()   # before warmup: count its compiles
         try:
@@ -225,20 +315,27 @@ class ReconstructionService:
                     keys, self.config.batch_sizes)
                 log.info("warmup: %d programs in %.1fs",
                          len(self._warmup_report), time.monotonic() - t0)
+            if recover_from:
+                self._recover()
         except BaseException:
             if self.telemetry is not None:
                 self.telemetry.uninstall()
             raise
         for w in self.workers:
             w.start()
+        self.governor.start_watchdog(lambda: list(self.workers),
+                                     self._restart_worker)
         self._started = True
+        self._ready = True
         return self
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown: refuse new work, finish everything admitted,
         stop workers. Returns True when every worker exited in time."""
         self._draining = True
+        self._ready = False
         self.queue.close()
+        self.governor.stop_watchdog()
         for w in self.workers:
             w.request_stop()
         deadline = time.monotonic() + timeout
@@ -251,11 +348,40 @@ class ReconstructionService:
                         timeout)
         if self.telemetry is not None:
             self.telemetry.uninstall()
+        if self.store is not None:
+            self.store.note("drain", clean=ok)
+            self.store.close()
         return ok
+
+    def abort(self) -> None:
+        """Crash-style stop for the durability tests and the soak bench:
+        workers exit at their next loop iteration WITHOUT draining, the
+        queue keeps its jobs, nothing journals a terminal transition —
+        the in-process stand-in for ``kill -9``. The journal retains
+        every acked op; a new service over the same ``store_dir`` with
+        ``start(recover_from=True)`` takes over."""
+        self._draining = True
+        self._ready = False
+        self.governor.stop_watchdog()
+        for w in self.workers:
+            w.abort()
+        for w in self.workers:
+            w.join(timeout=5.0)
+        if self.telemetry is not None:
+            self.telemetry.uninstall()
+        if self.store is not None:
+            self.store.close()
 
     @property
     def draining(self) -> bool:
         return self._draining
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (the ``/readyz`` contract): warmup + recovery done,
+        at least one worker lane alive, not draining."""
+        return (self._ready and not self._draining
+                and any(w.alive for w in self.workers))
 
     def _bucket_key(self, h: int, w: int) -> BucketKey:
         cfg = self.config
@@ -264,13 +390,142 @@ class ReconstructionService:
                          row_bits=cfg.proj.row_bits,
                          decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg)
 
+    # -- recovery (serve/store.py) -----------------------------------------
+
+    def _recover(self) -> None:
+        """Replay the journal: re-queue every non-terminal job under its
+        ORIGINAL id (clients keep polling the ids they hold) and rebuild
+        every live session by replaying its accepted stops through the
+        compiled B=1 lane — deterministic, so a recovered session
+        finalizes bitwise-identically to an uninterrupted one."""
+        state = self.store.recover()
+        if state.empty:
+            return
+        t0 = time.monotonic()
+        n_jobs = n_sessions = n_stops = 0
+        for rj in state.jobs:
+            try:
+                stack = self.store.load_stack(rj.stack_path)
+            except (OSError, ValueError) as e:
+                events.record("job_recover_failed", severity="error",
+                              job_id=rj.job_id, message=str(e))
+                continue
+            deadline = None
+            if rj.deadline_s is not None:
+                deadline = rj.deadline_s - (time.time()
+                                            - rj.submitted_wall)
+            job = Job(stack=stack, col_bits=self.config.proj.col_bits,
+                      row_bits=self.config.proj.row_bits,
+                      decode_cfg=self.config.decode_cfg,
+                      tri_cfg=self.config.tri_cfg,
+                      result_format=rj.result_format,
+                      priority=rj.priority,
+                      deadline_s=deadline, job_id=rj.job_id)
+            job.content_key = rj.content_key
+            job.journal_kind = "job"
+            job.recovered = True
+            job.on_terminal = self._on_terminal
+            self._jobs_total("submitted").inc()
+            with events.context(job_id=job.job_id):
+                if deadline is not None and deadline <= 0:
+                    self._register(job)
+                    from .jobs import DeadlineExceededError
+
+                    job.fail(DeadlineExceededError(
+                        f"deadline {rj.deadline_s:.2f}s lapsed across "
+                        "the crash/restart window"))
+                    continue
+                try:
+                    self.queue.submit(job)
+                except JobRejected as e:  # shrunk queue_depth on restart
+                    self._register(job)
+                    job.fail(e)
+                    continue
+                self._register(job)
+            events.record("job_recovered", job_id=job.job_id,
+                          result_format=rj.result_format)
+            n_jobs += 1
+        for rs in state.sessions:
+            try:
+                entry = self.sessions.restore(rs.session_id, rs.options,
+                                              rs.scan_id)
+            except JobRejected as e:  # shrunk max_sessions on restart
+                events.record("session_recover_failed", severity="error",
+                              session_id=rs.session_id, message=str(e))
+                continue
+            replayed = 0
+            for path in rs.stop_paths:
+                try:
+                    stack = self.store.load_stack(path)
+                    self._replay_stop(entry, stack)
+                    replayed += 1
+                except Exception as e:
+                    # A stop that cannot replay degrades the session (it
+                    # loses bitwise parity) but must not kill recovery
+                    # of everything else.
+                    events.record(
+                        "session_recover_degraded", severity="error",
+                        session_id=rs.session_id, message=str(e),
+                        exc_type=type(e).__name__)
+            with entry.lock:
+                entry.stops_submitted = replayed
+            events.record("session_recovered", session_id=rs.session_id,
+                          scan_id=rs.scan_id, stops_replayed=replayed)
+            n_sessions += 1
+            n_stops += replayed
+        log.info("recovered %d job(s), %d session(s) (%d stops "
+                 "replayed) in %.2fs", n_jobs, n_sessions, n_stops,
+                 time.monotonic() - t0)
+        events.record("service_recovered", jobs=n_jobs,
+                      sessions=n_sessions, stops=n_stops,
+                      seconds=round(time.monotonic() - t0, 3))
+
+    def _replay_stop(self, entry, stack: np.ndarray) -> None:
+        """Run one journaled stop through the SAME program the worker
+        used (the bucket's B=1 executable) and hand the per-lane arrays
+        to the session's ingest — the exact decode path of the original
+        submission, so replay is bit-reproducible."""
+        import jax.numpy as jnp
+
+        stack = self._validate_stack(stack)
+        probe = Job(stack=stack, col_bits=self.config.proj.col_bits,
+                    row_bits=self.config.proj.row_bits,
+                    decode_cfg=self.config.decode_cfg,
+                    tri_cfg=self.config.tri_cfg)
+        key = self.batcher.key_for(probe)
+        compiled = self.cache.get(ProgramKey(bucket=key, batch=1))
+        calib = self.cache.calib_provider(key.height, key.width)
+        batch = np.zeros((1, key.frames, key.height, key.width), np.uint8)
+        f, h, w = stack.shape
+        batch[0, :f, :h, :w] = stack
+        out = compiled(jnp.asarray(batch), calib)
+        points = np.asarray(out.points)[0]
+        colors = np.asarray(out.colors)[0]
+        valid = np.asarray(out.valid)[0]
+        vgrid = valid.reshape(key.height, key.width)[:h, :w]
+        entry.ingest(points, colors, valid, coverage=float(vgrid.mean()))
+
     # -- submission --------------------------------------------------------
+
+    def _content_sig(self, result_format: str) -> str:
+        """Config half of the content-hash key: everything besides the
+        pixels that shapes the artifact."""
+        cfg = self.config
+        return (f"{cfg.proj.col_bits}/{cfg.proj.row_bits}/"
+                f"{cfg.decode_cfg}/{cfg.tri_cfg}/"
+                f"mesh{cfg.mesh_depth}/{result_format}")
 
     def submit_array(self, stack: np.ndarray, result_format: str = "ply",
                      priority="normal",
                      deadline_s: float | None = None) -> Job:
         """Validate + admit one capture stack; returns the live Job.
-        Raises a :class:`~.jobs.JobRejected` subclass on refusal."""
+        Raises a :class:`~.jobs.JobRejected` subclass on refusal.
+
+        A content-cache hit (same bytes, same config, finished before —
+        even pre-restart or post-eviction) returns a completed job
+        WITHOUT touching the queue; the lookup runs before the overload
+        governor because a cached answer costs nothing and relieves
+        load."""
         cfg = self.config
         try:
             stack = self._validate_stack(stack)
@@ -285,11 +540,28 @@ class ReconstructionService:
                         f"{sorted(_PRIORITY_NAMES)} or an int, "
                         f"got {priority!r}")
                 priority = _PRIORITY_NAMES[priority]
+            ckey = None
+            if self.content_cache is not None and not self._draining:
+                # A draining service refuses even free answers: drain
+                # means "go to another replica", and a 200 here would
+                # keep clients pinned to a dying process.
+                ckey = content_key(stack, self._content_sig(result_format))
+                cached = self.content_cache.get(ckey)
+                if cached is not None:
+                    return self._complete_from_cache(
+                        ckey, result_format, int(priority), cached)
+            self.governor.admit(int(priority))
             job = Job(stack=stack, col_bits=cfg.proj.col_bits,
                       row_bits=cfg.proj.row_bits,
                       decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
                       result_format=result_format,
                       priority=int(priority), deadline_s=deadline_s)
+            job.content_key = ckey
+            # journal_kind BEFORE admission: a worker may reach the
+            # terminal transition before _journal_job runs, and that
+            # job_done must not be lost (the store's mirror tolerates
+            # done-before-admitted ordering).
+            job.journal_kind = "job" if self.store is not None else None
             # Observer BEFORE admission (a worker may finish the job
             # before _register runs); registry entry AFTER admission (a
             # rejected job must leave no trace — a pre-registered one
@@ -298,6 +570,7 @@ class ReconstructionService:
             # for).
             job.on_terminal = self._on_terminal
             self.queue.submit(job)
+            self._journal_job(job, stack)
             self._register(job)
         except JobRejected:
             self._jobs_total("rejected").inc()
@@ -305,6 +578,52 @@ class ReconstructionService:
         self._jobs_total("submitted").inc()
         self._queue_gauge.set(self.queue.depth())
         return job
+
+    def _complete_from_cache(self, ckey: str, result_format: str,
+                             priority: int, cached) -> Job:
+        """Land a content-cache hit as an already-terminal job in the
+        registry (same polling surface as a computed result)."""
+        payload, meta, fmt = cached
+        job = Job(stack=np.empty((0, 0, 0), np.uint8),
+                  col_bits=self.config.proj.col_bits,
+                  row_bits=self.config.proj.row_bits,
+                  result_format=fmt or result_format, priority=priority)
+        job.content_key = ckey
+        job.on_terminal = self._on_terminal
+        self._jobs_total("submitted").inc()  # counter conservation
+        job.mark_running()
+        job.complete(payload, **{**meta, "content_cache_hit": True})
+        self._register(job)
+        events.record("content_cache_hit", job_id=job.job_id,
+                      key=ckey[:12])
+        return job
+
+    def _journal_job(self, job: Job, stack: np.ndarray) -> None:
+        """WAL the admission (stack blob first, then the op — the op
+        must never reference a blob that does not exist). Runs after
+        queue.submit: a rejected job journals nothing; the sync append
+        is the durability promise the HTTP 200 rides on.
+
+        A failing volume (disk full, I/O error) degrades DURABILITY,
+        never availability: the job still runs and serves — it just
+        won't survive a crash — and its journal_kind is cleared so the
+        terminal op doesn't dangle against an admission that never
+        landed."""
+        if self.store is None:
+            return
+        try:
+            rel = self.store.put_stack(job.job_id, stack)
+            self.store.append({
+                "op": "job", "job_id": job.job_id, "stack": rel,
+                "result_format": job.result_format,
+                "priority": job.priority, "deadline_s": job.deadline_s,
+                "content_key": job.content_key})
+        except OSError as e:
+            job.journal_kind = None
+            log.error("job %s admission not journaled (%s) — it will "
+                      "not survive a crash", job.job_id, e)
+            events.record("journal_write_failed", severity="error",
+                          job_id=job.job_id, message=str(e))
 
     def _validate_stack(self, stack: np.ndarray) -> np.ndarray:
         cfg = self.config
@@ -360,13 +679,39 @@ class ReconstructionService:
         cfg = self.config
         try:
             stack = self._validate_stack(stack)
+            self.governor.admit(1)
             job = Job(stack=stack, col_bits=cfg.proj.col_bits,
                       row_bits=cfg.proj.row_bits,
                       decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
                       result_format="json")
             job.decode_sink = entry.ingest
+            job.journal_kind = "stop"
+            job.session_id = session_id
             job.on_terminal = self._on_terminal
             self.queue.submit(job)
+            if self.store is not None:
+                # The accepted stop IS the session's recoverable state:
+                # replaying these blobs in order through the B=1 lane
+                # rebuilds the session bit-for-bit. (A stop whose job
+                # later FAILS service-side journals a stop_failed op —
+                # replay must skip it exactly as the live session never
+                # fused it.) A failing volume degrades durability, not
+                # the stop itself.
+                try:
+                    rel = self.store.put_stack(
+                        f"{session_id}-{job.job_id}", stack)
+                    self.store.append({"op": "stop",
+                                       "session_id": session_id,
+                                       "job_id": job.job_id,
+                                       "stack": rel})
+                except OSError as e:
+                    job.journal_kind = None
+                    log.error("session %s stop not journaled (%s) — it "
+                              "will not survive a crash", session_id, e)
+                    events.record("journal_write_failed",
+                                  severity="error",
+                                  session_id=session_id,
+                                  job_id=job.job_id, message=str(e))
             self._register(job)
         except JobRejected:
             self._jobs_total("rejected").inc()
@@ -436,14 +781,24 @@ class ReconstructionService:
             job.complete(payload, **meta)
             self._register(job)
             entry.result_job_id = job.job_id
+        # Journal OUTSIDE the session lock (append can block on the
+        # group commit): a finalized session's stops are no longer
+        # needed for recovery — the artifact lives in the registry, and
+        # a post-crash client re-scans (documented in SERVING.md).
+        if self.store is not None:
+            self.store.append({"op": "session_end",
+                               "session_id": session_id,
+                               "reason": "finalized"})
         return job
 
-    def check_admission(self) -> None:
+    def check_admission(self, priority: int = 1) -> None:
         """Headers-time backpressure probe for the HTTP layer: raises the
-        rejection `submit_array` would, AND counts it — a refusal must hit
-        the rejected counter whether it happened before or after the body
+        rejection `submit_array` would (governor shedding/breaker OR
+        queue backpressure), AND counts it — a refusal must hit the
+        rejected counter whether it happened before or after the body
         was read."""
         try:
+            self.governor.admit(priority)
             self.queue.check_admission()
         except JobRejected:
             self._jobs_total("rejected").inc()
@@ -460,6 +815,31 @@ class ReconstructionService:
             self._queue_wait_s.observe(wait_end - job.submitted_t)
         if job.started_t is not None and job.finished_t is not None:
             self._run_s.observe(job.finished_t - job.started_t)
+        # Durability bookkeeping: only successful NON-hit artifacts enter
+        # the content cache (failures keep their honest taxonomy answer;
+        # a hit is already cached), and only one-shot jobs journal their
+        # terminal (stops are tracked per session, synthesized result
+        # jobs not at all).
+        if (self.content_cache is not None and job.status == DONE
+                and job.content_key is not None
+                and job.result_bytes is not None
+                and not job.result_meta.get("content_cache_hit")):
+            self.content_cache.put(job.content_key, job.result_bytes,
+                                   dict(job.result_meta),
+                                   job.result_format)
+        if self.store is not None and job.journal_kind == "job":
+            self.store.append({"op": "job_done", "job_id": job.job_id,
+                               "status": job.status}, sync=False)
+        elif self.store is not None and job.journal_kind == "stop" \
+                and job.status == FAILED:
+            # A stop whose job failed SERVICE-side was never fused by
+            # the live session; replay must skip it or a recovered
+            # session would fuse one stop more than the uninterrupted
+            # run (breaking bitwise recovery parity). Successful stops
+            # stay journaled until their session ends.
+            self.store.append({"op": "stop_failed",
+                               "session_id": job.session_id,
+                               "job_id": job.job_id}, sync=False)
         events.record("job_terminal",
                       severity="info" if job.status == DONE else "warning",
                       job_id=job.job_id, status=job.status,
@@ -494,6 +874,19 @@ class ReconstructionService:
         with self._jobs_lock:
             return self._jobs.get(job_id)
 
+    def result_payload(self, job: Job) -> bytes | None:
+        """The job's artifact bytes — from the registry, or (when the
+        byte-bounded registry evicted the payload) re-fetched from the
+        content-hash cache. Only when BOTH are gone does ``/result``
+        answer its 410."""
+        data = job.result_bytes
+        if data is None and job.content_key is not None \
+                and self.content_cache is not None:
+            cached = self.content_cache.get(job.content_key)
+            if cached is not None:
+                return cached[0]
+        return data
+
     def status(self, job_id: str) -> dict | None:
         job = self.get_job(job_id)
         if job is None:
@@ -504,15 +897,37 @@ class ReconstructionService:
         return out
 
     def stats(self) -> dict:
-        return {
+        out = {
             "queue_depth": self.queue.depth(),
             "pending_batches": self.batcher.pending_depth(),
             "draining": self._draining,
+            "ready": self.ready,
             "workers_alive": sum(w.alive for w in self.workers),
             "cache": self.cache.stats(),
             "warmup": self._warmup_report,
             "sessions": self.sessions.stats(),
+            "governor": self.governor.stats(),
         }
+        if self.content_cache is not None:
+            out["content_cache"] = self.content_cache.stats()
+        if self.store is not None:
+            out["store"] = self.store.stats()
+        return out
+
+    def readiness(self) -> dict:
+        """The ``/readyz`` payload: ready iff warmup + recovery are done,
+        a worker lane is alive, and the service is not draining —
+        routers stop sending here on 503 while ``/healthz`` (liveness)
+        stays 200 so the orchestrator does NOT restart the pod during a
+        drain or warmup."""
+        reasons = []
+        if not self._started:
+            reasons.append("starting (warmup/recovery in progress)")
+        if self._draining:
+            reasons.append("draining")
+        if self._started and not any(w.alive for w in self.workers):
+            reasons.append("no worker lanes alive")
+        return {"ready": self.ready, "reasons": reasons}
 
     def metrics_text(self) -> str:
         self._queue_gauge.set(self.queue.depth())
@@ -542,10 +957,11 @@ class ReconstructionService:
                         severity=sev).inc(total - seen)
                     self._events_seen[sev] = total
 
-    def events_jsonl(self, n: int = 256) -> str:
+    def events_jsonl(self, n: int = 256, kind: str | None = None) -> str:
         """Tail of the process flight journal (GET /events): the ordered,
-        correlated record of what recently happened to which job."""
-        return events.to_jsonl(n)
+        correlated record of what recently happened to which job.
+        ``kind`` filters to one event kind (e.g. ``session_evicted``)."""
+        return events.to_jsonl(n, kind=kind)
 
 
 # ---------------------------------------------------------------------------
@@ -601,10 +1017,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
 
     def _read_stack_body(self):
         """Read + decode an ``.npy`` POST body behind the headers-time
-        gates (length bound, queue backpressure) — the early-error paths
-        respond WITHOUT reading the (possibly ~95 MB) body; under
-        HTTP/1.1 keep-alive the unread bytes would desync the next
-        request on the connection, so those paths close it."""
+        gates (length bound, queue backpressure + governor shedding) —
+        the early-error paths respond WITHOUT reading the (possibly
+        ~95 MB) body; under HTTP/1.1 keep-alive the unread bytes would
+        desync the next request on the connection, so those paths close
+        it."""
         length = int(self.headers.get("Content-Length", 0))
         if length <= 0 or length > MAX_SUBMIT_BYTES:
             self.close_connection = True
@@ -620,9 +1037,13 @@ class _ServeHandler(BaseHTTPRequestHandler):
         # draining, reject before buffering the (~95 MB at 1080p)
         # body — N overloaded connections must cost N sockets, not
         # N stacks of transient RSS. submit_array/submit_session_stop
-        # below remain the authoritative (race-free) gates.
+        # below remain the authoritative (race-free) gates. Advisory by
+        # design: a duplicate submit the content cache could answer is
+        # sometimes refused here — the cache cannot be consulted before
+        # the body exists.
         try:
-            self.service.check_admission()
+            self.service.check_admission(_PRIORITY_NAMES.get(
+                self.headers.get("X-Priority", "normal"), 1))
         except JobRejected:
             self.close_connection = True
             raise
@@ -724,9 +1145,18 @@ class _ServeHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         url = urlparse(self.path)
         if url.path == "/healthz":
-            stats = self.service.stats()
-            ok = stats["workers_alive"] > 0 and not stats["draining"]
-            self._json({"ok": ok, **stats}, 200 if ok else 503)
+            # LIVENESS: the process is up and answering — always 200.
+            # Routing decisions belong to /readyz; if this endpoint went
+            # 503 during a graceful drain, an orchestrator probing it
+            # for liveness would kill the pod mid-drain.
+            self._json({"ok": True, **self.service.stats()})
+        elif url.path == "/readyz":
+            # READINESS: 503 until warmup + recovery complete, while no
+            # worker lane is alive, and during drain — the router's
+            # send-traffic-here signal (docs/SERVING.md deployment
+            # recipe).
+            ready = self.service.readiness()
+            self._json(ready, 200 if ready["ready"] else 503)
         elif url.path == "/metrics":
             data = self.service.metrics_text().encode()
             self.send_response(200)
@@ -736,11 +1166,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
         elif url.path == "/events":
+            q = parse_qs(url.query)
             try:
-                n = int((parse_qs(url.query).get("n") or ["256"])[0])
+                n = int((q.get("n") or ["256"])[0])
             except ValueError:
                 n = 256
-            data = self.service.events_jsonl(max(1, n)).encode()
+            kind = (q.get("kind") or [None])[0]
+            data = self.service.events_jsonl(max(1, n),
+                                             kind=kind).encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "application/x-ndjson; charset=utf-8")
@@ -810,8 +1243,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if job is None:
             self._json({"error": f"unknown job {job_id!r}"}, 404)
         elif job.status == DONE:
-            data = job.result_bytes
-            if data is None:  # payload fell out of the byte budget
+            # Registry payload, or the content-hash cache when the byte
+            # budget evicted it — 410 only when both are gone.
+            data = self.service.result_payload(job)
+            if data is None:
                 self._json({"job_id": job_id, "status": job.status,
                             "error": "result evicted from the bounded "
                                      "result cache; resubmit the scan",

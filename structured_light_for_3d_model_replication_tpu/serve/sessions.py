@@ -76,10 +76,14 @@ class ServeSession:
     """One streaming session: lock, lifecycle stamps, lazy preview bytes."""
 
     def __init__(self, session_id: str, session: IncrementalSession,
-                 bucket_pixels: int):
+                 bucket_pixels: int, preview_shed=None):
         self.session_id = session_id
         self.session = session
         self.bucket_pixels = bucket_pixels
+        # Overload hook (serve/governor.py): polled per ingested stop;
+        # True suppresses the progressive preview for that stop (the
+        # cheapest sheddable work — the last preview keeps serving).
+        self.preview_shed = preview_shed
         self.lock = threading.Lock()
         self.created_t = time.monotonic()
         self.last_t = self.created_t
@@ -93,7 +97,9 @@ class ServeSession:
     def ingest(self, points, colors, valid, coverage=None) -> dict:
         """The job's ``decode_sink``: fuse one decoded stop. Runs on the
         worker thread; the lock serializes against preview/finalize."""
+        shed = bool(self.preview_shed()) if self.preview_shed else False
         with self.lock:
+            self.session.suppress_previews = shed
             res = self.session.add_decoded(points, colors, valid,
                                            coverage=coverage)
             self.last_t = time.monotonic()
@@ -161,13 +167,19 @@ class SessionManager:
 
     def __init__(self, stream_params: StreamParams, proj,
                  decode_cfg, tri_cfg, max_sessions: int = 8,
-                 session_ttl_s: float = 3600.0):
+                 session_ttl_s: float = 3600.0, store=None,
+                 preview_shed=None):
         self.stream_params = stream_params
         self.proj = proj
         self.decode_cfg = decode_cfg
         self.tri_cfg = tri_cfg
         self.max_sessions = max(1, int(max_sessions))
         self.session_ttl_s = float(session_ttl_s)
+        # Durability journal (serve/store.py): session creations and
+        # endings are appended so `--recover` rebuilds exactly the live
+        # set. None = durability off.
+        self.store = store
+        self.preview_shed = preview_shed
         self._lock = threading.Lock()
         self._sessions: OrderedDict[str, ServeSession] = OrderedDict()
 
@@ -202,16 +214,22 @@ class SessionManager:
             overrides["covis"] = bool(overrides["covis"])
         return dataclasses.replace(self.stream_params, **overrides)
 
-    def create(self, options: dict | None = None) -> ServeSession:
-        params = self._params_for(dict(options or {}))
-        sid = uuid.uuid4().hex[:12]
+    def create(self, options: dict | None = None,
+               session_id: str | None = None,
+               scan_id: str | None = None,
+               journal: bool = True) -> ServeSession:
+        options = dict(options or {})
+        params = self._params_for(options)
+        sid = session_id or uuid.uuid4().hex[:12]
         session = IncrementalSession(
             calib=None,  # serve stops arrive pre-decoded via the batcher
             col_bits=self.proj.col_bits, row_bits=self.proj.row_bits,
             params=params, decode_cfg=self.decode_cfg,
-            tri_cfg=self.tri_cfg, scan_id=f"serve-{sid}")
-        entry = ServeSession(sid, session, bucket_pixels=0)
+            tri_cfg=self.tri_cfg, scan_id=scan_id or f"serve-{sid}")
+        entry = ServeSession(sid, session, bucket_pixels=0,
+                             preview_shed=self.preview_shed)
         expired: list[str] = []
+        evicted: list[str] = []
         with self._lock:
             # Idle-TTL expiry first — an abandoned (crashed-client) live
             # session must free its slot and model buffers, not pin them
@@ -233,13 +251,42 @@ class SessionManager:
             excess = len(self._sessions) - self.max_sessions
             for k in done[:max(0, excess)]:
                 del self._sessions[k]
+                evicted.append(k)
+        # Both eviction paths journal a flight event CARRYING THE SESSION
+        # ID (and the durability journal's session_end), so a vanished
+        # session is attributable in a `cli diagnose` bundle instead of
+        # silently 404ing.
         for k in expired:
             events.record("session_expired", session_id=k,
-                          severity="warning",
+                          severity="warning", reason="idle_ttl",
                           ttl_s=self.session_ttl_s)
+            self._journal_end(k, "idle_ttl")
+        for k in evicted:
+            events.record("session_evicted", session_id=k,
+                          severity="warning", reason="finalized_cap",
+                          max_sessions=self.max_sessions)
+            self._journal_end(k, "finalized_cap")
         events.record("session_created", scan_id=session.scan_id,
                       session_id=sid)
+        if journal and self.store is not None:
+            self.store.append({"op": "session", "session_id": sid,
+                               "scan_id": session.scan_id,
+                               "options": options})
         return entry
+
+    def restore(self, session_id: str, options: dict,
+                scan_id: str) -> ServeSession:
+        """Recreate a journaled session during recovery: same id, same
+        scan id, same options (⇒ same params/key schedule — the bitwise
+        replay contract), WITHOUT re-journaling its creation."""
+        return self.create(options, session_id=session_id,
+                           scan_id=scan_id, journal=False)
+
+    def _journal_end(self, session_id: str, reason: str) -> None:
+        if self.store is not None:
+            self.store.append({"op": "session_end",
+                               "session_id": session_id,
+                               "reason": reason}, sync=False)
 
     def get(self, session_id: str) -> ServeSession:
         with self._lock:
@@ -257,6 +304,7 @@ class SessionManager:
             raise UnknownSessionError(f"unknown session {session_id!r}")
         events.record("session_deleted", session_id=session_id,
                       stops_fused=entry.session.stops_fused)
+        self._journal_end(session_id, "deleted")
 
     def stats(self) -> dict:
         with self._lock:

@@ -54,14 +54,25 @@ class DeviceWorker:
                  mesh_depth: int = 7,
                  registry: "trace.MetricsRegistry | None" = None,
                  tracer: "trace.Tracer | None" = None,
-                 name: str = "serve-worker"):
+                 name: str = "serve-worker",
+                 governor=None):
         self.batcher = batcher
         self.cache = cache
         self.gates = gates
         self.mesh_depth = mesh_depth
         self.registry = registry if registry is not None else trace.REGISTRY
         self.tracer = tracer if tracer is not None else trace.GLOBAL
+        # Overload governor (serve/governor.py): fed worker outcomes for
+        # the circuit breaker; the watchdog reads the heartbeat below.
+        self.governor = governor
+        self.name = name
+        # Heartbeat: stamped every loop iteration. While the thread is
+        # stuck inside a launch it goes stale — the watchdog's wedge
+        # signal.
+        self.last_beat = time.monotonic()
+        self.abandoned = False  # set by the watchdog on replacement
         self._stop = threading.Event()
+        self._abort = threading.Event()
         self._thread = threading.Thread(target=self._run, name=name,
                                         daemon=True)
         self._batches = self.registry.counter(
@@ -82,6 +93,13 @@ class DeviceWorker:
     def request_stop(self) -> None:
         self._stop.set()
 
+    def abort(self) -> None:
+        """Crash-style stop: exit at the next loop iteration WITHOUT
+        draining the queue or pending buckets (simulated kill -9 for the
+        durability tests/bench — queued jobs stay non-terminal, exactly
+        what the journal must recover)."""
+        self._abort.set()
+
     def join(self, timeout: float | None = None) -> None:
         self._thread.join(timeout)
 
@@ -93,6 +111,9 @@ class DeviceWorker:
 
     def _run(self) -> None:
         while True:
+            if self._abort.is_set():
+                return
+            self.last_beat = time.monotonic()
             draining = self._stop.is_set()
             batch = self.batcher.next_batch(timeout=0.05, force=draining)
             if batch is None:
@@ -101,11 +122,20 @@ class DeviceWorker:
                     return
                 continue
             try:
-                self._process(batch)
+                contained = self._process(batch)
+                # The breaker hears "ok" only for a batch with NO
+                # service-side failures: a postprocess bug contained
+                # per-job already fed note_worker_failure, and pairing
+                # every such batch with an ok would pin the window's
+                # failure rate at 50% no matter how broken the lane is.
+                if self.governor is not None and not contained:
+                    self.governor.note_worker_ok()
             except Exception as e:
                 # Batch-scoped failure (compile, launch, transfer): every
                 # job in it fails with the fault payload; the worker — and
-                # with it the process — keeps serving.
+                # with it the process — keeps serving. The governor's
+                # breaker counts it: enough of these in a window means
+                # the device lane itself is sick.
                 log.warning("batch %s failed: %s", batch.key.label(), e)
                 events.record(
                     "batch_failed", severity="error", message=str(e),
@@ -114,16 +144,22 @@ class DeviceWorker:
                 for job in batch.jobs:
                     with events.context(job_id=job.job_id):
                         job.fail(e)
+                if self.governor is not None:
+                    self.governor.note_worker_failure()
 
     # ------------------------------------------------------------------
 
-    def _process(self, batch: Batch) -> None:
+    def _process(self, batch: Batch) -> bool:
+        """Run one batch; returns True when any job failed through the
+        SERVICE-SIDE containment path (feeds the breaker's view of this
+        batch — quality-gate failures are the client's data, not ours)."""
         import jax.numpy as jnp
 
         t0 = time.monotonic()
         for job in batch.jobs:
             job.mark_running()
         key = ProgramKey(bucket=batch.key, batch=batch.size)
+        contained = False
         with self.tracer.span("serve.batch", program=key.label(),
                               occupancy=batch.occupancy):
             compiled = self.cache.get(key)
@@ -141,12 +177,15 @@ class DeviceWorker:
             self._padded.inc(batch.size - batch.occupancy)
             with self.tracer.span("postprocess"):
                 for i, job in enumerate(batch.jobs):
-                    self._finish_job(job, batch.key, points[i], colors[i],
-                                     valid[i])
+                    contained |= self._finish_job(
+                        job, batch.key, points[i], colors[i], valid[i])
         per_job = (time.monotonic() - t0) / max(1, batch.occupancy)
         self.batcher.queue.observe_service_time(per_job)
+        return contained
 
-    def _finish_job(self, job, key, points, colors, valid) -> None:
+    def _finish_job(self, job, key, points, colors, valid) -> bool:
+        """Postprocess one job; True iff it failed via the service-side
+        (unexpected-exception) containment path."""
         # Correlation context covers the whole postprocess: a gate raise
         # (StopQualityError construction) journals with this job's id.
         with events.context(job_id=job.job_id):
@@ -159,11 +198,17 @@ class DeviceWorker:
                 job.fail(e)
             except Exception as e:
                 # Containment boundary: an unexpected host-side error (a
-                # meshing corner case, a writer bug) costs this job only.
+                # meshing corner case, a writer bug) costs this job only
+                # — but unlike a quality-gate fault it IS a service-side
+                # exception, so the breaker hears about it.
                 log.warning("job %s failed unexpectedly: %s", job.job_id, e)
                 events.record("job_contained", severity="error",
                               message=str(e), exc_type=type(e).__name__)
                 job.fail(e)
+                if self.governor is not None:
+                    self.governor.note_worker_failure()
+                return True
+        return False
 
     def _postprocess(self, job, key, points, colors,
                      valid) -> tuple[bytes, dict]:

@@ -300,6 +300,11 @@ class IncrementalSession:
                                      quantile_trim=params.preview_trim)
         self.preview = None
         self.preview_meta: dict = {}
+        # Overload hook (serve/governor.py): while True, progressive
+        # previews are skipped — the cheapest work to shed under load
+        # (fusion and the final artifact are untouched; the last emitted
+        # preview keeps serving). Flipped per stop by the serve layer.
+        self.suppress_previews = False
         self._finalized = False
         self._t0 = time.monotonic()
 
@@ -612,6 +617,11 @@ class IncrementalSession:
             return False
         n = len(self._labels)
         if n != 1 and n % p.preview_every != 0:
+            return False
+        if self.suppress_previews:
+            events.record("preview_shed", severity="info",
+                          message="progressive preview skipped under "
+                                  "overload shedding", stops_fused=n)
             return False
         t0 = time.monotonic()
         with trace.span("stream.preview", stop=label):

@@ -243,9 +243,17 @@ class FlightRecorder:
 
     # -- inspection --------------------------------------------------------
 
-    def tail(self, n: int | None = None) -> list[Event]:
+    def tail(self, n: int | None = None,
+             kind: str | None = None) -> list[Event]:
+        """Last ``n`` events, optionally restricted to one ``kind``
+        (exact match) — the filter behind ``GET /events?kind=`` and the
+        soak bench's eviction/recovery assertions. The kind filter
+        applies BEFORE the tail bound, so `tail(8, kind="job_terminal")`
+        is the last 8 terminals, not terminals among the last 8 events."""
         with self._lock:
             evs = list(self._ring)
+        if kind is not None:
+            evs = [e for e in evs if e.kind == kind]
         return evs if n is None else evs[-n:]
 
     def __len__(self) -> int:
@@ -257,8 +265,9 @@ class FlightRecorder:
         with self._lock:
             return self._dropped
 
-    def to_jsonl(self, n: int | None = None) -> str:
-        lines = [json.dumps(e.to_dict()) for e in self.tail(n)]
+    def to_jsonl(self, n: int | None = None,
+                 kind: str | None = None) -> str:
+        lines = [json.dumps(e.to_dict()) for e in self.tail(n, kind=kind)]
         return "\n".join(lines) + ("\n" if lines else "")
 
     def dump(self, path: str, n: int | None = None) -> None:

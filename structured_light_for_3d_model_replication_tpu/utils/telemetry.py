@@ -191,6 +191,19 @@ class DeviceTelemetry:
                 ).set(float(peak))
         return out
 
+    def memory_pressure(self) -> float:
+        """Worst-device ``bytes_in_use / bytes_limit`` in [0, 1] — the
+        overload governor's device-memory shedding signal. 0.0 when no
+        device reports memory stats (CPU) or limits are absent."""
+        worst = 0.0
+        for stats in self.sample_memory().values():
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit")
+            if in_use is not None and limit:
+                worst = max(worst, float(in_use) / float(limit))
+        return min(1.0, worst)
+
     # -- snapshots ---------------------------------------------------------
 
     def snapshot(self) -> dict:

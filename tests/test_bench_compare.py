@@ -144,3 +144,59 @@ def test_json_mode_counts_regressions(tmp_path, capsys):
     assert rc == 1
     assert doc["regressions"] == 1
     assert doc["rows"][0]["verdict"] == "REGRESSION"
+
+
+def test_soak_headline_lines_and_throughput_direction(tmp_path, capsys):
+    """Bench config [9] adds `soak_scans_per_s` (throughput —
+    HIGHER is better) and `soak_recovery_s` (latency — lower is better)
+    next to the scan→mesh headline. The trajectory must track both, and
+    --strict must judge each with its own direction: throughput going UP
+    is an improvement, not a regression; recovery time going up is."""
+    tail = "\n".join([
+        _headline("full_360_scan_to_mesh_s", 5.9),
+        _headline("soak_scans_per_s", 8.0),
+        _headline("soak_recovery_s", 2.0),
+        "[9] soak: 1200 jobs in 180s (8.00/s)",          # log noise
+    ])
+    _round(tmp_path, 1, tail)
+    traj = bench_compare.load_history([str(tmp_path / "BENCH_r01.json")])
+    assert traj["soak_scans_per_s"] == [(1, 8.0)]
+    assert traj["soak_recovery_s"] == [(1, 2.0)]
+
+    # Throughput UP + recovery flat: no regression, strict passes.
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text("\n".join([
+        _headline("full_360_scan_to_mesh_s", 5.9),
+        _headline("soak_scans_per_s", 10.0),
+        _headline("soak_recovery_s", 2.0),
+    ]) + "\n", encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["soak_scans_per_s"] == "improved"
+    assert by_metric["soak_recovery_s"] == "flat"
+
+    # Throughput DOWN and recovery UP beyond threshold: both regress.
+    fresh.write_text("\n".join([
+        _headline("full_360_scan_to_mesh_s", 5.9),
+        _headline("soak_scans_per_s", 5.0),
+        _headline("soak_recovery_s", 3.5),
+    ]) + "\n", encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["soak_scans_per_s"] == "REGRESSION"
+    assert by_metric["soak_recovery_s"] == "REGRESSION"
+    assert doc["regressions"] == 2
+
+    # Best-round bookkeeping follows the metric's direction too.
+    _round(tmp_path, 2, _headline("soak_scans_per_s", 6.0))
+    traj = bench_compare.load_history(sorted(
+        str(p) for p in tmp_path.glob("BENCH_r*.json")))
+    rows = bench_compare.compare({"soak_scans_per_s": 6.5}, traj,
+                                 threshold=0.05)
+    (row,) = rows
+    assert row["best"] == 8.0 and row["best_round"] == 1
+    assert row["verdict"] == "improved"

@@ -1,0 +1,719 @@
+"""Durable serving (serve/store.py + content cache + overload governor).
+
+The subsystem's acceptance bars:
+
+* **crash-safe journal** — acked admissions and session stops survive
+  ``kill -9``; ``start(recover_from=...)`` re-queues non-terminal jobs
+  under their ORIGINAL ids and rebuilds live sessions by replaying
+  retained stop stacks through the compiled B=1 lane. A recovered
+  session finalizes **bitwise-identically** to an uninterrupted run.
+* **content-hash result cache** — duplicate submits (same stack bytes +
+  config) return the finished artifact at admission without touching
+  the queue, across registry eviction AND across restarts: a result the
+  byte-bounded registry evicted answers a resubmit with 200 instead of
+  the 410 "re-scan".
+* **overload governor** — circuit breaker on worker-exception rate,
+  graduated shedding (previews first, then low-priority admissions),
+  and a watchdog that journals + replaces a wedged worker lane.
+* **client backoff** — `ServeClient` honors Retry-After with jittered
+  exponential backoff under a bounded budget.
+
+The kill-9 members are marked ``slow`` and run in the SL_SANITIZE CI
+job (ci.yml `sanitize`); everything else is tier-1. Shapes are the
+tiny test_serve rig (24x40 camera, 24-frame protocol).
+"""
+
+import importlib.util
+import os
+import pathlib
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import (
+    ProjectorConfig,
+)
+from structured_light_for_3d_model_replication_tpu.models import (
+    merge as merge_mod,
+)
+from structured_light_for_3d_model_replication_tpu.models import synthetic
+from structured_light_for_3d_model_replication_tpu.serve import (
+    BreakerOpenError,
+    ContentCache,
+    GovernorParams,
+    JournalStore,
+    LoadShedError,
+    OverloadGovernor,
+    ReconstructionService,
+    ServeClient,
+    ServeConfig,
+    ServeHTTPServer,
+    read_live_state,
+)
+from structured_light_for_3d_model_replication_tpu.serve.client import (
+    BackpressureError,
+)
+from structured_light_for_3d_model_replication_tpu.stream import (
+    StreamParams,
+)
+from structured_light_for_3d_model_replication_tpu.utils import events, trace
+
+# The subprocess spawn recipe AND the small-rig session params come
+# from the CI soak-smoke script — one source, so this suite and that
+# gate always exercise the same compiled-program keys and startup
+# protocol (same import-by-path pattern as tests/test_bench_compare.py).
+_SOAK_SPEC = importlib.util.spec_from_file_location(
+    "soak_smoke",
+    pathlib.Path(__file__).resolve().parents[1] / "scripts"
+    / "soak_smoke.py")
+soak_smoke = importlib.util.module_from_spec(_SOAK_SPEC)
+_SOAK_SPEC.loader.exec_module(soak_smoke)
+
+PROJ = ProjectorConfig(width=soak_smoke.PROJ_W, height=soak_smoke.PROJ_H)
+H, W = soak_smoke.CAM_H, soak_smoke.CAM_W
+
+
+def _stream_params() -> StreamParams:
+    import dataclasses
+
+    doc = dict(soak_smoke.STREAM_PARAMS)
+    merge = merge_mod.MergeParams(**doc.pop("merge"))
+    return dataclasses.replace(StreamParams(), merge=merge, **doc)
+
+
+@pytest.fixture(scope="module")
+def serve_stack():
+    cam = synthetic.default_calibration(H, W, PROJ)
+    stack, _ = synthetic.render_scan(synthetic.Scene(), *cam, H, W, PROJ)
+    return stack
+
+
+@pytest.fixture(scope="module")
+def serve_ring():
+    """4 genuinely different turntable views at the serve bucket size."""
+    cam = synthetic.default_calibration(H, W, PROJ)
+    scene = synthetic.Scene(
+        wall_z=None,
+        spheres=(synthetic.Sphere((0.0, 2.0, 500.0), 80.0, 0.9),
+                 synthetic.Sphere((55.0, -30.0, 460.0), 35.0, 0.7),
+                 synthetic.Sphere((-60.0, 35.0, 530.0), 30.0, 0.8)))
+    scans = synthetic.render_turntable_scans(
+        scene, n_stops=4, degrees_per_stop=12.0,
+        cam_K=cam[0], proj_K=cam[1], R=cam[2], T=cam[3],
+        cam_height=H, cam_width=W, proj=PROJ)
+    return [s for s, _ in scans]
+
+
+def _config(store_dir=None, **kw) -> ServeConfig:
+    kw.setdefault("stream", _stream_params())
+    return ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1, 2),
+                       linger_ms=5.0, queue_depth=16, workers=1,
+                       store_dir=store_dir, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Journal store (pure stdlib + numpy — no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_terminal_and_session_end(tmp_path):
+    d = str(tmp_path / "vol")
+    s = JournalStore(d)
+    rel = s.put_stack("j1", np.arange(24, dtype=np.uint8).reshape(2, 3, 4))
+    s.append({"op": "job", "job_id": "j1", "stack": rel,
+              "result_format": "stl", "priority": 2, "deadline_s": None,
+              "content_key": "k1"})
+    s.append({"op": "session", "session_id": "s1", "scan_id": "serve-s1",
+              "options": {"preview_every": 2}})
+    rel2 = s.put_stack("s1-a", np.ones((2, 3, 4), np.uint8))
+    s.append({"op": "stop", "session_id": "s1", "stack": rel2})
+    s.close()
+
+    st = read_live_state(d)
+    assert [j.job_id for j in st.jobs] == ["j1"]
+    assert st.jobs[0].result_format == "stl"
+    assert st.jobs[0].priority == 2
+    assert st.jobs[0].content_key == "k1"
+    assert [x.session_id for x in st.sessions] == ["s1"]
+    assert st.sessions[0].options == {"preview_every": 2}
+    assert st.sessions[0].stop_paths == [rel2]
+
+    # Terminal + session_end empty the live set; reopening compacts the
+    # journal to O(live) and deletes unreferenced stack blobs.
+    s2 = JournalStore(d)
+    assert np.array_equal(s2.load_stack(rel),
+                          np.arange(24, dtype=np.uint8).reshape(2, 3, 4))
+    s2.append({"op": "job_done", "job_id": "j1", "status": "done"})
+    s2.append({"op": "session_end", "session_id": "s1",
+               "reason": "deleted"})
+    s2.close()
+    assert read_live_state(d).empty
+    s3 = JournalStore(d)   # open-time compaction
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not os.listdir(os.path.join(d, "stacks")) \
+                and s3.stats()["compactions"] >= 1:
+            break
+        time.sleep(0.02)
+    assert s3.stats()["live_jobs"] == 0
+    assert os.listdir(os.path.join(d, "stacks")) == []
+    s3.close()
+
+
+def test_journal_tolerates_torn_tail_and_done_before_admit(tmp_path):
+    d = str(tmp_path / "vol")
+    s = JournalStore(d)
+    # Worker outran the submitter's append: terminal journals FIRST.
+    s.append({"op": "job_done", "job_id": "early", "status": "done"})
+    s.append({"op": "job", "job_id": "early", "stack": "stacks/x.npy"})
+    s.close()
+    # Torn final line: crash mid-write of an unacked op.
+    with open(os.path.join(d, "journal.jsonl"), "a") as f:
+        f.write('{"op": "job", "job_id": "torn", "sta')
+    st = read_live_state(d)
+    assert st.jobs == [] and st.corrupt_lines == 1
+    # The mirror agrees (the early-done job must not be resurrected by
+    # compaction either).
+    s2 = JournalStore(d)
+    assert s2.stats()["live_jobs"] == 0
+    assert len(s2.recover().jobs) == 0
+    s2.close()
+
+
+def test_content_cache_persistence_and_eviction(tmp_path):
+    reg = trace.MetricsRegistry()
+    c = ContentCache(max_bytes=300, dir=str(tmp_path / "content"),
+                     registry=reg)
+    assert c.get("k1") is None                 # miss counted
+    c.put("k1", b"a" * 200, {"points": 3}, "ply")
+    payload, meta, fmt = c.get("k1")
+    assert payload == b"a" * 200 and meta["points"] == 3 and fmt == "ply"
+    c.put("k2", b"b" * 200, {}, "stl")         # busts 300-byte budget
+    assert c.get("k1") is None                 # LRU victim
+    assert c.get("k2") is not None
+    st = c.stats()
+    assert st["evictions"] == 1 and st["entries"] == 1
+
+    # A fresh process over the same directory recovers the index.
+    c2 = ContentCache(max_bytes=300, dir=str(tmp_path / "content"),
+                      registry=trace.MetricsRegistry())
+    payload, _, fmt = c2.get("k2")
+    assert payload == b"b" * 200 and fmt == "stl"
+
+    # The byte budget is enforced at LOAD too: reopening with a lowered
+    # max_bytes evicts down to it instead of running over forever.
+    c2.put("k3", b"c" * 90, {}, "ply")          # k2 (200) + k3 (90)
+    c3 = ContentCache(max_bytes=100, dir=str(tmp_path / "content"),
+                      registry=trace.MetricsRegistry())
+    st = c3.stats()
+    assert st["bytes"] <= 100 and st["entries"] == 1
+    assert c3.get("k2") is None                  # oldest evicted on open
+    assert c3.get("k3") is not None
+
+
+def test_content_key_includes_shape_and_dtype():
+    from structured_light_for_3d_model_replication_tpu.serve import (
+        content_key,
+    )
+
+    buf = np.arange(24, dtype=np.uint8)
+    a = buf.reshape(2, 3, 4)
+    b = buf.reshape(2, 4, 3)     # same bytes, different geometry
+    assert content_key(a, "sig") != content_key(b, "sig")
+    assert content_key(a, "sig") == content_key(a.copy(), "sig")
+    assert content_key(a, "sig") != content_key(a, "other-sig")
+
+
+# ---------------------------------------------------------------------------
+# Content-hash cache at admission (service level)
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_submit_served_from_content_cache(serve_stack):
+    svc = ReconstructionService(_config(warmup=False)).start()
+    try:
+        first = svc.submit_array(serve_stack)
+        assert first.wait(60.0) and first.status == "done"
+        misses = svc.cache.stats()["misses"]
+        dup = svc.submit_array(serve_stack)
+        # Completed AT admission: no queue, no program, no device.
+        assert dup.status == "done"
+        assert dup.result_meta["content_cache_hit"] is True
+        assert dup.result_bytes == first.result_bytes
+        assert dup.result_meta["points"] == first.result_meta["points"]
+        assert svc.cache.stats()["misses"] == misses
+        assert svc.content_cache.stats()["hits"] == 1
+        # Different processing config = different artifact = miss.
+        stl = svc.submit_array(serve_stack, result_format="stl")
+        assert stl.wait(60.0) and stl.status == "done"
+        assert not stl.result_meta.get("content_cache_hit")
+    finally:
+        svc.drain(timeout=10.0)
+
+
+def test_result_evicted_from_registry_still_200_on_resubmit(serve_stack):
+    """The satellite bar: a finalized result evicted from the
+    byte-bounded result registry but present in the content-hash cache
+    answers a RESUBMIT with 200 — the old path was a 410 'resubmit the
+    scan' with a full recompute."""
+    cfg = _config(warmup=False, completed_cap=100,
+                  result_cache_bytes=1)   # any result busts the budget
+    svc = ReconstructionService(cfg).start()
+    http = ServeHTTPServer(svc, port=0).start()
+    client = ServeClient(f"http://127.0.0.1:{http.port}", timeout_s=60.0)
+    try:
+        jid = client.submit(serve_stack)
+        st = client.wait(jid, timeout_s=60.0)
+        assert st["status"] == "done"
+        # Force the byte-budget eviction pass (the next _register runs
+        # it) with a second, different job.
+        jid2 = client.submit(serve_stack + np.uint8(1))
+        client.wait(jid2, timeout_s=60.0)
+        assert svc.get_job(jid).result_bytes is None  # registry evicted
+        # The ORIGINAL id keeps serving: /result falls back to the
+        # content cache instead of the old 410 "resubmit the scan".
+        assert client.result(jid).startswith(b"ply")
+        # And a resubmit of the SAME stack completes at admission.
+        jid3 = client.submit(serve_stack)
+        st3 = client.wait(jid3, timeout_s=10.0)
+        assert st3["status"] == "done"
+        assert st3["result"]["content_cache_hit"] is True
+        data = client.result(jid3)
+        assert data.startswith(b"ply") and len(data) > 0
+    finally:
+        http.stop()
+        svc.drain(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# Overload governor
+# ---------------------------------------------------------------------------
+
+
+class _FakeQueue:
+    def __init__(self, depth=0, max_depth=16):
+        self._depth, self.max_depth = depth, max_depth
+
+    def depth(self):
+        return self._depth
+
+    def retry_hint(self):
+        return 0.5
+
+
+def test_breaker_opens_on_failure_rate_and_half_open_recovers():
+    params = GovernorParams(breaker_window=8, breaker_min_samples=4,
+                            breaker_failure_rate=0.5,
+                            breaker_cooldown_s=0.15)
+    gov = OverloadGovernor(params, _FakeQueue(), trace.MetricsRegistry())
+    gov.admit(1)                         # healthy: flows
+    for _ in range(2):
+        gov.note_worker_ok()
+    for _ in range(4):
+        gov.note_worker_failure()        # 4/6 >= 0.5 with n >= 4: trips
+    with pytest.raises(BreakerOpenError) as ei:
+        gov.admit(0)                     # even high priority refused
+    assert ei.value.retryable and ei.value.retry_after_s > 0
+    assert any(e.kind == "breaker_open" for e in events.tail(50))
+    time.sleep(0.2)                      # cooldown lapses: half-open
+    gov.admit(1)                         # probe traffic flows
+    gov.note_worker_ok()                 # probe succeeded: closes
+    assert gov.breaker_open() is None
+    for _ in range(3):
+        gov.note_worker_failure()        # window was cleared on close
+    gov.admit(1)                         # 3 < min_samples: still closed
+
+
+def test_load_shedding_tiers_by_queue_depth():
+    params = GovernorParams(shed_preview_frac=0.5, shed_low_frac=0.8)
+    q = _FakeQueue(depth=0, max_depth=10)
+    gov = OverloadGovernor(params, q, trace.MetricsRegistry())
+    assert not gov.shed_previews()
+    gov.admit(2)                         # idle: low priority flows
+    q._depth = 6                         # 60%: previews shed, jobs flow
+    assert gov.shed_previews()
+    gov.admit(2)
+    q._depth = 9                         # 90%: low priority refused
+    with pytest.raises(LoadShedError) as ei:
+        gov.admit(2)
+    assert ei.value.retryable and ei.value.retry_after_s > 0
+    gov.admit(1)                         # normal still flows
+    gov.admit(0)
+
+
+def test_failed_stop_is_skipped_on_replay(tmp_path, serve_ring):
+    """A stop whose job failed SERVICE-side was never fused by the live
+    session; the journal's stop_failed op must make recovery skip its
+    blob — otherwise a recovered session fuses one stop more than the
+    uninterrupted run and bitwise parity is gone."""
+    store_dir = str(tmp_path / "vol")
+    svc = ReconstructionService(_config(store_dir, warmup=False)).start()
+    try:
+        sid = svc.create_session({})["session_id"]
+        assert svc.submit_session_stop(sid, serve_ring[0]).wait(120.0)
+        # Wedge the postprocess for the SECOND stop only: its job fails
+        # service-side after the stop op was journaled.
+        original = svc.workers[0]._postprocess
+
+        def broken(job, key, points, colors, valid):
+            raise RuntimeError("transient postprocess bug")
+
+        svc.workers[0]._postprocess = broken
+        bad = svc.submit_session_stop(sid, serve_ring[1])
+        assert bad.wait(120.0) and bad.status == "failed"
+        svc.workers[0]._postprocess = original
+        assert svc.submit_session_stop(sid, serve_ring[2]).wait(120.0)
+        assert svc.sessions.get(sid).session.stops_fused == 2
+    finally:
+        svc.abort()
+
+    state = read_live_state(store_dir)
+    assert len(state.sessions) == 1
+    # Only the two FUSED stops' blobs replay; the failed one is skipped.
+    assert len(state.sessions[0].stop_paths) == 2
+    svc2 = ReconstructionService(_config(store_dir)).start(
+        recover_from=True)
+    try:
+        assert svc2.sessions.get(sid).session.stops_fused == 2
+    finally:
+        svc2.abort()
+
+
+def test_breaker_hears_contained_postprocess_failures(serve_stack):
+    """A postprocess bug contained per job (batch 'succeeds') must still
+    open the breaker: pairing every such batch with an 'ok' outcome
+    would pin the window's failure rate at 50% forever."""
+    cfg = _config(warmup=False,
+                  governor=GovernorParams(breaker_window=8,
+                                          breaker_min_samples=4,
+                                          breaker_failure_rate=0.6,
+                                          breaker_cooldown_s=30.0))
+    svc = ReconstructionService(cfg)
+
+    def broken(job, key, points, colors, valid):
+        raise RuntimeError("writer bug")
+
+    svc.workers[0]._postprocess = broken
+    svc.start()
+    try:
+        jobs = [svc.submit_array(serve_stack + np.uint8(i))
+                for i in range(5)]
+        for j in jobs:
+            assert j.wait(60.0) and j.status == "failed"
+        assert svc.governor.breaker_open() is not None
+        with pytest.raises(BreakerOpenError):
+            svc.submit_array(serve_stack + np.uint8(99))
+    finally:
+        svc.abort()
+
+
+def test_watchdog_journals_and_restarts_wedged_worker(serve_stack):
+    cfg = _config(warmup=False,
+                  governor=GovernorParams(wedge_timeout_s=0.5,
+                                          watchdog_interval_s=0.1))
+    svc = ReconstructionService(cfg)
+    original = svc.workers[0]
+
+    def wedge(batch):
+        time.sleep(60.0)
+
+    original._process = wedge
+    svc.start()
+    try:
+        stuck = svc.submit_array(serve_stack)      # wedges the lane
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if svc.workers[0] is not original:
+                break
+            time.sleep(0.05)
+        assert svc.workers[0] is not original, "watchdog never fired"
+        assert original.abandoned
+        assert any(e.kind == "worker_wedged" for e in events.tail(100))
+        assert any(e.kind == "worker_restarted"
+                   for e in events.tail(100))
+        assert svc.registry.counter(
+            "serve_worker_restarts_total").value == 1
+        # The replacement lane serves fresh traffic; the wedged batch's
+        # job never completes until its thread dies with the process.
+        ok = svc.submit_array(serve_stack + np.uint8(3))
+        assert ok.wait(60.0) and ok.status == "done", ok.status_dict()
+        assert stuck.status in ("queued", "running")
+    finally:
+        svc.abort()
+
+
+# ---------------------------------------------------------------------------
+# Client backoff + readiness split
+# ---------------------------------------------------------------------------
+
+
+def test_client_backoff_honors_retry_after_with_jitter_and_budget():
+    client = ServeClient("http://127.0.0.1:1", retries=3,
+                         retry_backoff_s=0.25, retry_budget_s=60.0)
+    sleeps = []
+    client._sleep = sleeps.append
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise BackpressureError("429", retry_after_s=2.0)
+        return "job-1"
+
+    assert client._retrying(flaky) == "job-1"
+    assert calls["n"] == 4 and len(sleeps) == 3
+    for s in sleeps:                     # Retry-After 2.0 s, ±50% jitter
+        assert 1.0 <= s <= 3.0
+
+    # Without a server hint: exponential from retry_backoff_s.
+    sleeps.clear()
+    calls["n"] = 0
+
+    def hintless():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise BackpressureError("503", retry_after_s=None)
+        return "job-2"
+
+    assert client._retrying(hintless) == "job-2"
+    assert 0.125 <= sleeps[0] <= 0.375   # 0.25 * 2^0, jittered
+    assert 0.25 <= sleeps[1] <= 0.75     # 0.25 * 2^1, jittered
+
+    # Bounded attempts: the LAST rejection surfaces intact.
+    always = lambda: (_ for _ in ()).throw(
+        BackpressureError("429", retry_after_s=0.1))
+    with pytest.raises(BackpressureError):
+        client._retrying(always)
+    # Bounded wall clock: a huge hint is not slept on.
+    tight = ServeClient("http://127.0.0.1:1", retries=5,
+                        retry_budget_s=0.001)
+    tight._sleep = sleeps.append
+    with pytest.raises(BackpressureError):
+        tight._retrying(lambda: (_ for _ in ()).throw(
+            BackpressureError("429", retry_after_s=30.0)))
+
+
+def test_healthz_liveness_vs_readyz_readiness(serve_stack):
+    svc = ReconstructionService(_config(warmup=False))
+    http = ServeHTTPServer(svc, port=0).start()
+    client = ServeClient(f"http://127.0.0.1:{http.port}")
+    try:
+        # Not started: alive (200) but NOT ready (503 body).
+        assert client.healthz()["ok"] is True
+        ready = client.readyz()
+        assert ready["ready"] is False and ready["reasons"]
+        svc.start()
+        assert client.readyz()["ready"] is True
+        job = svc.submit_array(serve_stack)
+        assert job.wait(60.0) and job.status == "done"
+        svc.drain(timeout=10.0)
+        # Draining: still alive, not ready — the router stops sending,
+        # the orchestrator does NOT kill the pod mid-drain.
+        assert client.healthz()["ok"] is True
+        assert client.readyz()["ready"] is False
+    finally:
+        http.stop()
+
+
+def test_session_ttl_and_cap_evictions_are_journaled(monkeypatch):
+    from structured_light_for_3d_model_replication_tpu.serve.sessions \
+        import SessionManager
+
+    mgr = SessionManager(_stream_params(), PROJ,
+                         ServeConfig().decode_cfg, ServeConfig().tri_cfg,
+                         max_sessions=1, session_ttl_s=0.05)
+    a = mgr.create({})
+    time.sleep(0.1)                      # a's idle TTL lapses
+    b = mgr.create({})                   # expiry runs at create time
+    expired = [e for e in events.tail(50, kind="session_expired")
+               if e.fields.get("session_id") == a.session_id]
+    assert expired and expired[-1].fields["reason"] == "idle_ttl"
+    with pytest.raises(Exception):
+        mgr.get(a.session_id)
+
+    # Finalized-cap eviction (the formerly-silent path) journals too.
+    b.session._finalized = True
+    c = mgr.create({})
+    assert mgr.get(c.session_id) is c
+    evicted = [e for e in events.tail(50, kind="session_evicted")
+               if e.fields.get("session_id") == b.session_id]
+    assert evicted and evicted[-1].fields["reason"] == "finalized_cap"
+
+
+def test_preview_shedding_skips_preview_not_fusion(serve_ring):
+    from structured_light_for_3d_model_replication_tpu.stream import (
+        IncrementalSession,
+    )
+
+    cam = synthetic.default_calibration(H, W, PROJ)
+    from structured_light_for_3d_model_replication_tpu.ops.triangulate \
+        import make_calibration
+
+    calib = make_calibration(*cam, H, W, proj_width=PROJ.width,
+                             proj_height=PROJ.height)
+    sess = IncrementalSession(calib, PROJ.col_bits, PROJ.row_bits,
+                              params=_stream_params())
+    sess.suppress_previews = True
+    r = sess.add_stop(serve_ring[0])
+    assert r.fused and not r.preview and sess.preview is None
+    assert any(e.kind == "preview_shed" for e in events.tail(20))
+    sess.suppress_previews = False       # load receded: previews resume
+    r2 = sess.add_stop(serve_ring[1])
+    assert r2.preview and sess.preview is not None
+
+
+# ---------------------------------------------------------------------------
+# kill -9 → recover (slow; SL_SANITIZE CI job)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_crash_recover_jobs_and_session_bitwise(tmp_path, serve_stack,
+                                                serve_ring):
+    """In-process crash (service.abort == the workers' view of kill -9):
+    a queued job recovers under its original id and completes with a
+    correct artifact; a live 2-stop session accepts stops 3-4 after
+    recovery and finalizes BITWISE-identically to an uninterrupted run;
+    the drained journal is clean."""
+    store_dir = str(tmp_path / "vol")
+
+    # Uninterrupted reference (no store: pure compute path).
+    ref = ReconstructionService(_config()).start()
+    sid_ref = ref.create_session({})["session_id"]
+    for s in serve_ring:
+        assert ref.submit_session_stop(sid_ref, s).wait(120.0)
+    ref_bytes = ref.finalize_session(sid_ref, "ply").result_bytes
+    ref.drain(timeout=10.0)
+
+    svc = ReconstructionService(_config(store_dir)).start()
+    done = svc.submit_array(serve_stack)
+    assert done.wait(60.0) and done.status == "done"
+    sid = svc.create_session({})["session_id"]
+    for s in serve_ring[:2]:
+        assert svc.submit_session_stop(sid, s).wait(120.0)
+    # Stop the lanes abruptly FIRST so the next submit stays queued —
+    # the ≥1-queued-job crash state of the acceptance criterion.
+    for w in svc.workers:
+        w.abort()
+        w.join(5.0)
+    queued = svc.submit_array(serve_stack + np.uint8(7))
+    assert queued.status == "queued"
+    svc.abort()
+
+    state = read_live_state(store_dir)
+    assert len(state.jobs) == 1 and len(state.sessions) == 1
+    assert len(state.sessions[0].stop_paths) == 2
+
+    svc2 = ReconstructionService(_config(store_dir)).start(
+        recover_from=True)
+    # The queued job: original id, terminal with a correct artifact.
+    j2 = svc2.get_job(queued.job_id)
+    assert j2 is not None and j2.recovered
+    assert j2.wait(120.0) and j2.status == "done", j2.status_dict()
+    assert j2.result_meta["points"] > 0
+    assert j2.result_bytes.startswith(b"ply")
+    # Pre-crash artifact survives in the content cache across restart.
+    dup = svc2.submit_array(serve_stack)
+    assert dup.status == "done"
+    assert dup.result_meta["content_cache_hit"] is True
+    # The session: accepts its next stops and finalizes bitwise-equal.
+    assert svc2.sessions.get(sid).session.stops_fused == 2
+    for s in serve_ring[2:]:
+        assert svc2.submit_session_stop(sid, s).wait(120.0)
+    fin = svc2.finalize_session(sid, "ply")
+    assert fin.result_bytes == ref_bytes
+    svc2.sessions.delete(sid)
+    assert svc2.drain(timeout=30.0)
+    # Journal-clean drain: nothing left to recover.
+    assert read_live_state(store_dir).empty
+
+
+def _spawn_serve(store_dir, recover=False):
+    """The shared soak-smoke spawn recipe (sanitize off: the suite's
+    SL_SANITIZE run arms it via the environment already)."""
+    try:
+        return soak_smoke.spawn_serve(store_dir, recover=recover,
+                                      sanitize=False)
+    except soak_smoke.SpawnError as e:
+        raise AssertionError(str(e))
+
+
+@pytest.mark.slow
+def test_kill9_subprocess_recover_roundtrip(tmp_path, serve_stack,
+                                            serve_ring):
+    """The acceptance criterion end to end with a REAL process and a
+    REAL ``kill -9``: queued jobs + a live 2-stop session at SIGKILL,
+    restart with ``--recover``, the session accepts stops 3-4 and
+    finalizes bitwise-identically to an uninterrupted serve process."""
+    # Uninterrupted reference in its own process/volume.
+    ref_proc, ref_port, _ = _spawn_serve(str(tmp_path / "ref"))
+    try:
+        rc = ServeClient(f"http://127.0.0.1:{ref_port}", timeout_s=120.0)
+        sid = rc.create_session()
+        for s in serve_ring:
+            st = rc.wait(rc.submit_stop(sid, s), timeout_s=300.0)
+            assert st["status"] == "done", st
+        fin = rc.finalize_session(sid, result_format="ply")
+        ref_bytes = rc.result(fin["job_id"])
+    finally:
+        ref_proc.send_signal(signal.SIGTERM)
+        ref_proc.wait(timeout=60.0)
+
+    store_dir = str(tmp_path / "vol")
+    proc, port, _ = _spawn_serve(store_dir)
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout_s=120.0)
+    sid = client.create_session()
+    for s in serve_ring[:2]:
+        st = client.wait(client.submit_stop(sid, s), timeout_s=300.0)
+        assert st["status"] == "done", st
+    # Burst of one-shot jobs, then SIGKILL without waiting: with a 5 ms
+    # linger and instant kill, some are still queued — and ALL acked
+    # admissions must recover regardless.
+    burst = [client.submit(serve_stack + np.uint8(i)) for i in range(6)]
+    proc.kill()                                  # SIGKILL, no cleanup
+    proc.wait(timeout=30.0)
+
+    proc2, port2, lines2 = _spawn_serve(store_dir, recover=True)
+    try:
+        client2 = ServeClient(f"http://127.0.0.1:{port2}",
+                              timeout_s=120.0)
+        assert client2.readyz()["ready"] is True
+        assert any("recovered from" in ln for ln in lines2)
+        # Every burst job either finished pre-kill (its id is gone with
+        # the in-memory registry) or was journaled live and must now
+        # complete under its ORIGINAL id.
+        from structured_light_for_3d_model_replication_tpu.serve.client \
+            import ServeClientError
+
+        recovered = 0
+        gone = 0
+        for jid in burst:
+            try:
+                st = client2.wait(jid, timeout_s=300.0)
+            except ServeClientError:
+                gone += 1   # finished pre-kill: id died with the
+                continue    # in-memory registry (404 is the contract)
+            assert st["status"] == "done", st
+            assert client2.result(jid).startswith(b"ply")
+            recovered += 1
+        assert recovered + gone == len(burst)
+        assert recovered >= 1, "no queued job survived the kill window"
+        # The session: recovered with both stops, accepts the rest,
+        # finalizes bitwise-identically to the uninterrupted process.
+        st = client2.session_status(sid)
+        assert st["stops_fused"] == 2, st
+        for s in serve_ring[2:]:
+            stj = client2.wait(client2.submit_stop(sid, s),
+                               timeout_s=300.0)
+            assert stj["status"] == "done", stj
+        fin = client2.finalize_session(sid, result_format="ply")
+        assert client2.result(fin["job_id"]) == ref_bytes
+        # Cross-restart duplicate: content cache, not recompute.
+        jdup = client2.submit(serve_stack + np.uint8(0))
+        stdup = client2.wait(jdup, timeout_s=60.0)
+        assert stdup["result"].get("content_cache_hit") is True
+    finally:
+        proc2.send_signal(signal.SIGTERM)
+        assert proc2.wait(timeout=120.0) == 0

@@ -505,9 +505,14 @@ def test_registry_bounded_by_result_bytes(serve_stack):
     job entries survive, so a late client gets an explicit eviction
     notice (HTTP 410), never a silent unknown-job 404."""
     stack, _ = serve_stack
+    # content_cache off: this test's subject is the REGISTRY byte budget
+    # on computed results; with the cache on, resubmits of the same
+    # stack short-circuit at admission (tests/test_durability.py covers
+    # that path, including eviction → resubmit → 200).
     cfg = ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1,),
                       linger_ms=1.0, queue_depth=8, workers=1,
                       warmup=False, completed_cap=100,
+                      content_cache=False,
                       result_cache_bytes=1)  # any result busts the budget
     svc = ReconstructionService(cfg).start()
     old = [_run_ok(svc, stack) for _ in range(3)]
@@ -595,16 +600,24 @@ def test_http_backpressure_429_with_retry_after(serve_stack):
                       queue_depth=2, workers=1, warmup=False)
     svc = ReconstructionService(cfg)         # workers never started
     http = ServeHTTPServer(svc, port=0).start()
-    client = ServeClient(f"http://127.0.0.1:{http.port}")
+    # retries=0: this test asserts the RAW backpressure surface; the
+    # client's default jittered-backoff retry loop is covered in
+    # tests/test_durability.py.
+    client = ServeClient(f"http://127.0.0.1:{http.port}", retries=0)
     try:
         client.submit(stack)
         client.submit(stack)
         with pytest.raises(BackpressureError) as ei:
             client.submit(stack)
         assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        # /healthz is LIVENESS (always 200 while the process answers);
+        # readiness — workers alive, warmup done, not draining — moved
+        # to /readyz for the deployment router.
         health = client.healthz()
-        assert health["ok"] is False          # no workers alive
+        assert health["ok"] is True
         assert health["queue_depth"] == 2
+        ready = client.readyz()
+        assert ready["ready"] is False        # never started
     finally:
         http.stop()
 

@@ -43,8 +43,10 @@ _DETAILS_ALIASES = {
 
 def higher_is_better(metric: str) -> bool:
     """Most headline metrics are seconds (lower wins); throughput lines
-    (config [9]'s ``soak_scans_per_s``) invert — going UP is the
-    improvement, going down the regression."""
+    (config [9]'s ``soak_scans_per_s``, config [10]'s
+    ``fleet_scans_per_s``) invert — going UP is the improvement, going
+    down the regression. Latency-shaped fleet lines
+    (``fleet_failover_s``) keep the lower-wins default."""
     return metric.endswith("_per_s")
 
 

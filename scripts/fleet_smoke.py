@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""CI fleet smoke: 2 real replica processes + a real router process,
+kill one replica mid-session, assert the session hands off.
+
+The tier-1-safe end of the fleet chaos spectrum (the 3-replica chaos
+gate with offered load, peer-network faults and fresh-node recovery is
+``tests/test_fleet.py::test_fleet_chaos_gate``; the measured version is
+bench config [10]):
+
+1. spawn replicas r0/r1 (`cli serve` on the soak-smoke tiny rig, each
+   with its own ``--store-dir`` under one shared volume plus the shared
+   ``--handoff-dir``, peered at each other) and a `cli serve --router`
+   process fronting both;
+2. via the ROUTER: one-shot job completes; a duplicate submit hits the
+   content cache (consistent-hash placement makes it a local hit); a
+   duplicate pushed directly at the OTHER replica comes back as a PEER
+   hit (the shared-cache path);
+3. open a session via the router, fuse stop 1, then **SIGKILL the
+   pinned replica**. The next stop through the router must succeed —
+   the router re-pins the session onto the survivor, which adopts it
+   from the handoff stream — and finalize must return a mesh;
+4. SIGTERM survivor + router: clean exits, the survivor's journal
+   volume drains clean, and the handoff dir holds no session streams.
+
+This module is also the SHARED SPAWN RECIPE for the fleet gates:
+``spawn_fleet`` / ``spawn_router`` are imported by tests/test_fleet.py
+and bench config [10] (same import-by-path pattern soak_smoke.py
+established), so every fleet gate exercises the same ports/flags/rig.
+
+CI runs this as the `fleet-smoke` job with SL_SANITIZE=1 (ci.yml).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+DEADLINE_S = 540.0
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SOAK_SPEC = importlib.util.spec_from_file_location(
+    "soak_smoke", os.path.join(REPO, "scripts", "soak_smoke.py"))
+soak_smoke = importlib.util.module_from_spec(_SOAK_SPEC)
+_SOAK_SPEC.loader.exec_module(soak_smoke)
+
+PROJ_W, PROJ_H = soak_smoke.PROJ_W, soak_smoke.PROJ_H
+CAM_H, CAM_W = soak_smoke.CAM_H, soak_smoke.CAM_W
+STREAM_PARAMS = soak_smoke.STREAM_PARAMS
+
+
+def free_ports(n: int) -> list[int]:
+    """Pre-pick n distinct free ports: replicas need their PEERS' URLs
+    at spawn time, before any of them is listening. The close→bind race
+    is real but vanishing at test scale (SO_REUSEADDR on the server)."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def replica_store(shared_dir: str, idx: int) -> str:
+    return os.path.join(shared_dir, "replicas", f"r{idx}")
+
+
+def handoff_dir(shared_dir: str) -> str:
+    return os.path.join(shared_dir, "handoff")
+
+
+def spawn_replica(shared_dir: str, idx: int, ports: list[int],
+                  recover: bool = False, sanitize: bool = True,
+                  env_extra: dict | None = None):
+    """One fleet replica on its pre-picked port: own journal volume
+    under the shared dir, the shared handoff volume, peered at every
+    other port. Returns (proc, port, stderr_lines)."""
+    peers = ",".join(f"http://127.0.0.1:{p}"
+                     for i, p in enumerate(ports) if i != idx)
+    extra = ["--port", str(ports[idx]),
+             "--replica-id", f"r{idx}",
+             "--handoff-dir", handoff_dir(shared_dir)]
+    if peers:
+        extra += ["--peers", peers]
+    return soak_smoke.spawn_serve(
+        replica_store(shared_dir, idx), recover=recover, extra=extra,
+        sanitize=sanitize, env_extra=env_extra)
+
+
+def spawn_router(ports: list[int], sanitize: bool = True,
+                 timeout_s: float = 60.0):
+    """The thin front (`cli serve --router`) over the replica ports;
+    returns (proc, router_port, stderr_lines)."""
+    replicas = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+    cmd = [sys.executable, "-m",
+           "structured_light_for_3d_model_replication_tpu.cli", "serve",
+           "--router", "--replicas", replicas, "--port", "0",
+           "--check-interval", "0.25"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if sanitize:
+        env.setdefault("SL_SANITIZE", "1")
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stderr=subprocess.PIPE, text=True)
+    lines: list[str] = []
+    port = [None]
+    got = threading.Event()
+
+    def pump():
+        for line in proc.stderr:
+            lines.append(line)
+            m = re.search(r"routing on :(\d+)", line)
+            if m:
+                port[0] = int(m.group(1))
+                got.set()
+        got.set()
+
+    threading.Thread(target=pump, daemon=True).start()
+    if not got.wait(timeout_s) or port[0] is None:
+        proc.kill()
+        raise soak_smoke.SpawnError(
+            "router never announced its port:\n" + "".join(lines[-30:]))
+    return proc, port[0], lines
+
+
+def spawn_fleet(shared_dir: str, n: int = 2, sanitize: bool = True,
+                env_extra: dict | None = None):
+    """n replicas + ports; returns ([(proc, port, lines)], ports)."""
+    ports = free_ports(n)
+    out = []
+    for i in range(n):
+        out.append(spawn_replica(shared_dir, i, ports,
+                                 sanitize=sanitize, env_extra=env_extra))
+    return out, ports
+
+
+def _fail(msg, procs=(), stderr_lines=None):
+    print(f"FLEET SMOKE FAIL: {msg}", file=sys.stderr)
+    if stderr_lines:
+        print("--- stderr ---", file=sys.stderr)
+        print("".join(stderr_lines[-60:]), file=sys.stderr)
+    for p in procs:
+        if p is not None and p.poll() is None:
+            p.kill()
+    sys.exit(1)
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    sys.path.insert(0, REPO)
+    import tempfile
+
+    import numpy as np
+
+    from structured_light_for_3d_model_replication_tpu.config import (
+        ProjectorConfig,
+    )
+    from structured_light_for_3d_model_replication_tpu.models import (
+        synthetic,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve import (
+        read_live_state,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClient,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.store import (
+        SessionStreamStore,
+    )
+
+    proj = ProjectorConfig(width=PROJ_W, height=PROJ_H)
+    cam = synthetic.default_calibration(CAM_H, CAM_W, proj)
+    stack, _ = synthetic.render_scan(synthetic.Scene(), *cam,
+                                     CAM_H, CAM_W, proj)
+    scene = synthetic.Scene(
+        wall_z=None,
+        spheres=(synthetic.Sphere((0.0, 2.0, 500.0), 80.0, 0.9),
+                 synthetic.Sphere((55.0, -30.0, 460.0), 35.0, 0.7)))
+    ring = [s for s, _ in synthetic.render_turntable_scans(
+        scene, n_stops=3, degrees_per_stop=12.0, cam_K=cam[0],
+        proj_K=cam[1], R=cam[2], T=cam[3], cam_height=CAM_H,
+        cam_width=CAM_W, proj=proj)]
+
+    shared = tempfile.mkdtemp(prefix="sl-fleet-smoke-")
+    try:
+        members, ports = spawn_fleet(shared, n=2)
+    except soak_smoke.SpawnError as e:
+        _fail(str(e))
+    procs = [m[0] for m in members]
+    all_lines = [ln for m in members for ln in m[2]]
+    try:
+        rproc, rport, rlines = spawn_router(ports)
+    except soak_smoke.SpawnError as e:
+        _fail(str(e), procs)
+    procs.append(rproc)
+    client = ServeClient(f"http://127.0.0.1:{rport}", timeout_s=120.0)
+    print(f"fleet up: replicas :{ports[0]}/:{ports[1]}, router :{rport} "
+          f"({time.monotonic() - t0:.0f}s)")
+
+    # One-shot via the router + local duplicate via consistent hashing.
+    jid = client.submit(stack)
+    st = client.wait(jid, timeout_s=240.0)
+    if st["status"] != "done":
+        _fail(f"routed job failed: {st}", procs, all_lines)
+    st2 = client.wait(client.submit(stack), timeout_s=60.0)
+    if not st2["result"].get("content_cache_hit"):
+        _fail(f"routed duplicate missed the cache: {st2}", procs,
+              all_lines)
+    # Cross-replica duplicate straight at each replica: whichever did
+    # NOT compute it must answer via the PEER cache.
+    peer_hit = False
+    for p in ports:
+        direct = ServeClient(f"http://127.0.0.1:{p}", timeout_s=120.0)
+        std = direct.wait(direct.submit(stack), timeout_s=120.0)
+        if std["status"] != "done":
+            _fail(f"direct duplicate failed: {std}", procs, all_lines)
+        if std["result"].get("cache_source") == "peer":
+            peer_hit = True
+    if not peer_hit:
+        _fail("no cross-replica duplicate came from the peer cache",
+              procs, all_lines)
+    print(f"cache: routed dup hit + cross-replica peer hit "
+          f"({time.monotonic() - t0:.0f}s)")
+
+    # Session through the router; kill the pinned replica mid-session.
+    sid = client.create_session()
+    stj = client.wait(client.submit_stop(sid, ring[0]), timeout_s=240.0)
+    if stj["status"] != "done":
+        _fail(f"stop 1 failed: {stj}", procs, all_lines)
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{rport}/fleet",
+                                timeout=10) as r:
+        fleet = json.loads(r.read())
+    pin = fleet["sessions_pinned"].get(sid)
+    if pin is None:
+        _fail(f"session not pinned: {fleet}", procs, all_lines)
+    victim_idx = ports.index(int(pin.rsplit(":", 1)[1]))
+    survivor_idx = 1 - victim_idx
+    members[victim_idx][0].kill()                 # SIGKILL, no drain
+    members[victim_idx][0].wait(timeout=30.0)
+    print(f"killed pinned replica r{victim_idx} "
+          f"({time.monotonic() - t0:.0f}s)")
+
+    stj2 = client.wait(client.submit_stop(sid, ring[1]), timeout_s=240.0)
+    if stj2["status"] != "done":
+        _fail(f"post-kill stop failed (no handoff?): {stj2}", procs,
+              all_lines)
+    sst = client.session_status(sid)
+    if sst.get("stops_fused") != 2:
+        _fail(f"session lost stops across handoff: {sst}", procs,
+              all_lines)
+    fin = client.finalize_session(sid, result_format="ply")
+    if not client.result(fin["job_id"]).startswith(b"ply"):
+        _fail("finalize artifact not a PLY", procs, all_lines)
+    print(f"handoff: session re-pinned + finalized on survivor "
+          f"r{survivor_idx} ({time.monotonic() - t0:.0f}s)")
+
+    # Clean exits: survivor drains clean, router stops, handoff empty.
+    for proc in (members[survivor_idx][0], rproc):
+        proc.send_signal(signal.SIGTERM)
+    rcs = [members[survivor_idx][0].wait(timeout=120.0),
+           rproc.wait(timeout=60.0)]
+    if any(rc != 0 for rc in rcs):
+        _fail(f"non-zero exits: {rcs}", procs, all_lines)
+    state = read_live_state(replica_store(shared, survivor_idx))
+    if state.jobs or state.sessions:
+        _fail(f"survivor journal not clean: {len(state.jobs)} jobs, "
+              f"{len(state.sessions)} sessions", procs, all_lines)
+    streams = SessionStreamStore(handoff_dir(shared)).list_sessions()
+    if streams:
+        _fail(f"handoff streams left behind: {streams}", procs,
+              all_lines)
+    print(f"FLEET SMOKE PASS in {time.monotonic() - t0:.0f}s "
+          "(router + 2 replicas, SIGKILL pinned mid-session, handoff "
+          "to survivor, clean drains, empty handoff volume)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -67,11 +67,15 @@ class SpawnError(RuntimeError):
 
 
 def spawn_serve(store_dir, recover=False, extra=(), sanitize=True,
-                timeout_s=300.0):
+                timeout_s=300.0, env_extra=None):
     """Start a tiny-rig `cli serve` subprocess over ``store_dir`` and
     wait for its readiness line; returns (proc, port, stderr_lines).
-    Shared with tests/test_durability.py — one spawn recipe, one set of
-    session params, no drift between the two durability gates."""
+    Shared with tests/test_durability.py AND the fleet tier
+    (scripts/fleet_smoke.py builds replicas from it) — one spawn
+    recipe, one set of session params, no drift between the gates.
+    ``extra`` flags appended LAST override the defaults (argparse
+    last-wins — the fleet recipe pins --port this way); ``env_extra``
+    adds environment (e.g. SL_PEER_FAULTS for the chaos harness)."""
     cmd = [sys.executable, "-m",
            "structured_light_for_3d_model_replication_tpu.cli", "serve",
            "--port", "0", "--proj-width", str(PROJ_W),
@@ -87,6 +91,8 @@ def spawn_serve(store_dir, recover=False, extra=(), sanitize=True,
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     if sanitize:
         env.setdefault("SL_SANITIZE", "1")
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.Popen(cmd, cwd=REPO, env=env,
                             stderr=subprocess.PIPE, text=True)
     lines: list[str] = []

@@ -94,7 +94,71 @@ def build_parser() -> argparse.ArgumentParser:
                         "{\"voxel_size\":4.0}}' — a 'merge' sub-object "
                         "overrides MergeParams. Fixed at startup (it "
                         "keys compiled programs)")
+    # -- fleet tier (docs/SERVING.md § fleet) ---------------------------
+    p.add_argument("--replica-id", default=None,
+                   help="stable replica identity (journaled session "
+                        "heads, handoff ownership); default: random "
+                        "per process")
+    p.add_argument("--peers", default=None,
+                   help="comma-separated peer base URLs — a local "
+                        "content-cache miss consults their "
+                        "GET /cache/<key> before computing")
+    p.add_argument("--handoff-dir", default=None,
+                   help="shared session-handoff volume (requires "
+                        "--store-dir): session ops stream there so a "
+                        "survivor replica can adopt this replica's "
+                        "live sessions after a crash")
+    p.add_argument("--router", action="store_true",
+                   help="run the thin fleet FRONT ROUTER instead of a "
+                        "replica: consistent-hash admission, sticky "
+                        "sessions with handoff, /readyz-driven "
+                        "failover (requires --replicas)")
+    p.add_argument("--replicas", default=None,
+                   help="comma-separated replica base URLs the router "
+                        "fronts (--router mode only)")
+    p.add_argument("--check-interval", type=float, default=1.0,
+                   help="router /readyz health-sweep period in seconds")
     return p
+
+
+def _run_router(args) -> int:
+    """``serve --router``: the thin fleet front (serve/router.py). It
+    holds no reconstruction state and never touches a device, but the
+    import of the serve package still pulls jax (service.py is a
+    sibling), so run it where the repo's deps are installed."""
+    import json
+
+    from ..serve.fleet import transport_from_env
+    from ..serve.router import FleetRouter, RouterHTTPServer
+
+    replicas = [u.strip() for u in (args.replicas or "").split(",")
+                if u.strip()]
+    if not replicas:
+        print("error: --router requires --replicas url1,url2,...",
+              file=sys.stderr)
+        return 2
+    router = FleetRouter(replicas,
+                         check_interval_s=args.check_interval,
+                         transport=transport_from_env())
+    http = RouterHTTPServer(router, host=args.host,
+                            port=args.port).start()
+    # Machine-parseable readiness line (fleet smoke greps it).
+    print(f"routing on :{http.port}", file=sys.stderr, flush=True)
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        print(f"signal {signum}: router stopping...", file=sys.stderr,
+              flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    stop.wait()
+    print(json.dumps(router.stats()), file=sys.stderr, flush=True)
+    http.stop()
+    print("router stopped", file=sys.stderr, flush=True)
+    return 0
 
 
 def _stream_params(base, spec: str | None):
@@ -118,6 +182,9 @@ def _stream_params(base, spec: str | None):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.router:
+        return _run_router(args)
 
     from ..config import ProjectorConfig
     from ..serve.service import (
@@ -147,6 +214,10 @@ def main(argv=None) -> int:
         print("error: --recover requires --store-dir (the journal "
               "volume to replay)", file=sys.stderr)
         return 2
+    if args.handoff_dir is not None and args.store_dir is None:
+        print("error: --handoff-dir requires --store-dir (the handoff "
+              "stream rides the WAL's group commit)", file=sys.stderr)
+        return 2
     import dataclasses
 
     defaults = ServeConfig()
@@ -170,7 +241,11 @@ def main(argv=None) -> int:
         max_sessions=args.max_sessions,
         store_dir=args.store_dir,
         content_cache=not args.no_content_cache,
-        stream=stream)
+        stream=stream,
+        replica_id=args.replica_id,
+        peers=tuple(u.strip() for u in (args.peers or "").split(",")
+                    if u.strip()),
+        handoff_dir=args.handoff_dir)
 
     calib_provider = None
     if args.calib is not None:

@@ -44,9 +44,16 @@ architecture and tuning guide.
 
 from .batcher import Batch, BucketBatcher, BucketKey, bucket_for
 from .cache import ContentCache, ProgramCache, ProgramKey, content_key
-from .client import ServeClient
-from .governor import BreakerOpenError, GovernorParams, LoadShedError, \
-    OverloadGovernor
+from .client import ServeClient, TransportError
+from .fleet import (
+    FaultyPeerTransport,
+    HashRing,
+    PeerCacheClient,
+    PeerFaultPlan,
+    PeerTransport,
+)
+from .governor import BreakerOpenError, CircuitBreaker, GovernorParams, \
+    LoadShedError, OverloadGovernor
 from .jobs import (
     AdmissionQueue,
     Job,
@@ -56,9 +63,11 @@ from .jobs import (
     ServeError,
     StackFormatError,
 )
+from .router import FleetRouter, RouterHTTPServer
 from .service import ReconstructionService, ServeConfig, ServeHTTPServer
 from .sessions import SessionLimitError, SessionManager, UnknownSessionError
-from .store import JournalStore, RecoveredState, read_live_state
+from .store import JournalStore, RecoveredState, SessionStreamStore, \
+    read_live_state
 from .worker import DeviceWorker
 
 __all__ = [
@@ -67,27 +76,37 @@ __all__ = [
     "BreakerOpenError",
     "BucketBatcher",
     "BucketKey",
+    "CircuitBreaker",
     "ContentCache",
     "DeviceWorker",
+    "FaultyPeerTransport",
+    "FleetRouter",
     "GovernorParams",
+    "HashRing",
     "Job",
     "JobRejected",
     "JournalStore",
     "LoadShedError",
     "OverloadGovernor",
+    "PeerCacheClient",
+    "PeerFaultPlan",
+    "PeerTransport",
     "ProgramCache",
     "ProgramKey",
     "QueueClosedError",
     "QueueFullError",
     "ReconstructionService",
     "RecoveredState",
+    "RouterHTTPServer",
     "ServeClient",
     "ServeConfig",
     "ServeError",
     "ServeHTTPServer",
     "SessionLimitError",
     "SessionManager",
+    "SessionStreamStore",
     "StackFormatError",
+    "TransportError",
     "UnknownSessionError",
     "bucket_for",
     "content_key",

@@ -260,16 +260,37 @@ class ContentCache:
         self._evictions = self.registry.counter(
             "serve_content_cache_evictions_total",
             "artifacts dropped by the byte budget")
+        self._corrupt = self.registry.counter(
+            "serve_content_cache_corrupt_total",
+            "corrupt/truncated disk blobs quarantined at load or hit")
         self._bytes_gauge = self.registry.gauge(
             "serve_content_cache_bytes", "retained artifact bytes")
         if dir is not None:
             os.makedirs(dir, exist_ok=True)
+            os.makedirs(os.path.join(dir, "quarantine"), exist_ok=True)
             self._load_index()
 
     # ------------------------------------------------------------------
 
     def _payload_path(self, key: str) -> str:
         return os.path.join(self.dir, f"{key}.bin")
+
+    def _quarantine(self, key: str, reason: str) -> None:
+        """Move a corrupt entry's files aside (never delete evidence —
+        the quarantine dir is what a post-mortem inspects) and count it.
+        The entry is already out of the index when this runs; a
+        quarantined key simply misses, it NEVER raises into admission."""
+        self._corrupt.inc()
+        log.warning("content cache entry %s quarantined: %s",
+                    key[:12], reason)
+        qdir = os.path.join(self.dir, "quarantine")
+        for suffix in (".bin", ".json"):
+            src = os.path.join(self.dir, f"{key}{suffix}")
+            try:
+                os.replace(src, os.path.join(qdir, f"{key}{suffix}"))
+            except OSError:
+                log.debug("quarantine move of %s%s failed", key[:12],
+                          suffix)
 
     def _load_index(self) -> None:
         """Rebuild the index from sidecars, oldest first (so LRU order
@@ -285,7 +306,16 @@ class ContentCache:
             except (OSError, ValueError):
                 continue
             key = fname[:-5]
-            if not os.path.exists(self._payload_path(key)):
+            try:
+                size = os.path.getsize(self._payload_path(key))
+            except OSError:
+                continue  # no payload: sidecar-only orphan
+            if size != int(doc.get("bytes", -1)):
+                # Truncated/grown blob (torn write, disk fault): a miss
+                # and a quarantine, never an entry that would raise —
+                # or serve garbage — at hit time.
+                self._quarantine(key, f"size {size} != sidecar "
+                                      f"{doc.get('bytes')}")
                 continue
             sidecars.append((float(doc.get("t", 0.0)), key, doc))
         for _, key, doc in sorted(sidecars):
@@ -293,6 +323,7 @@ class ContentCache:
             self._index[key] = {"bytes": n,
                                 "format": doc.get("format", "ply"),
                                 "meta": dict(doc.get("meta") or {}),
+                                "sha256": doc.get("sha256"),
                                 "payload": None}
             self._held += n
         # Enforce the budget at load too: a lowered max_bytes (or a
@@ -317,32 +348,65 @@ class ContentCache:
 
     def get(self, key: str) -> tuple[bytes, dict, str] | None:
         """(payload, meta, format) for ``key``, or None. Counts the
-        hit/miss; disk reads happen outside the index lock."""
+        hit/miss; disk reads happen outside the index lock. A corrupt
+        or truncated disk blob counts as a MISS and is quarantined —
+        admission never sees an exception from this path."""
+        return self._get(key, count=True)
+
+    def peek(self, key: str) -> tuple[bytes, dict, str] | None:
+        """``get`` without touching the hit/miss counters — the peer
+        protocol's export path (serve/fleet.py), so fleet probes don't
+        masquerade as admission traffic on this replica's dashboards.
+        Corruption handling is identical (quarantine, miss)."""
+        return self._get(key, count=False)
+
+    def _get(self, key: str, count: bool) -> tuple[bytes, dict, str] | None:
         with self._lock:
             entry = self._index.get(key)
             if entry is not None:
                 self._index.move_to_end(key)
                 payload = entry["payload"]
                 meta, fmt = dict(entry["meta"]), entry["format"]
+                want_bytes = entry["bytes"]
+                want_sha = entry.get("sha256")
         if entry is None:
-            self._misses.inc()
+            if count:
+                self._misses.inc()
             return None
         if payload is None:
             try:
                 with open(self._payload_path(key), "rb") as f:
                     payload = f.read()
             except OSError as e:
-                log.warning("content cache payload %s unreadable: %s",
-                            key[:12], e)
-                with self._lock:
-                    gone = self._index.pop(key, None)
-                    if gone is not None:
-                        self._held -= gone["bytes"]
-                        self._bytes_gauge.set(self._held)
-                self._misses.inc()
+                self._drop(key)
+                self._quarantine(key, f"unreadable ({e})")
+                if count:
+                    self._misses.inc()
                 return None
-        self._hits.inc()
+            # Integrity gate: a bit-flipped or truncated blob must never
+            # reach a client (or a fleet peer) as a "cached artifact".
+            corrupt = (len(payload) != want_bytes
+                       or (want_sha is not None
+                           and hashlib.sha256(payload).hexdigest()
+                           != want_sha))
+            if corrupt:
+                self._drop(key)
+                self._quarantine(
+                    key, f"payload {len(payload)}B fails integrity "
+                         f"check (want {want_bytes}B)")
+                if count:
+                    self._misses.inc()
+                return None
+        if count:
+            self._hits.inc()
         return payload, meta, fmt
+
+    def _drop(self, key: str) -> None:
+        with self._lock:
+            gone = self._index.pop(key, None)
+            if gone is not None:
+                self._held -= gone["bytes"]
+                self._bytes_gauge.set(self._held)
 
     def put(self, key: str, payload: bytes, meta: dict, fmt: str) -> None:
         """Retain one finished artifact; evicts oldest past the byte
@@ -351,6 +415,11 @@ class ContentCache:
         if len(payload) > self.max_bytes:
             return  # one artifact over the whole budget: not cacheable
         stored: bytes | None = payload
+        # Digest only for disk-backed caches: memory-held payloads are
+        # never re-read, so hashing them would be pure wasted CPU on
+        # the job-completion path.
+        sha = (hashlib.sha256(payload).hexdigest()
+               if self.dir is not None else None)
         if self.dir is not None:
             path = self._payload_path(key)
             tmp = path + ".tmp"
@@ -361,7 +430,7 @@ class ContentCache:
                 side = os.path.join(self.dir, f"{key}.json")
                 with open(side + ".tmp", "w", encoding="utf-8") as f:
                     json.dump({"format": fmt, "meta": meta,
-                               "bytes": len(payload),
+                               "bytes": len(payload), "sha256": sha,
                                "t": time.time()}, f)
                 os.replace(side + ".tmp", side)
             except OSError as e:
@@ -374,7 +443,8 @@ class ContentCache:
             if prior is not None:
                 self._held -= prior["bytes"]
             self._index[key] = {"bytes": len(payload), "format": fmt,
-                                "meta": dict(meta), "payload": stored}
+                                "meta": dict(meta), "sha256": sha,
+                                "payload": stored}
             self._held += len(payload)
             while self._held > self.max_bytes and len(self._index) > 1:
                 victim, entry = self._index.popitem(last=False)
@@ -401,4 +471,5 @@ class ContentCache:
                 "hits": int(self._hits.value),
                 "misses": int(self._misses.value),
                 "evictions": int(self._evictions.value),
+                "corrupt_quarantined": int(self._corrupt.value),
             }

@@ -15,6 +15,12 @@ rejected burst does not re-arrive as the same burst, and bounded by BOTH
 an attempt count (``retries``) and a wall-clock budget
 (``retry_budget_s``). ``retries=0`` restores surface-immediately
 semantics.
+
+Fleet failover: ``base_url`` may be a LIST of replica (or router) URLs.
+Connection-level failures (refused/reset/timeout — a dead replica)
+raise :class:`TransportError`, which is retryable under the same backoff
+policy and rotates the client to the next URL first, so the retry lands
+on a live replica instead of hammering the corpse.
 """
 
 from __future__ import annotations
@@ -46,37 +52,73 @@ class BackpressureError(ServeClientError):
         self.retry_after_s = retry_after_s
 
 
+class TransportError(ServeClientError):
+    """Connection-level failure — refused, reset, DNS, timeout. A dead
+    replica looks exactly like this, so it is RETRYABLE under the same
+    backoff policy as backpressure, and a client constructed with
+    several base URLs rotates to the next one before the retry."""
+
+
 class ServeClient:
-    def __init__(self, base_url: str, timeout_s: float = 30.0,
+    def __init__(self, base_url, timeout_s: float = 30.0,
                  retries: int = 4, retry_backoff_s: float = 0.25,
-                 retry_budget_s: float = 30.0):
-        self.base_url = base_url.rstrip("/")
+                 retry_budget_s: float = 30.0,
+                 unknown_grace_s: float = 0.0):
+        # One URL or a list: with a list, connection-level failures
+        # rotate to the next replica (failover), while HTTP-level
+        # answers (including 429/503) stay on the current one.
+        urls = ([base_url] if isinstance(base_url, str)
+                else list(base_url))
+        if not urls:
+            raise ValueError("base_url must name at least one replica")
+        self._urls = [u.rstrip("/") for u in urls]
+        self._url_idx = 0
         self.timeout_s = timeout_s
         self.retries = int(retries)
         self.retry_backoff_s = float(retry_backoff_s)
         self.retry_budget_s = float(retry_budget_s)
+        # How long wait() keeps polling through "unknown job" 404s
+        # before trusting them. Behind a fleet router, an acked job can
+        # 404 transiently while its replica is dead-awaiting-recovery
+        # (every survivor answers 404); clients that poll recoverable
+        # jobs across failover set this to their recovery budget.
+        # Default 0.0 keeps the honest fast 404.
+        self.unknown_grace_s = float(unknown_grace_s)
         # Injectable for deterministic tests.
         self._sleep = time.sleep
         self._rng = random.Random()
 
+    @property
+    def base_url(self) -> str:
+        """The replica currently in rotation."""
+        return self._urls[self._url_idx % len(self._urls)]
+
+    def _rotate(self) -> None:
+        if len(self._urls) > 1:
+            self._url_idx = (self._url_idx + 1) % len(self._urls)
+
     # ------------------------------------------------------------------
 
     def _retrying(self, fn):
-        """Run ``fn`` with jittered backoff on backpressure: the server's
-        Retry-After hint (when present) sets the base delay, otherwise
-        exponential from ``retry_backoff_s``; every delay is jittered
-        ±50% so N rejected clients don't re-arrive in lockstep. Bounded
-        by attempts AND wall clock; the LAST rejection is re-raised
-        intact (hint included) when the budget is spent."""
+        """Run ``fn`` with jittered backoff on backpressure AND on
+        connection-level failure (a dead/restarting replica): the
+        server's Retry-After hint (when present) sets the base delay,
+        otherwise exponential from ``retry_backoff_s``; every delay is
+        jittered ±50% so N rejected clients don't re-arrive in lockstep.
+        Bounded by attempts AND wall clock; the LAST error is re-raised
+        intact (hint included) when the budget is spent. Transport
+        failures have already rotated the base URL, so the retry lands
+        on the next replica in the list."""
         deadline = time.monotonic() + self.retry_budget_s
         attempt = 0
         while True:
             try:
                 return fn()
-            except BackpressureError as e:
+            except (BackpressureError, TransportError) as e:
                 if attempt >= self.retries:
                     raise
-                base = (e.retry_after_s if e.retry_after_s
+                hint = getattr(e, "retry_after_s", None)
+                base = (hint if hint
                         else self.retry_backoff_s * (2 ** attempt))
                 delay = base * self._rng.uniform(0.5, 1.5)
                 if time.monotonic() + delay > deadline:
@@ -90,6 +132,14 @@ class ServeClient:
                 return r.status, dict(r.headers), r.read()
         except urllib.error.HTTPError as e:
             return e.code, dict(e.headers), e.read()
+        except OSError as e:
+            # urllib.error.URLError (connection refused/reset/DNS) and
+            # raw socket timeouts are all OSError. Rotate FIRST so even
+            # a non-retrying caller's next call tries the next replica.
+            self._rotate()
+            raise TransportError(
+                f"replica unreachable ({e}); "
+                f"next base URL: {self.base_url}") from e
 
     @staticmethod
     def _payload(body: bytes) -> dict:
@@ -164,8 +214,32 @@ class ServeClient:
         A FAILED job returns normally — callers inspect ``error`` (its
         taxonomy chain tells retryable congestion from poisoned input)."""
         deadline = time.monotonic() + timeout_s
+        grace_end = time.monotonic() + self.unknown_grace_s
         while True:
-            st = self.status(job_id)
+            try:
+                st = self.status(job_id)
+            except TransportError:
+                # A restarting/failing-over replica mid-poll: keep
+                # polling (the base URL already rotated) until the
+                # caller's own deadline says stop.
+                if time.monotonic() > deadline:
+                    raise
+                self._sleep(poll_s)
+                continue
+            except ServeClientError as e:
+                # An "unknown job" 404 can be a wrong-replica answer
+                # rather than a terminal fact: multi-URL clients after
+                # a transport rotation (the job lives on the replica
+                # that admitted it — rotate onward), and router
+                # clients while the admitting replica is dead awaiting
+                # recovery (poll through within unknown_grace_s).
+                now = time.monotonic()
+                if "unknown job" in str(e) and now <= deadline \
+                        and (len(self._urls) > 1 or now < grace_end):
+                    self._rotate()
+                    self._sleep(poll_s)
+                    continue
+                raise
             if st["status"] in ("done", "failed"):
                 return st
             if time.monotonic() > deadline:

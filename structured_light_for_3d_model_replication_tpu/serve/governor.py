@@ -75,6 +75,73 @@ class GovernorParams:
     watchdog_max_restarts: int = 4
 
 
+class CircuitBreaker:
+    """Sliding-window failure-rate breaker with cooldown + half-open.
+
+    The reusable core of the PR-8 governor's worker breaker, split out so
+    the fleet tier (serve/fleet.py) can run ONE PER PEER: a sliding
+    window of outcomes, an open state that lasts ``cooldown_s``, and
+    half-open semantics — after cooldown the first probe is allowed
+    through, and its success closes the breaker (clearing the window so
+    stale failures cannot re-trip it instantly).
+
+    Thread-safe; policy-free: it reports transitions (tripped / closed)
+    and leaves events, metrics and what "failure" means to the caller.
+    """
+
+    def __init__(self, window: int = 32, min_samples: int = 8,
+                 failure_rate: float = 0.5, cooldown_s: float = 5.0):
+        self.min_samples = int(min_samples)
+        self.failure_rate = float(failure_rate)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._outcomes: collections.deque[bool] = collections.deque(
+            maxlen=max(1, int(window)))
+        self._open_until = -float("inf")
+        self._open_rate = 0.0
+
+    def note_ok(self) -> bool:
+        """Record one success. Returns True when this success CLOSED a
+        half-open breaker (cooldown had lapsed and the probe worked)."""
+        with self._lock:
+            was_open = time.monotonic() < self._open_until
+            self._outcomes.append(True)
+            if was_open or self._open_until == -float("inf"):
+                return False
+            # Half-open probe succeeded: close fully, forget the window.
+            self._open_until = -float("inf")
+            self._outcomes.clear()
+            return True
+
+    def note_failure(self) -> tuple[bool, float, int]:
+        """Record one failure. Returns (tripped_now, rate, samples)."""
+        with self._lock:
+            self._outcomes.append(False)
+            n = len(self._outcomes)
+            rate = sum(1 for ok in self._outcomes if not ok) / n
+            now = time.monotonic()
+            tripped = (n >= self.min_samples
+                       and rate >= self.failure_rate
+                       and now >= self._open_until)
+            if tripped:
+                self._open_until = now + self.cooldown_s
+                self._open_rate = rate
+            return tripped, rate, n
+
+    def open_remaining(self) -> float | None:
+        """Remaining cooldown seconds while open, else None (closed or
+        half-open — probe traffic may flow)."""
+        with self._lock:
+            remaining = self._open_until - time.monotonic()
+        return remaining if remaining > 0 else None
+
+    @property
+    def open_rate(self) -> float:
+        """The failure rate observed at the last trip."""
+        with self._lock:
+            return self._open_rate
+
+
 class BreakerOpenError(JobRejected):
     """Worker-exception rate tripped the breaker — retry after cooldown."""
 
@@ -110,11 +177,11 @@ class OverloadGovernor:
         self.queue = queue
         self.telemetry = telemetry
         self.store = store
-        self._lock = threading.Lock()
-        self._outcomes: collections.deque[bool] = collections.deque(
-            maxlen=max(1, params.breaker_window))
-        self._open_until = -float("inf")
-        self._open_rate = 0.0
+        self._breaker = CircuitBreaker(
+            window=params.breaker_window,
+            min_samples=params.breaker_min_samples,
+            failure_rate=params.breaker_failure_rate,
+            cooldown_s=params.breaker_cooldown_s)
         # tier="preview" counts SHEDDING DECISIONS (one per stop
         # ingested while the tier is active) — the preview-due check and
         # covisibility gate run later in the session, so the per-preview
@@ -142,36 +209,13 @@ class OverloadGovernor:
     # -- breaker -----------------------------------------------------------
 
     def note_worker_ok(self) -> None:
-        with self._lock:
-            was_open = time.monotonic() < self._open_until
-            self._outcomes.append(True)
-            if was_open:
-                return
-            if self._open_until != -float("inf"):
-                # Half-open probe succeeded: close fully.
-                self._open_until = -float("inf")
-                self._outcomes.clear()
-                closed = True
-            else:
-                closed = False
-        if closed:
+        if self._breaker.note_ok():
             events.record("breaker_closed", severity="info",
                           message="worker recovered; breaker closed")
 
     def note_worker_failure(self) -> None:
         p = self.params
-        with self._lock:
-            self._outcomes.append(False)
-            n = len(self._outcomes)
-            failures = sum(1 for ok in self._outcomes if not ok)
-            rate = failures / n
-            now = time.monotonic()
-            tripped = (n >= p.breaker_min_samples
-                       and rate >= p.breaker_failure_rate
-                       and now >= self._open_until)
-            if tripped:
-                self._open_until = now + p.breaker_cooldown_s
-                self._open_rate = rate
+        tripped, rate, n = self._breaker.note_failure()
         if tripped:
             self._breaker_trips.inc()
             events.record(
@@ -186,9 +230,7 @@ class OverloadGovernor:
 
     def breaker_open(self) -> float | None:
         """Remaining cooldown seconds when open, else None."""
-        with self._lock:
-            remaining = self._open_until - time.monotonic()
-        return remaining if remaining > 0 else None
+        return self._breaker.open_remaining()
 
     # -- shedding ----------------------------------------------------------
 
@@ -233,7 +275,7 @@ class OverloadGovernor:
         if remaining is not None:
             self._shed_total["breaker"].inc()
             self._level_gauge.set(LEVEL_BREAKER_OPEN)
-            raise BreakerOpenError(self._open_rate, remaining)
+            raise BreakerOpenError(self._breaker.open_rate, remaining)
         lvl = self.level()
         if lvl >= LEVEL_SHED_LOW_PRIORITY and priority >= 2:
             self._shed_total["low_priority"].inc()

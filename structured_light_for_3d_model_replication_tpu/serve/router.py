@@ -1,0 +1,644 @@
+"""Thin fleet front router: consistent-hash admission, sticky sessions.
+
+One :class:`FleetRouter` in front of N replicas (each a normal
+`cli serve` process) turns "a durable replica" into "a fleet that loses
+a node and doesn't care":
+
+* **health-driven membership** — a background thread polls every
+  replica's ``/readyz`` (the PR-8 readiness contract) on a short bounded
+  timeout; a 503 or a dead socket removes the replica from the routing
+  ring until it answers ready again. No replica-side cooperation beyond
+  the endpoint that already exists.
+* **consistent-hash admission** — ``POST /submit`` is placed by the
+  SHA-256 of the request body over a :class:`~.fleet.HashRing`, so
+  duplicate submits land on the replica that already holds the artifact
+  (a local content-cache hit). When that replica dies, only its arc of
+  keys remaps — and the peer half of the shared cache
+  (serve/fleet.py) covers the remapped duplicates.
+* **replica-sticky sessions with handoff** — ``POST /session`` pins the
+  new session to a ready replica; every later op routes to the pin.
+  When the pinned replica dies mid-session, the router walks the ring's
+  survivors and asks one to **adopt** the session from the shared
+  handoff stream (``POST /session/<id>/adopt``,
+  `ReconstructionService.adopt_session`), re-pins, and forwards the op
+  — the client sees one slower stop, not a dead scan.
+* **transparent proxying** — everything else (``/status``, ``/result``,
+  previews, metrics aggregation's per-replica scrape) forwards to the
+  owning replica; job→replica placements are remembered (bounded) so
+  polling follows the job wherever admission put it.
+
+The router holds NO reconstruction state and never touches a device:
+killing it loses nothing but routing memory (job/session pins are
+re-learned by probing replicas), which is why one thin process is
+enough in front of the fleet. (Importing it still pulls the serve
+package — and with it jax — so it runs from the same install as a
+replica; it just never initializes a backend.)
+docs/SERVING.md § fleet has the deployment recipe; the chaos bars live
+in tests/test_fleet.py and bench config [10].
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..utils import events, trace
+from ..utils.log import get_logger
+from .fleet import HashRing, PeerTransport
+from .service import MAX_SUBMIT_BYTES
+
+log = get_logger(__name__)
+
+#: Request headers the router forwards to replicas verbatim.
+_FORWARD_HEADERS = ("X-Result-Format", "X-Priority", "X-Deadline-S",
+                    "Content-Type")
+
+
+class FleetRouter:
+    """Routing brain (transport-agnostic; the HTTP server is below)."""
+
+    def __init__(self, replicas, check_interval_s: float = 1.0,
+                 health_timeout_s: float = 2.0,
+                 forward_timeout_s: float = 600.0,
+                 transport: PeerTransport | None = None,
+                 registry: "trace.MetricsRegistry | None" = None,
+                 max_job_pins: int = 65536):
+        urls = [u.rstrip("/") for u in replicas]
+        if not urls:
+            raise ValueError("a router needs at least one replica URL")
+        self.replicas = urls
+        self.check_interval_s = float(check_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.transport = transport if transport is not None \
+            else PeerTransport()
+        self.registry = registry if registry is not None \
+            else trace.MetricsRegistry()
+        self.ring = HashRing(urls)
+        self._lock = threading.Lock()
+        self._ready: dict[str, bool] = {u: False for u in urls}
+        self._reasons: dict[str, str] = {}
+        self._jobs: OrderedDict[str, str] = OrderedDict()  # job -> url
+        self._max_job_pins = int(max_job_pins)
+        self._sessions: dict[str, str] = {}                # sid -> url
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._requests = lambda route: self.registry.counter(
+            "router_requests_total", "requests by route", route=route)
+        self._failovers = self.registry.counter(
+            "router_failovers_total",
+            "submits re-placed after the hash owner failed")
+        self._repins = self.registry.counter(
+            "router_session_repins_total",
+            "sessions handed off to a survivor after their pinned "
+            "replica died")
+        self._unroutable = self.registry.counter(
+            "router_unroutable_total",
+            "requests refused with no ready replica")
+        self._ready_gauge = self.registry.gauge(
+            "router_ready_replicas", "replicas currently routable")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        self._sweep()  # synchronous first sweep: route from request one
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._watch,
+                                        name="router-health", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            self._sweep()
+
+    def _sweep(self) -> None:
+        for url in self.replicas:
+            ready, reason = self._probe(url)
+            self._set_ready(url, ready, reason)
+        with self._lock:
+            self._ready_gauge.set(sum(self._ready.values()))
+
+    def _probe(self, url: str) -> tuple[bool, str]:
+        try:
+            status, _, body = self.transport.get(
+                f"{url}/readyz", timeout_s=self.health_timeout_s)
+        except OSError as e:
+            return False, f"unreachable ({e})"
+        if status == 200:
+            return True, ""
+        try:
+            reasons = json.loads(body.decode()).get("reasons", [])
+        except (ValueError, UnicodeDecodeError):
+            reasons = []
+        return False, "; ".join(reasons) or f"readyz {status}"
+
+    def _set_ready(self, url: str, ready: bool, reason: str = "") -> None:
+        with self._lock:
+            was = self._ready.get(url)
+            self._ready[url] = ready
+            self._reasons[url] = reason
+        if was is not None and was != ready:
+            log.info("replica %s -> %s%s", url,
+                     "ready" if ready else "not ready",
+                     f" ({reason})" if reason else "")
+            events.record("router_replica_health", severity="info"
+                          if ready else "warning", url=url, ready=ready,
+                          reason=reason)
+
+    # -- membership views ----------------------------------------------
+
+    def ready_replicas(self) -> list[str]:
+        with self._lock:
+            return [u for u in self.replicas if self._ready.get(u)]
+
+    def _down(self) -> set[str]:
+        with self._lock:
+            return {u for u in self.replicas if not self._ready.get(u)}
+
+    # -- placement ------------------------------------------------------
+
+    def place_submit(self, body: bytes) -> list[str]:
+        """Candidate replicas for one submit, consistent-hash owner
+        first: duplicates of the same bytes keep landing on the same
+        replica while it lives, so its local content cache answers."""
+        key = hashlib.sha256(body).hexdigest()
+        return self.ring.preference(key, avoid=self._down())
+
+    def place_session(self, session_id: str) -> list[str]:
+        return self.ring.preference(session_id, avoid=self._down())
+
+    def next_replica(self) -> str | None:
+        """Round-robin over ready replicas (session creation spread)."""
+        ready = self.ready_replicas()
+        if not ready:
+            return None
+        with self._lock:
+            self._rr += 1
+            return ready[self._rr % len(ready)]
+
+    # -- pin bookkeeping -------------------------------------------------
+
+    def pin_job(self, job_id: str, url: str) -> None:
+        with self._lock:
+            self._jobs[job_id] = url
+            while len(self._jobs) > self._max_job_pins:
+                self._jobs.popitem(last=False)
+
+    def job_url(self, job_id: str) -> str | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def pin_session(self, session_id: str, url: str) -> None:
+        with self._lock:
+            self._sessions[session_id] = url
+
+    def session_url(self, session_id: str) -> str | None:
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def unpin_session(self, session_id: str) -> None:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    # -- forwarding ------------------------------------------------------
+
+    def forward(self, url: str, method: str, path: str,
+                body: bytes | None = None, headers: dict | None = None
+                ) -> tuple[int, dict, bytes]:
+        """One bounded-proxy hop. OSError propagates (connection-level
+        death) and flips the replica not-ready immediately — the health
+        sweep would notice within a second anyway, but the failing
+        request IS the freshest probe we have."""
+        try:
+            return self.transport.request(
+                method, url + path, body=body, headers=headers,
+                timeout_s=self.forward_timeout_s)
+        except OSError:
+            self._set_ready(url, False, "request failed")
+            raise
+
+    # -- session handoff --------------------------------------------------
+
+    def adopt_on_survivor(self, session_id: str) -> str | None:
+        """Walk the ring's survivors asking each to adopt the session
+        from the shared handoff stream; returns the new pin, or None
+        when nobody could (no ready replicas, or no handoff volume)."""
+        return self._adopt_on_survivor_ex(session_id)[0]
+
+    def _adopt_on_survivor_ex(self, session_id: str
+                              ) -> tuple[str | None, bool]:
+        """``(new pin, definitively_unknown)``: the second element is
+        True only when at least one survivor ANSWERED the adoption and
+        every answer was a 404 — no adoptable handoff stream exists
+        (the session ended, or never rode a handoff volume), so a
+        retry cannot help. Transport failures and busy refusals (503)
+        keep it False — those warrant the caller's retryable 503."""
+        old = self.session_url(session_id)
+        attempted = 0
+        uncertain = 0      # transport failures + non-404 refusals
+        for url in self.place_session(session_id):
+            if url == old:
+                continue
+            attempted += 1
+            try:
+                status, _, body = self.forward(
+                    url, "POST", f"/session/{session_id}/adopt")
+            except OSError:
+                uncertain += 1
+                continue
+            if status == 200:
+                self.pin_session(session_id, url)
+                self._repins.inc()
+                events.record("session_repinned", severity="warning",
+                              session_id=session_id, from_url=old,
+                              to_url=url)
+                log.warning("session %s re-pinned %s -> %s",
+                            session_id, old, url)
+                return url, False
+            if status != 404:
+                uncertain += 1
+            log.warning("survivor %s refused adoption of %s: %s %s",
+                        url, session_id, status, body[:200])
+        return None, attempted > 0 and uncertain == 0
+
+    def route_session(self, session_id: str) -> str | None:
+        return self.route_session_ex(session_id)[0]
+
+    def route_session_ex(self, session_id: str
+                         ) -> tuple[str | None, bool]:
+        """The replica a session op should go to: the live pin; for an
+        UNKNOWN session (router restart — pins are memory) the replica
+        that already holds it live, re-learned by probing; else a
+        survivor that successfully adopts. Probing before adopting
+        matters: stealing a session from a healthy replica would
+        double-host it and pay an adoption replay for a failover that
+        never happened.
+
+        Returns ``(replica, definitively_unknown)``. ``(None, True)``
+        = every ready replica answered and denied the session AND no
+        adoptable handoff stream exists — the caller should 404, not
+        tell the client to retry a session that already ended.
+        ``(None, False)`` = nowhere to send it right now (no ready
+        replicas, or transport failures muddied the sweep) — caller
+        503s and the client retries."""
+        url = self.session_url(session_id)
+        if url is not None:
+            with self._lock:
+                pinned_ready = self._ready.get(url, False)
+            if pinned_ready:
+                return url, False
+            # The sweep's cached flag can be STALE (one missed probe
+            # while the replica was busy). Adoption is expensive and —
+            # worse — steals the session; re-probe the pin fresh and
+            # believe a live answer before walking the survivors.
+            ready, reason = self._probe(url)
+            self._set_ready(url, ready, reason)
+            if ready:
+                return url, False
+            # A pin is evidence the session recently lived on a replica
+            # we can no longer ask — its fate is UNKNOWN until a
+            # survivor adopts or the replica recovers, so never 404.
+            return self._adopt_on_survivor_ex(session_id)[0], False
+        probed = 0
+        uncertain = 0      # transport failures + non-(200|404) answers
+        for candidate in self.ready_replicas():
+            probed += 1
+            try:
+                status, _, _ = self.forward(
+                    candidate, "GET", f"/session/{session_id}")
+            except OSError:
+                uncertain += 1
+                continue
+            if status == 200:
+                self.pin_session(session_id, candidate)
+                return candidate, False
+            if status != 404:
+                uncertain += 1
+        adopted, adopt_unknown = self._adopt_on_survivor_ex(session_id)
+        if adopted is not None:
+            return adopted, False
+        return None, probed > 0 and uncertain == 0 and adopt_unknown
+
+    # -- inspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": [
+                    {"url": u, "ready": self._ready.get(u, False),
+                     "reason": self._reasons.get(u, "")}
+                    for u in self.replicas],
+                "sessions_pinned": dict(self._sessions),
+                "jobs_pinned": len(self._jobs),
+                "failovers": int(self._failovers.value),
+                "session_repins": int(self._repins.value),
+            }
+
+    def metrics_text(self) -> str:
+        with self._lock:
+            self._ready_gauge.set(sum(self._ready.values()))
+        return self.registry.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: FleetRouter  # bound by RouterHTTPServer
+
+    protocol_version = "HTTP/1.1"
+    timeout = 120.0
+
+    def _json(self, obj, status=200):
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _relay(self, status: int, headers: dict, body: bytes) -> None:
+        self.send_response(status)
+        for k, v in headers.items():
+            if k.lower() in ("content-type", "retry-after") \
+                    or k.lower().startswith("x-"):
+                self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _no_replica(self) -> None:
+        self.router._unroutable.inc()
+        self._json({"error": {"type": "NoReadyReplicaError",
+                              "message": "no ready replica in the "
+                                         "fleet; retry shortly"}}, 503)
+
+    def _session_unknown(self, session_id: str) -> None:
+        # Definitive (route_session_ex's second element): every ready
+        # replica denied the session and no handoff stream exists — a
+        # retryable 503 here would have clients polling an ended
+        # session forever, each poll costing a full fleet sweep.
+        self._json({"error": {"type": "UnknownSessionError",
+                              "message": f"unknown session "
+                                         f"{session_id!r} on every "
+                                         "ready replica"}}, 404)
+
+    def _read_body(self) -> bytes | None:
+        length = int(self.headers.get("Content-Length", 0))
+        if length < 0 or length > MAX_SUBMIT_BYTES:
+            self.close_connection = True
+            self._json({"error": {"type": "StackFormatError",
+                                  "message": f"Content-Length {length} "
+                                             f"outside [0, "
+                                             f"{MAX_SUBMIT_BYTES}]"}},
+                       400)
+            return None
+        return self.rfile.read(length) if length else b""
+
+    def _fwd_headers(self) -> dict:
+        return {k: self.headers[k] for k in _FORWARD_HEADERS
+                if self.headers.get(k)}
+
+    # ------------------------------------------------------------------
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        body = self._read_body()
+        if body is None:
+            return
+        if url.path == "/submit":
+            self._submit(body)
+        elif parts and parts[0] == "session":
+            self._session_op(parts, body)
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def _submit(self, body: bytes) -> None:
+        r = self.router
+        r._requests("submit").inc()
+        candidates = r.place_submit(body)
+        if not candidates:
+            self._no_replica()
+            return
+        for i, replica in enumerate(candidates):
+            try:
+                status, hdrs, resp = r.forward(
+                    replica, "POST", "/submit", body=body,
+                    headers=self._fwd_headers())
+            except OSError:
+                r._failovers.inc()
+                continue
+            if status == 503 and i + 1 < len(candidates):
+                # Draining/unready replica the sweep hasn't flagged yet:
+                # fail over along the ring like a dead one. 429 is NOT
+                # failed over — backpressure is load, and shoving the
+                # burst onto the next replica just moves the hot spot.
+                r._failovers.inc()
+                continue
+            if status == 200:
+                try:
+                    job_id = json.loads(resp.decode()).get("job_id")
+                except (ValueError, UnicodeDecodeError):
+                    job_id = None
+                if job_id:
+                    r.pin_job(job_id, replica)
+            self._relay(status, hdrs, resp)
+            return
+        self._no_replica()
+
+    def _session_op(self, parts: list, body: bytes) -> None:
+        r = self.router
+        if len(parts) == 1:
+            # POST /session — create on the round-robin pick.
+            r._requests("session_create").inc()
+            replica = r.next_replica()
+            if replica is None:
+                self._no_replica()
+                return
+            try:
+                status, hdrs, resp = r.forward(
+                    replica, "POST", "/session", body=body,
+                    headers=self._fwd_headers())
+            except OSError:
+                self._no_replica()
+                return
+            if status == 200:
+                try:
+                    sid = json.loads(resp.decode()).get("session_id")
+                except (ValueError, UnicodeDecodeError):
+                    sid = None
+                if sid:
+                    r.pin_session(sid, replica)
+            self._relay(status, hdrs, resp)
+            return
+        sid = parts[1]
+        r._requests("session_op").inc()
+        replica, unknown = r.route_session_ex(sid)
+        if replica is None:
+            self._session_unknown(sid) if unknown else self._no_replica()
+            return
+        try:
+            status, hdrs, resp = r.forward(
+                replica, "POST", "/" + "/".join(parts), body=body,
+                headers=self._fwd_headers())
+        except OSError:
+            # The pin died mid-request: one handoff retry, then give up
+            # (the client's own retry policy owns anything beyond).
+            replica = r.adopt_on_survivor(sid)
+            if replica is None:
+                self._no_replica()
+                return
+            try:
+                status, hdrs, resp = r.forward(
+                    replica, "POST", "/" + "/".join(parts), body=body,
+                    headers=self._fwd_headers())
+            except OSError:
+                self._no_replica()
+                return
+        if len(parts) == 3 and parts[2] == "finalize" and status == 200:
+            try:
+                job_id = json.loads(resp.decode()).get("job_id")
+            except (ValueError, UnicodeDecodeError):
+                job_id = None
+            if job_id:
+                r.pin_job(job_id, replica)
+        self._relay(status, hdrs, resp)
+
+    # ------------------------------------------------------------------
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        r = self.router
+        if url.path == "/healthz":
+            self._json({"ok": True, "router": True, **r.stats()})
+        elif url.path == "/readyz":
+            ready = bool(r.ready_replicas())
+            self._json({"ready": ready,
+                        "reasons": ([] if ready
+                                    else ["no ready replicas"])},
+                       200 if ready else 503)
+        elif url.path == "/fleet":
+            self._json(r.stats())
+        elif url.path == "/metrics":
+            data = r.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif url.path in ("/status", "/result"):
+            self._job_query(url)
+        elif url.path.startswith("/session/"):
+            parts = [p for p in url.path.split("/") if p]
+            if len(parts) < 2:     # bare "/session/" — no id to route
+                self._json({"error": "not found"}, 404)
+                return
+            replica, unknown = r.route_session_ex(parts[1])
+            if replica is None:
+                self._session_unknown(parts[1]) if unknown \
+                    else self._no_replica()
+                return
+            self._proxy_get(replica, self.path)
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def _job_query(self, url) -> None:
+        r = self.router
+        job_id = (parse_qs(url.query).get("id") or [""])[0]
+        replica = r.job_url(job_id)
+        if replica is not None:
+            self._proxy_get(replica, self.path)
+            return
+        # Unknown placement (router restarted, or the job predates us):
+        # probe the fleet — first replica that knows the id wins the pin.
+        for candidate in r.ready_replicas():
+            try:
+                status, hdrs, body = r.forward(candidate, "GET",
+                                               self.path)
+            except OSError:
+                continue
+            if status != 404:
+                r.pin_job(job_id, candidate)
+                self._relay(status, hdrs, body)
+                return
+        self._json({"error": f"unknown job {job_id!r} on every ready "
+                             "replica"}, 404)
+
+    def _proxy_get(self, replica: str, path: str) -> None:
+        try:
+            status, hdrs, body = self.router.forward(replica, "GET", path)
+        except OSError:
+            self._json({"error": {"type": "ReplicaUnreachableError",
+                                  "message": f"replica {replica} did "
+                                             "not answer"}}, 503)
+            return
+        self._relay(status, hdrs, body)
+
+    def do_DELETE(self):
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if len(parts) == 2 and parts[0] == "session":
+            replica, unknown = self.router.route_session_ex(parts[1])
+            if replica is None:
+                self._session_unknown(parts[1]) if unknown \
+                    else self._no_replica()
+                return
+            try:
+                status, hdrs, body = self.router.forward(
+                    replica, "DELETE", self.path)
+            except OSError:
+                self._no_replica()
+                return
+            if status == 200:
+                self.router.unpin_session(parts[1])
+            self._relay(status, hdrs, body)
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def log_message(self, fmt, *args):
+        log.debug("router: " + fmt, *args)
+
+
+class RouterHTTPServer:
+    """Owns the router's listener thread (mirrors ServeHTTPServer)."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        handler = type("BoundRouterHandler", (_RouterHandler,),
+                       {"router": router})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="router-http", daemon=True)
+        self._started = False
+
+    def start(self) -> "RouterHTTPServer":
+        self.router.start()
+        self._thread.start()
+        self._started = True
+        log.info("fleet router on :%d (%d replica(s))", self.port,
+                 len(self.router.replicas))
+        return self
+
+    def stop(self) -> None:
+        self.router.stop()
+        if self._started:
+            self._httpd.shutdown()
+        self._httpd.server_close()

@@ -35,6 +35,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -48,6 +49,7 @@ from ..utils import events, telemetry, trace
 from ..utils.log import get_logger
 from .batcher import BucketBatcher, BucketKey
 from .cache import ContentCache, ProgramCache, ProgramKey, content_key
+from .fleet import PeerCacheClient
 from .governor import GovernorParams, OverloadGovernor
 from .jobs import (
     DONE,
@@ -59,7 +61,7 @@ from .jobs import (
     error_payload,
 )
 from .sessions import SessionManager, UnknownSessionError
-from .store import JournalStore
+from .store import JournalStore, SessionStreamStore
 from .worker import DeviceWorker
 
 log = get_logger(__name__)
@@ -122,6 +124,22 @@ class ServeConfig:
     # worker-exception rate, graduated load shedding (previews first,
     # then low-priority admissions), worker watchdog.
     governor: GovernorParams = GovernorParams()
+    # -- fleet tier (serve/fleet.py, serve/router.py; SERVING.md § fleet)
+    # Replica identity: stamped into journaled session heads and the
+    # /healthz//readyz payloads; None = a fresh random id per process.
+    replica_id: str | None = None
+    # Peer base URLs for the shared content cache: a local miss at
+    # admission consults peers' ``GET /cache/<key>`` (bounded timeouts,
+    # per-peer breakers, single-flight, negative TTL) before computing.
+    peers: tuple = ()
+    peer_timeout_s: float = 2.0     # per-peer request bound
+    peer_budget_s: float = 3.0      # whole peer-lookup bound
+    peer_negative_ttl_s: float = 5.0
+    # Shared session-handoff volume: the WAL streams session ops there
+    # (SessionStreamStore sink) so a survivor replica can adopt a dead
+    # replica's live sessions. Requires store_dir (the stream rides the
+    # WAL's group commit).
+    handoff_dir: str | None = None
 
 
 def synthetic_calib_provider(proj: ProjectorConfig):
@@ -191,9 +209,22 @@ class ReconstructionService:
         self.cache = ProgramCache(self.calib_provider,
                                   max_entries=config.max_cache_entries,
                                   registry=self.registry)
+        # Fleet identity: journaled session heads carry it, so a
+        # restarting replica can tell "still mine" from "a survivor
+        # adopted this while I was dead" (handoff-aware recovery).
+        self.replica_id = config.replica_id or f"r-{uuid.uuid4().hex[:8]}"
+        # Shared session-handoff volume (fleet tier): session ops stream
+        # there as the WAL sink, riding the group commit.
+        if config.handoff_dir is not None and config.store_dir is None:
+            raise ValueError(
+                "handoff_dir requires store_dir — the handoff stream is "
+                "a sink of the WAL's group commit")
+        self.handoff: SessionStreamStore | None = (
+            SessionStreamStore(config.handoff_dir)
+            if config.handoff_dir is not None else None)
         # Durability journal + persistent content cache share one volume.
         self.store: JournalStore | None = (
-            JournalStore(config.store_dir)
+            JournalStore(config.store_dir, sink=self.handoff)
             if config.store_dir is not None else None)
         self.content_cache: ContentCache | None = (
             ContentCache(max_bytes=config.content_cache_bytes,
@@ -201,6 +232,16 @@ class ReconstructionService:
                               if self.store is not None else None),
                          registry=self.registry)
             if config.content_cache else None)
+        # Peer half of the shared content cache (serve/fleet.py):
+        # consulted at admission after a local miss; every degraded mode
+        # converges on "compute locally", never a stall.
+        self.peer_cache: PeerCacheClient | None = (
+            PeerCacheClient(config.peers,
+                            timeout_s=config.peer_timeout_s,
+                            budget_s=config.peer_budget_s,
+                            negative_ttl_s=config.peer_negative_ttl_s,
+                            registry=self.registry)
+            if (config.peers and config.content_cache) else None)
         # Constructed here (its counter families must exist in the
         # registry from the first scrape) but installed into the compile-
         # event dispatch only for the start→drain window, so an abandoned
@@ -243,7 +284,8 @@ class ReconstructionService:
             max_sessions=config.max_sessions,
             session_ttl_s=config.session_ttl_s,
             store=self.store,
-            preview_shed=self.governor.shed_previews)
+            preview_shed=self.governor.shed_previews,
+            replica_id=self.replica_id)
 
     def _make_worker(self, name: str) -> DeviceWorker:
         return DeviceWorker(self.batcher, self.cache,
@@ -294,7 +336,8 @@ class ReconstructionService:
             if recover_from is True:
                 raise ValueError("recover_from=True needs a configured "
                                  "store_dir")
-            self.store = JournalStore(str(recover_from))
+            self.store = JournalStore(str(recover_from),
+                                      sink=self.handoff)
             self.sessions.store = self.store
             self.governor.store = self.store
             if self.content_cache is not None:
@@ -407,8 +450,29 @@ class ReconstructionService:
             try:
                 stack = self.store.load_stack(rj.stack_path)
             except (OSError, ValueError) as e:
+                # A purged/corrupt stack blob: the job cannot replay.
+                # Register it FAILED (the client polling its id gets an
+                # honest taxonomy answer, not a 404) — which also
+                # journals its terminal op, so the dead admission stops
+                # haunting every future recovery of this volume.
                 events.record("job_recover_failed", severity="error",
                               job_id=rj.job_id, message=str(e))
+                job = Job(stack=np.empty((0, 0, 0), np.uint8),
+                          col_bits=self.config.proj.col_bits,
+                          row_bits=self.config.proj.row_bits,
+                          result_format=rj.result_format,
+                          priority=rj.priority, job_id=rj.job_id)
+                job.journal_kind = "job"
+                job.recovered = True
+                job.on_terminal = self._on_terminal
+                self._jobs_total("submitted").inc()
+                with events.context(job_id=job.job_id):
+                    from ..health import CaptureError
+
+                    job.fail(CaptureError(
+                        f"recovered capture stack unreadable "
+                        f"({rj.stack_path}): {e}"))
+                self._register(job)
                 continue
             deadline = None
             if rj.deadline_s is not None:
@@ -446,6 +510,48 @@ class ReconstructionService:
                           result_format=rj.result_format)
             n_jobs += 1
         for rs in state.sessions:
+            if self.handoff is not None:
+                # Handoff-aware recovery: while this replica was dead
+                # the router may have re-pinned the session to a
+                # survivor (adopt_session stamps the stream's owner),
+                # or the session may have ENDED there (end tombstone).
+                # Either is POSITIVE evidence the session is no longer
+                # ours — journal the local tombstone so this WAL drains
+                # clean instead of resurrecting a second live copy. A
+                # MISSING stream is the opposite: the mirror never
+                # wrote (shared-volume failure, handoff enabled after
+                # the session started) and this WAL holds the ONLY
+                # copy — recover it; losing acked stops to a mirror
+                # hiccup would invert the durability contract.
+                stream = self.handoff.stream_state(rs.session_id)
+                owner = self.handoff.owner(rs.session_id)
+                if stream == "ended" or (stream == "live"
+                                         and owner != rs.replica):
+                    events.record(
+                        "session_skipped_handed_off", severity="warning",
+                        session_id=rs.session_id, journaled_by=rs.replica,
+                        stream_state=stream, stream_owner=owner)
+                    # scope=local: the sink must NOT mirror this end —
+                    # the stream now belongs to the adopter (or is the
+                    # tombstone we consume below).
+                    self.store.append(
+                        {"op": "session_end",
+                         "session_id": rs.session_id,
+                         "reason": "handed_off", "scope": "local"},
+                        sync=False)
+                    if stream == "ended":
+                        # Tombstone consumed: only THIS replica's WAL
+                        # referenced it; dropping it bounds tombstone
+                        # accumulation on long-lived volumes.
+                        self.handoff.drop_session(rs.session_id)
+                    continue
+                if stream == "missing":
+                    events.record(
+                        "session_recovered_without_stream",
+                        severity="warning", session_id=rs.session_id,
+                        message="no handoff stream (mirror never "
+                                "wrote); recovering from the local "
+                                "WAL only")
             try:
                 entry = self.sessions.restore(rs.session_id, rs.options,
                                               rs.scan_id)
@@ -453,6 +559,26 @@ class ReconstructionService:
                 events.record("session_recover_failed", severity="error",
                               session_id=rs.session_id, message=str(e))
                 continue
+            if self.handoff is not None and stream == "missing":
+                # Heal the stream from the local WAL (head + stop
+                # blobs) so the recovered session is adoptable again;
+                # a still-failing shared volume degrades handoff only.
+                try:
+                    self.handoff.mirror(
+                        {"op": "session", "session_id": rs.session_id,
+                         "scan_id": rs.scan_id, "options": rs.options,
+                         "replica": rs.replica}, self.store)
+                    for jid, path in rs.stops:
+                        self.handoff.mirror(
+                            {"op": "stop",
+                             "session_id": rs.session_id,
+                             "job_id": jid, "stack": path}, self.store)
+                except OSError as e:
+                    self.handoff.mirror_failures += 1
+                    events.record("handoff_mirror_failed",
+                                  severity="error",
+                                  session_id=rs.session_id,
+                                  message=str(e))
             replayed = 0
             for path in rs.stop_paths:
                 try:
@@ -547,9 +673,23 @@ class ReconstructionService:
                 # keep clients pinned to a dying process.
                 ckey = content_key(stack, self._content_sig(result_format))
                 cached = self.content_cache.get(ckey)
+                source = "local"
+                if cached is None and self.peer_cache is not None:
+                    # Shared fleet cache: a mesh computed on replica A
+                    # answers a duplicate submit here. Bounded lookup —
+                    # every degraded peer mode is a local miss. The
+                    # fetched artifact is re-cached locally so the NEXT
+                    # duplicate is a local hit.
+                    cached = self.peer_cache.lookup(ckey)
+                    source = "peer"
+                    if cached is not None:
+                        payload, meta, fmt = cached
+                        self.content_cache.put(ckey, payload,
+                                               dict(meta), fmt)
                 if cached is not None:
                     return self._complete_from_cache(
-                        ckey, result_format, int(priority), cached)
+                        ckey, result_format, int(priority), cached,
+                        source=source)
             self.governor.admit(int(priority))
             job = Job(stack=stack, col_bits=cfg.proj.col_bits,
                       row_bits=cfg.proj.row_bits,
@@ -580,9 +720,13 @@ class ReconstructionService:
         return job
 
     def _complete_from_cache(self, ckey: str, result_format: str,
-                             priority: int, cached) -> Job:
+                             priority: int, cached,
+                             source: str = "local") -> Job:
         """Land a content-cache hit as an already-terminal job in the
-        registry (same polling surface as a computed result)."""
+        registry (same polling surface as a computed result).
+        ``source`` says which half of the shared cache answered —
+        "local" (this replica's disk/memory) or "peer" (fetched over
+        the fleet's GET /cache/<key> protocol)."""
         payload, meta, fmt = cached
         job = Job(stack=np.empty((0, 0, 0), np.uint8),
                   col_bits=self.config.proj.col_bits,
@@ -592,10 +736,11 @@ class ReconstructionService:
         job.on_terminal = self._on_terminal
         self._jobs_total("submitted").inc()  # counter conservation
         job.mark_running()
-        job.complete(payload, **{**meta, "content_cache_hit": True})
+        job.complete(payload, **{**meta, "content_cache_hit": True,
+                                 "cache_source": source})
         self._register(job)
         events.record("content_cache_hit", job_id=job.job_id,
-                      key=ckey[:12])
+                      key=ckey[:12], source=source)
         return job
 
     def _journal_job(self, job: Job, stack: np.ndarray) -> None:
@@ -788,8 +933,106 @@ class ReconstructionService:
         if self.store is not None:
             self.store.append({"op": "session_end",
                                "session_id": session_id,
-                               "reason": "finalized"})
+                               "reason": "finalized",
+                               "replica": self.replica_id})
         return job
+
+    def adopt_session(self, session_id: str) -> dict:
+        """``POST /session/<id>/adopt`` (fleet tier): take over a live
+        session from the shared handoff stream — the router calls this
+        on a survivor after the session's pinned replica died.
+
+        Claims ownership on the stream FIRST (so the dead replica's
+        eventual ``--recover`` sees the session is no longer its),
+        re-journals the session into THIS replica's WAL (so the
+        adopter's own crash-recovery covers it), and replays the
+        journaled stops through the compiled B=1 lane — deterministic,
+        so the re-pinned session finalizes bitwise-identically to an
+        uninterrupted run. Idempotent: adopting a session already live
+        here is a no-op report."""
+        if self.handoff is None:
+            raise StackFormatError(
+                "this replica has no handoff volume configured "
+                "(--handoff-dir)")
+        if self._draining:
+            from .jobs import QueueClosedError
+
+            raise QueueClosedError()
+        try:
+            entry = self.sessions.get(session_id)
+        except UnknownSessionError:
+            entry = None
+        if entry is not None:
+            with entry.lock:
+                fused = entry.session.stops_fused
+            return {"session_id": session_id, "adopted": False,
+                    "stops_fused": fused, "replica": self.replica_id}
+        info = self.handoff.read_session(session_id)
+        if info is None:
+            raise UnknownSessionError(
+                f"session {session_id!r} has no handoff stream (never "
+                "created with a handoff volume, or already ended)")
+        t0 = time.monotonic()
+        # Ownership first — a sync, direct stream append: from this line
+        # on, the previous owner's recovery must skip the session.
+        self.handoff.append({"op": "session_owner",
+                             "session_id": session_id,
+                             "replica": self.replica_id,
+                             "t_wall": time.time()})
+        entry = self.sessions.restore(session_id, info.options,
+                                      info.scan_id)
+        if self.store is not None:
+            self.store.append({"op": "session", "session_id": session_id,
+                               "scan_id": info.scan_id,
+                               "options": info.options,
+                               "replica": self.replica_id})
+        replayed = degraded = 0
+        for job_id, blob in info.stops:
+            try:
+                stack = self.handoff.load_blob(blob)
+                self._replay_stop(entry, stack)
+            except Exception as e:
+                # One unreadable blob degrades the session (bitwise
+                # parity is gone) but must not kill the adoption.
+                events.record("session_recover_degraded",
+                              severity="error", session_id=session_id,
+                              message=str(e), exc_type=type(e).__name__)
+                degraded += 1
+                continue
+            if self.store is not None:
+                # Same job ids as the origin replica's stops: the sink
+                # re-mirrors them, and the stream reader dedups by id.
+                rel = self.store.put_stack(
+                    f"{session_id}-{job_id or uuid.uuid4().hex[:8]}",
+                    stack)
+                self.store.append({"op": "stop",
+                                   "session_id": session_id,
+                                   "job_id": job_id, "stack": rel})
+            replayed += 1
+        with entry.lock:
+            entry.stops_submitted = replayed
+            fused = entry.session.stops_fused
+        events.record("session_adopted", session_id=session_id,
+                      scan_id=info.scan_id, from_replica=info.replica,
+                      replica=self.replica_id, stops_replayed=replayed,
+                      stops_degraded=degraded,
+                      seconds=round(time.monotonic() - t0, 3))
+        log.info("adopted session %s from %s: %d stop(s) replayed "
+                 "(%d degraded) in %.2fs", session_id, info.replica,
+                 replayed, degraded, time.monotonic() - t0)
+        return {"session_id": session_id, "adopted": True,
+                "stops_fused": fused, "stops_degraded": degraded,
+                "replica": self.replica_id}
+
+    def cache_export(self, key: str) -> tuple[bytes, dict, str] | None:
+        """``GET /cache/<key>`` (the peer protocol's server half): this
+        replica's LOCAL content-cache entry, or None. Never consults
+        peers — a fleet of replicas proxying each other's lookups would
+        recurse. Uses the non-counting peek so peer probes don't inflate
+        this replica's admission hit/miss counters."""
+        if self.content_cache is None:
+            return None
+        return self.content_cache.peek(key)
 
     def check_admission(self, priority: int = 1) -> None:
         """Headers-time backpressure probe for the HTTP layer: raises the
@@ -898,6 +1141,7 @@ class ReconstructionService:
 
     def stats(self) -> dict:
         out = {
+            "replica_id": self.replica_id,
             "queue_depth": self.queue.depth(),
             "pending_batches": self.batcher.pending_depth(),
             "draining": self._draining,
@@ -912,6 +1156,10 @@ class ReconstructionService:
             out["content_cache"] = self.content_cache.stats()
         if self.store is not None:
             out["store"] = self.store.stats()
+        if self.peer_cache is not None:
+            out["peer_cache"] = self.peer_cache.stats()
+        if self.handoff is not None:
+            out["handoff"] = self.handoff.stats()
         return out
 
     def readiness(self) -> dict:
@@ -927,7 +1175,8 @@ class ReconstructionService:
             reasons.append("draining")
         if self._started and not any(w.alive for w in self.workers):
             reasons.append("no worker lanes alive")
-        return {"ready": self.ready, "reasons": reasons}
+        return {"ready": self.ready, "reasons": reasons,
+                "replica_id": self.replica_id}
 
     def metrics_text(self) -> str:
         self._queue_gauge.set(self.queue.depth())
@@ -1113,6 +1362,20 @@ class _ServeHandler(BaseHTTPRequestHandler):
             job = self.service.submit_session_stop(parts[1], stack)
             self._json({"job_id": job.job_id, "status": job.status,
                         "session_id": parts[1]})
+        elif len(parts) == 3 and parts[2] == "adopt":
+            # Fleet handoff (docs/SERVING.md § fleet): take over a live
+            # session from the shared stream. 404 when no stream exists,
+            # 409 when adoption cannot proceed (e.g. session registry
+            # full) — the router tries the next survivor.
+            try:
+                out = self.service.adopt_session(parts[1])
+            except (JobRejected, UnknownSessionError):
+                raise
+            except Exception as e:
+                self._json({"error": {"type": type(e).__name__,
+                                      "message": str(e)}}, 409)
+                return
+            self._json(out)
         elif len(parts) == 3 and parts[2] == "finalize":
             from .sessions import SessionResultEvicted
 
@@ -1180,6 +1443,28 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+        elif url.path.startswith("/cache/"):
+            # Peer protocol (serve/fleet.py): export one LOCAL content-
+            # cache artifact to a fleet peer. Served even while draining
+            # (a free answer for a peer costs nothing and 404s would
+            # look like misses).
+            key = url.path[len("/cache/"):]
+            out = None
+            if len(key) == 64 and all(c in "0123456789abcdef"
+                                      for c in key):
+                out = self.service.cache_export(key)
+            if out is None:
+                self._json({"error": "no such artifact"}, 404)
+            else:
+                payload, meta, fmt = out
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("X-Content-Format", fmt)
+                self.send_header("X-Content-Meta", json.dumps(meta))
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
         elif url.path == "/status":
             job_id = (parse_qs(url.query).get("id") or [""])[0]
             status = self.service.status(job_id)

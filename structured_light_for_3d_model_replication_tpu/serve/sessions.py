@@ -168,7 +168,7 @@ class SessionManager:
     def __init__(self, stream_params: StreamParams, proj,
                  decode_cfg, tri_cfg, max_sessions: int = 8,
                  session_ttl_s: float = 3600.0, store=None,
-                 preview_shed=None):
+                 preview_shed=None, replica_id: str | None = None):
         self.stream_params = stream_params
         self.proj = proj
         self.decode_cfg = decode_cfg
@@ -180,6 +180,10 @@ class SessionManager:
         # set. None = durability off.
         self.store = store
         self.preview_shed = preview_shed
+        # Fleet tier: journaled session heads carry the replica id, so
+        # handoff-aware recovery can compare the WAL's claim against the
+        # shared stream's current owner (serve/store.py).
+        self.replica_id = replica_id
         self._lock = threading.Lock()
         self._sessions: OrderedDict[str, ServeSession] = OrderedDict()
 
@@ -271,7 +275,8 @@ class SessionManager:
         if journal and self.store is not None:
             self.store.append({"op": "session", "session_id": sid,
                                "scan_id": session.scan_id,
-                               "options": options})
+                               "options": options,
+                               "replica": self.replica_id})
         return entry
 
     def restore(self, session_id: str, options: dict,
@@ -283,10 +288,14 @@ class SessionManager:
                            scan_id=scan_id, journal=False)
 
     def _journal_end(self, session_id: str, reason: str) -> None:
+        # The ending replica's id rides the op: the handoff sink
+        # ignores an end from a NON-owner (a stale double-hosted copy
+        # expiring after its session was adopted elsewhere).
         if self.store is not None:
             self.store.append({"op": "session_end",
                                "session_id": session_id,
-                               "reason": reason}, sync=False)
+                               "reason": reason,
+                               "replica": self.replica_id}, sync=False)
 
     def get(self, session_id: str) -> ServeSession:
         with self._lock:

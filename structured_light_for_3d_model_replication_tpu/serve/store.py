@@ -45,6 +45,7 @@ import json
 import os
 import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -79,12 +80,18 @@ class RecoveredJob:
 @dataclasses.dataclass
 class RecoveredSession:
     """One live (never-ended) streaming session + its accepted stops in
-    submission order."""
+    submission order. ``replica`` is the replica id that journaled the
+    session head (fleet tier: ownership comparisons against the handoff
+    stream decide whether a recovering replica still owns it)."""
 
     session_id: str
     scan_id: str
     options: dict
     stop_paths: list = dataclasses.field(default_factory=list)
+    replica: str | None = None
+    # (job_id, blob) pairs for handoff streams, where blob identity (not
+    # a journal-relative path) names the shared-volume copy.
+    stops: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -138,7 +145,8 @@ def _parse_journal(path: str) -> RecoveredState:
                 sid = op["session_id"]
                 sessions[sid] = RecoveredSession(
                     session_id=sid, scan_id=op.get("scan_id", sid),
-                    options=dict(op.get("options") or {}))
+                    options=dict(op.get("options") or {}),
+                    replica=op.get("replica"))
             elif kind == "stop":
                 if op["session_id"] in sessions:
                     stops.setdefault(op["session_id"], []).append(
@@ -153,12 +161,294 @@ def _parse_journal(path: str) -> RecoveredState:
                 ended.add(op["session_id"])
             # "note" and unknown ops carry no recoverable state.
     for sid, entries in stops.items():
-        sessions[sid].stop_paths = [p for jid, p in entries
-                                    if jid not in failed_stops]
+        live = [(jid, p) for jid, p in entries
+                if jid not in failed_stops]
+        sessions[sid].stop_paths = [p for _, p in live]
+        sessions[sid].stops = live
     live_jobs = [j for jid, j in jobs.items() if jid not in done]
     live_sessions = [s for sid, s in sessions.items() if sid not in ended]
     return RecoveredState(jobs=live_jobs, sessions=live_sessions,
                           ops=ops, corrupt_lines=corrupt)
+
+
+# ---------------------------------------------------------------------------
+# Session handoff streams (the fleet tier's shared volume)
+# ---------------------------------------------------------------------------
+
+#: WAL ops the sink mirrors to the shared volume.
+SESSION_STREAM_OPS = ("session", "stop", "stop_failed", "session_end",
+                      "session_owner")
+
+
+class SessionStreamStore:
+    """Per-session op streams on a shared volume — the
+    :class:`JournalStore` **sink abstraction** of the fleet tier
+    (docs/SERVING.md § fleet).
+
+    Layout::
+
+        <root>/<session_id>.jsonl    the session's op stream
+        <root>/blobs/                stack blobs (one per accepted stop)
+
+    The owning :class:`JournalStore` mirrors session-scoped WAL ops here
+    from its writer thread **inside the group commit** (before the
+    commit event fires), so an acked session stop is on the shared
+    volume by the time the client sees its HTTP 200 — the property that
+    lets the router re-pin a SIGKILLed replica's live sessions to a
+    survivor (`ReconstructionService.adopt_session`) with zero acked
+    stops lost.
+
+    Appends are lock-free single ``write`` calls in append mode, so the
+    writer thread and an adopting service can interleave safely;
+    :meth:`read_session` is tolerant by construction — duplicate heads
+    take the LAST (ownership moved), duplicate stops dedup by job id
+    keeping the FIRST (an adopter's replayed stops mirror again with the
+    same ids), ``stop_failed`` removes its stop, torn tails are skipped.
+    A mirrored ``session_end`` deletes the stream file and its blobs:
+    an empty stream directory after drain is the fleet-level
+    journal-clean signal.
+    """
+
+    BLOBS_DIR = "blobs"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, self.BLOBS_DIR), exist_ok=True)
+        self.mirror_failures = 0
+
+    # -- paths ----------------------------------------------------------
+
+    def _stream_path(self, session_id: str) -> str:
+        # Session ids are uuid hex (ours) but defend the path join
+        # anyway: a traversal-shaped id must not escape the volume.
+        safe = "".join(c for c in session_id if c.isalnum() or c in "-_")
+        return os.path.join(self.root, f"{safe}.jsonl")
+
+    def _blob_path(self, name: str) -> str:
+        return os.path.join(self.root, self.BLOBS_DIR, name)
+
+    # -- writing --------------------------------------------------------
+
+    def append(self, op: dict) -> None:
+        """Append one op line to its session's stream (atomic-enough
+        single write; readers tolerate interleaves)."""
+        line = json.dumps(op) + "\n"
+        with open(self._stream_path(op["session_id"]), "a",
+                  encoding="utf-8") as f:
+            f.write(line)
+            f.flush()
+
+    def put_blob(self, name: str, data: bytes) -> str:
+        """Store one stack blob by content bytes (tmp + atomic rename);
+        returns the blob name."""
+        path = self._blob_path(name)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return name
+
+    def mirror(self, op: dict, store: "JournalStore") -> None:
+        """Sink entry point, called by ``store``'s writer thread per
+        session-scoped WAL op. Blob copy FIRST (an op must never
+        reference a blob that is not there), then the op line. A failing
+        shared volume degrades handoff — loudly — never local serving;
+        the caller wraps this in the OSError containment."""
+        kind = op.get("op")
+        out = dict(op)
+        if kind == "stop" and op.get("stack"):
+            blob = f"{op['session_id']}-{op.get('job_id') or 'stop'}.npy"
+            existing = self._blob_path(blob)
+            if not os.path.exists(existing):
+                with open(os.path.join(store.root, op["stack"]),
+                          "rb") as f:
+                    self.put_blob(blob, f.read())
+            out["blob"] = blob
+            out.pop("stack", None)
+        if kind == "session_end":
+            if op.get("scope") == "local":
+                # A handed-off tombstone: the stream now belongs to the
+                # adopting replica — leave it alone.
+                return
+            ender = op.get("replica")
+            owner = self.owner(op["session_id"])
+            if ender is not None and owner is not None \
+                    and ender != owner:
+                # A NON-OWNER's end (e.g. the origin replica's idle-TTL
+                # expiry of its stale double-hosted copy after a
+                # handoff): the stream belongs to the adopter — nuking
+                # it would lose the adopter's acked stops at its next
+                # recovery.
+                log.info("ignoring session_end from non-owner %s for "
+                         "%s (owner %s)", ender, op["session_id"],
+                         owner)
+                return
+            self.end_session(op["session_id"],
+                             reason=op.get("reason", "ended"))
+            return
+        self.append(out)
+
+    def end_session(self, session_id: str,
+                    reason: str = "ended") -> None:
+        """The session ended fleet-wide (finalized/deleted/expired/
+        evicted): free its blobs and rewrite the stream to ONE
+        tombstone line. The tombstone is POSITIVE evidence of the end —
+        recovery must distinguish "ended somewhere" (tombstone) from
+        "the mirror never wrote" (missing stream), because the latter
+        means the local WAL is the only copy and must recover."""
+        info = self._read(session_id, include_failed=True)
+        if info is not None:
+            for _, blob in info.stops:
+                try:
+                    os.remove(self._blob_path(blob))
+                except OSError:
+                    log.debug("handoff blob %s already gone", blob)
+        path = self._stream_path(session_id)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"op": "session_end",
+                                "session_id": session_id,
+                                "reason": reason,
+                                "t_wall": time.time()}) + "\n")
+        os.replace(tmp, path)
+
+    def drop_session(self, session_id: str) -> None:
+        """Hard-remove a stream file (the origin replica calls this
+        after consuming an end tombstone at recovery, bounding
+        tombstone accumulation on long-lived volumes)."""
+        try:
+            os.remove(self._stream_path(session_id))
+        except OSError:
+            log.debug("handoff stream %s already gone", session_id)
+
+    # -- reading --------------------------------------------------------
+
+    def _scan(self, session_id: str, include_failed: bool = False
+              ) -> tuple[bool, "RecoveredSession | None"]:
+        """(ended, info) for one stream; info is None when the file is
+        missing, unreadable or headless. ``ended`` True = an end
+        tombstone is present (positive evidence the session finished
+        SOMEWHERE in the fleet)."""
+        path = self._stream_path(session_id)
+        if not os.path.exists(path):
+            return False, None
+        head = None
+        owner = None
+        ended = False
+        stops: "OrderedDict[str, str]" = OrderedDict()
+        anon: list[tuple[None, str]] = []
+        failed: set[str] = set()
+        try:
+            with open(path, "rb") as f:
+                lines = f.readlines()
+        except OSError as e:
+            log.warning("handoff stream %s unreadable: %s", session_id, e)
+            return False, None
+        for raw in lines:
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                op = json.loads(line)
+            except ValueError:
+                continue  # torn/interleaved line: skip, keep reading
+            kind = op.get("op")
+            if kind == "session":
+                head = op                      # last head wins
+                owner = op.get("replica", owner)
+            elif kind == "session_owner":
+                owner = op.get("replica", owner)
+            elif kind == "session_end":
+                ended = True
+            elif kind == "stop" and op.get("blob"):
+                jid = op.get("job_id")
+                if jid is None:
+                    anon.append((None, op["blob"]))
+                elif jid not in stops:         # dedup: first wins
+                    stops[jid] = op["blob"]
+            elif kind == "stop_failed" and op.get("job_id"):
+                failed.add(op["job_id"])
+        if head is None:
+            return ended, None
+        pairs = [(jid, blob) for jid, blob in stops.items()
+                 if include_failed or jid not in failed] + anon
+        return ended, RecoveredSession(
+            session_id=session_id,
+            scan_id=head.get("scan_id", session_id),
+            options=dict(head.get("options") or {}),
+            replica=owner, stops=pairs)
+
+    def _read(self, session_id: str,
+              include_failed: bool = False) -> RecoveredSession | None:
+        ended, info = self._scan(session_id, include_failed)
+        return None if ended else info
+
+    def stream_state(self, session_id: str) -> str:
+        """``"live"`` (adoptable stream), ``"ended"`` (tombstoned — the
+        session finished somewhere in the fleet), or ``"missing"`` (no
+        stream: never mirrored, or the mirror failed — the caller's
+        local WAL may be the ONLY copy)."""
+        ended, info = self._scan(session_id, include_failed=True)
+        if ended:
+            return "ended"
+        return "live" if info is not None else "missing"
+
+    def read_session(self, session_id: str) -> RecoveredSession | None:
+        """The session's replayable state: head options/scan id, current
+        owner, and (job_id, blob) stop pairs with service-side-failed
+        stops excluded — replay must skip exactly what the live session
+        never fused."""
+        return self._read(session_id, include_failed=False)
+
+    def owner(self, session_id: str) -> str | None:
+        """Current owner replica id, or None when the stream is
+        missing/ended or carries no replica stamps."""
+        info = self._read(session_id, include_failed=True)
+        return info.replica if info is not None else None
+
+    def has_session(self, session_id: str) -> bool:
+        """True while a LIVE (adoptable, un-ended) stream exists."""
+        return self.stream_state(session_id) == "live"
+
+    def load_blob(self, name: str) -> np.ndarray:
+        with open(self._blob_path(name), "rb") as f:
+            return np.load(io.BytesIO(f.read()), allow_pickle=False)
+
+    def list_sessions(self) -> list[str]:
+        """Session ids with LIVE streams (end tombstones excluded) —
+        the fleet-level "journal clean?" probe."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for n in sorted(names):
+            if not n.endswith(".jsonl"):
+                continue
+            sid = n[:-6]
+            if self.stream_state(sid) == "live":
+                out.append(sid)
+        return out
+
+    def stats(self) -> dict:
+        # Parse-free on purpose: this rides every /healthz scrape, and
+        # the shared volume may be remote (NFS). ``streams`` counts
+        # stream FILES — live sessions plus not-yet-consumed end
+        # tombstones; the exact live set is ``list_sessions()``, which
+        # parses every stream and belongs in probes, not health scrapes.
+        try:
+            streams = sum(1 for n in os.listdir(self.root)
+                          if n.endswith(".jsonl"))
+        except OSError:
+            streams = 0
+        try:
+            blobs = sum(1 for n in os.listdir(
+                os.path.join(self.root, self.BLOBS_DIR))
+                if ".tmp" not in n)   # temp suffix is .tmp-<pid>
+        except OSError:
+            blobs = 0
+        return {"root": self.root, "streams": streams, "blobs": blobs,
+                "mirror_failures": self.mirror_failures}
 
 
 # ---------------------------------------------------------------------------
@@ -167,12 +457,19 @@ def _parse_journal(path: str) -> RecoveredState:
 
 
 class JournalStore:
-    """Write-ahead journal + stack-blob store over one directory."""
+    """Write-ahead journal + stack-blob store over one directory.
+
+    ``sink`` (fleet tier): a :class:`SessionStreamStore` that receives
+    every session-scoped op from the writer thread as part of the group
+    commit — the journal *streams* session state to the shared volume so
+    a survivor replica can adopt a dead replica's live sessions."""
 
     def __init__(self, root: str, fsync_interval_s: float = 0.25,
                  compact_min_dead: int = 256,
-                 compact_on_open: bool = True):
+                 compact_on_open: bool = True,
+                 sink: "SessionStreamStore | None" = None):
         self.root = root
+        self.sink = sink
         self.fsync_interval_s = float(fsync_interval_s)
         self.compact_min_dead = int(compact_min_dead)
         os.makedirs(os.path.join(root, STACKS_DIR), exist_ok=True)
@@ -219,11 +516,17 @@ class JournalStore:
                 "deadline_s": j.deadline_s, "t_wall": j.submitted_wall,
                 "content_key": j.content_key}
         for s in state.sessions:
+            # Carry replica + stop job_ids through compaction: the
+            # rewritten journal must preserve the ownership stamp (the
+            # handoff-aware recovery compares it against the stream's
+            # owner) and the ids stop_failed ops match against.
             self._sessions[s.session_id] = {
                 "head": {"op": "session", "session_id": s.session_id,
-                         "scan_id": s.scan_id, "options": s.options},
+                         "scan_id": s.scan_id, "options": s.options,
+                         "replica": s.replica},
                 "stops": [{"op": "stop", "session_id": s.session_id,
-                           "stack": p} for p in s.stop_paths]}
+                           "job_id": jid, "stack": p}
+                          for jid, p in s.stops]}
 
     def _live_ops(self) -> list[dict]:
         out = list(self._jobs.values())
@@ -392,6 +695,24 @@ class JournalStore:
                     events.record("journal_write_failed",
                                   severity="error", message=str(e),
                                   ops=len(batch))
+                if self.sink is not None:
+                    # Handoff mirroring is part of the group commit: an
+                    # acked session op is on the shared volume before
+                    # the commit event fires. A failing shared volume
+                    # degrades HANDOFF (survivors adopt a shorter
+                    # stream), never local serving — loudly.
+                    for _, op in batch:
+                        if op.get("op") not in SESSION_STREAM_OPS:
+                            continue
+                        try:
+                            self.sink.mirror(op, self)
+                        except OSError as e:
+                            self.sink.mirror_failures += 1
+                            log.error("handoff mirror failed: %s", e)
+                            events.record(
+                                "handoff_mirror_failed",
+                                severity="error", message=str(e),
+                                session_id=op.get("session_id"))
                 with self._cond:  # mirror updates visible to stats()
                     for _, op in batch:
                         self._apply(op)

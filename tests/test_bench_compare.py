@@ -200,3 +200,50 @@ def test_soak_headline_lines_and_throughput_direction(tmp_path, capsys):
     (row,) = rows
     assert row["best"] == 8.0 and row["best_round"] == 1
     assert row["verdict"] == "improved"
+
+
+def test_fleet_headline_lines_and_direction(tmp_path, capsys):
+    """Bench config [10] adds ``fleet_scans_per_s`` (throughput —
+    HIGHER is better) and ``fleet_failover_s`` (latency — lower is
+    better). The trajectory tracks both next to the other headline
+    lines, and --strict judges each with its own direction."""
+    assert bench_compare.higher_is_better("fleet_scans_per_s")
+    assert not bench_compare.higher_is_better("fleet_failover_s")
+    tail = "\n".join([
+        _headline("full_360_scan_to_mesh_s", 5.9),
+        _headline("soak_scans_per_s", 8.0),
+        _headline("fleet_scans_per_s", 20.0),
+        _headline("fleet_failover_s", 12.0),
+        "[10] fleet: 500 jobs in 25s (20.00/s), failover 12.00s",
+    ])
+    _round(tmp_path, 1, tail)
+    traj = bench_compare.load_history([str(tmp_path / "BENCH_r01.json")])
+    assert traj["fleet_scans_per_s"] == [(1, 20.0)]
+    assert traj["fleet_failover_s"] == [(1, 12.0)]
+
+    # Throughput UP + failover DOWN: both improvements, strict passes.
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text("\n".join([
+        _headline("fleet_scans_per_s", 25.0),
+        _headline("fleet_failover_s", 8.0),
+    ]) + "\n", encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["fleet_scans_per_s"] == "improved"
+    assert by_metric["fleet_failover_s"] == "improved"
+
+    # Throughput DOWN + failover UP beyond threshold: both regress,
+    # each judged by its OWN direction.
+    fresh.write_text("\n".join([
+        _headline("fleet_scans_per_s", 15.0),
+        _headline("fleet_failover_s", 20.0),
+    ]) + "\n", encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["fleet_scans_per_s"] == "REGRESSION"
+    assert by_metric["fleet_failover_s"] == "REGRESSION"
+    assert doc["regressions"] == 2

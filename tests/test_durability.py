@@ -431,6 +431,14 @@ def test_watchdog_journals_and_restarts_wedged_worker(serve_stack):
         assert svc.workers[0] is not original, "watchdog never fired"
         assert original.abandoned
         assert any(e.kind == "worker_wedged" for e in events.tail(100))
+        # The watchdog swaps the lane BEFORE it records
+        # worker_restarted, and the wait loop above breaks on the swap
+        # — poll briefly so a descheduled watchdog thread can land the
+        # event (single-core box under load).
+        ev_deadline = time.monotonic() + 5.0
+        while time.monotonic() < ev_deadline and not any(
+                e.kind == "worker_restarted" for e in events.tail(100)):
+            time.sleep(0.05)
         assert any(e.kind == "worker_restarted"
                    for e in events.tail(100))
         assert svc.registry.counter(
@@ -562,6 +570,125 @@ def test_preview_shedding_skips_preview_not_fusion(serve_ring):
     sess.suppress_previews = False       # load receded: previews resume
     r2 = sess.add_stop(serve_ring[1])
     assert r2.preview and sess.preview is not None
+
+
+# ---------------------------------------------------------------------------
+# Recovery edge cases (ISSUE 9 satellite): purged blobs, double recovery
+# ---------------------------------------------------------------------------
+
+
+def test_recover_with_purged_stack_blob_degrades_gracefully(
+        tmp_path, serve_stack, serve_ring):
+    """A journal whose ops reference blobs that no longer exist (manual
+    cleanup, a partial volume restore) must degrade per-item — the
+    unreadable job fails its recovery with a journaled flight event, the
+    degraded session loses only that stop — and the service must still
+    come up ready and serve."""
+    store_dir = str(tmp_path / "vol")
+    svc = ReconstructionService(_config(store_dir, warmup=False)).start()
+    # Strand one queued job + a 2-stop live session, then crash.
+    for w in svc.workers:
+        w.abort()
+        w.join(5.0)
+    queued = svc.submit_array(serve_stack)
+    sid = svc.create_session({})["session_id"]
+    svc.submit_session_stop(sid, serve_ring[0])
+    svc.submit_session_stop(sid, serve_ring[1])
+    time.sleep(0.3)          # session stops reach the WAL (group commit)
+    svc.abort()
+
+    state = read_live_state(store_dir)
+    assert len(state.jobs) == 1
+    assert len(state.sessions) == 1 and \
+        len(state.sessions[0].stop_paths) == 2
+    # Purge the queued job's blob and the session's FIRST stop blob.
+    os.remove(os.path.join(store_dir, state.jobs[0].stack_path))
+    os.remove(os.path.join(store_dir,
+                           state.sessions[0].stop_paths[0]))
+
+    svc2 = ReconstructionService(_config(store_dir)).start(
+        recover_from=True)
+    try:
+        assert svc2.ready
+        # The job whose stack is gone: registered FAILED under its
+        # original id with a taxonomy answer (not a silent 404), and
+        # the flight journal says why.
+        j2 = svc2.get_job(queued.job_id)
+        assert j2 is not None and j2.status == "failed"
+        assert "CaptureError" in j2.error["taxonomy"]
+        failed = [e for e in events.tail(100, kind="job_recover_failed")
+                  if e.fields.get("job_id") == queued.job_id]
+        assert failed, "purged-blob job recovery not journaled"
+        # The session: degraded to the one readable stop, event carries
+        # the session id, and it still accepts stops + finalizes.
+        degraded = [e for e in events.tail(100,
+                                           kind="session_recover_degraded")
+                    if e.fields.get("session_id") == sid]
+        assert degraded
+        assert svc2.sessions.get(sid).session.stops_fused == 1
+        assert svc2.submit_session_stop(sid, serve_ring[2]).wait(120.0)
+        fin = svc2.finalize_session(sid, "ply")
+        assert fin.status == "done" and fin.result_bytes.startswith(
+            b"ply")
+        assert svc2.drain(timeout=30.0)
+    finally:
+        if any(w.alive for w in svc2.workers):
+            svc2.abort()
+    assert read_live_state(store_dir).empty
+
+
+def test_double_recovery_crash_before_first_checkpoint(
+        tmp_path, serve_stack, serve_ring):
+    """Recover, crash again before ANY recovered work reached a
+    terminal op, recover again: the journal still holds the original
+    admissions (recovery never rewrites them), both recoveries journal
+    their flight events, and the second recovery completes the job
+    under its ORIGINAL id and the session with full fidelity."""
+    store_dir = str(tmp_path / "vol")
+    svc = ReconstructionService(_config(store_dir, warmup=False)).start()
+    for w in svc.workers:
+        w.abort()
+        w.join(5.0)
+    queued = svc.submit_array(serve_stack)
+    sid = svc.create_session({})["session_id"]
+    svc.submit_session_stop(sid, serve_ring[0])
+    time.sleep(0.3)
+    svc.abort()
+
+    # Recovery #1 with wedged workers: the replayed session and the
+    # re-queued job never reach a checkpoint (no terminal op lands),
+    # then the process "dies" again.
+    svc2 = ReconstructionService(_config(store_dir))
+    for w in svc2.workers:
+        w._process = lambda batch: time.sleep(120.0)
+    svc2.start(recover_from=True)
+    j2 = svc2.get_job(queued.job_id)
+    assert j2 is not None and j2.status == "queued"
+    assert svc2.sessions.get(sid).session.stops_fused == 1
+    svc2.abort()
+
+    # Recovery #2: everything is STILL there — original ids, original
+    # stops — and now completes.
+    svc3 = ReconstructionService(_config(store_dir)).start(
+        recover_from=True)
+    try:
+        recovered = [e for e in events.tail(200, kind="service_recovered")]
+        assert len(recovered) >= 2, "both recoveries must journal"
+        j3 = svc3.get_job(queued.job_id)
+        assert j3 is not None and j3.recovered
+        assert j3.wait(120.0) and j3.status == "done", j3.status_dict()
+        assert svc3.sessions.get(sid).session.stops_fused == 1
+        assert svc3.submit_session_stop(sid, serve_ring[1]).wait(120.0)
+        assert svc3.sessions.get(sid).session.stops_fused == 2
+        # End the session (a LIVE session must stay journaled across
+        # drains by design — that is the whole point) so the volume can
+        # prove journal-clean below.
+        svc3.sessions.delete(sid)
+        assert svc3.drain(timeout=30.0)
+    finally:
+        if any(w.alive for w in svc3.workers):
+            svc3.abort()
+    assert read_live_state(store_dir).empty
 
 
 # ---------------------------------------------------------------------------

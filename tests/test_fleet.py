@@ -1,0 +1,1001 @@
+"""Fleet tier (serve/fleet.py + serve/router.py + session handoff).
+
+The subsystem's acceptance bars (ISSUE 9 / docs/SERVING.md § fleet):
+
+* **shared content cache** — a local miss consults peers'
+  ``GET /cache/<key>`` with single-flight dedup, bounded timeouts,
+  per-peer circuit breakers + jittered backoff and a negative-result
+  TTL; every degraded peer mode (slow, dead, draining) converges on a
+  LOCAL MISS, never a stall or an error in admission.
+* **front router** — consistent-hash admission by content key (same
+  bytes → same replica → local duplicate hit), replica-sticky session
+  routing, health-driven failover via the existing ``/readyz``.
+* **session handoff** — the WAL streams session ops to the shared
+  handoff volume (`SessionStreamStore` sink); when a replica dies the
+  router re-pins its live sessions to a survivor which ADOPTS them
+  (replaying journaled stops through the compiled B=1 lane) and the
+  session finalizes bitwise-identically to an uninterrupted run.
+* **fleet chaos gate** (slow) — 3 real subprocess replicas under
+  offered load with injected peer-network faults: SIGKILL of one
+  replica mid-session loses zero acked jobs/sessions, duplicate hits
+  survive across replicas, survivors show zero steady-state program
+  compiles, and every journal drains clean.
+
+Subprocess spawn recipes are shared with scripts/fleet_smoke.py (which
+itself builds on scripts/soak_smoke.py) — one rig, one flag set, no
+drift between the gates.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import pathlib
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import (
+    ProjectorConfig,
+)
+from structured_light_for_3d_model_replication_tpu.models import (
+    merge as merge_mod,
+)
+from structured_light_for_3d_model_replication_tpu.models import synthetic
+from structured_light_for_3d_model_replication_tpu.serve import (
+    CircuitBreaker,
+    FaultyPeerTransport,
+    FleetRouter,
+    HashRing,
+    JournalStore,
+    PeerCacheClient,
+    PeerFaultPlan,
+    ReconstructionService,
+    RouterHTTPServer,
+    ServeClient,
+    ServeConfig,
+    ServeHTTPServer,
+    SessionStreamStore,
+    read_live_state,
+)
+from structured_light_for_3d_model_replication_tpu.serve.client import (
+    TransportError,
+)
+from structured_light_for_3d_model_replication_tpu.stream import (
+    StreamParams,
+)
+from structured_light_for_3d_model_replication_tpu.utils import events, trace
+
+_FLEET_SPEC = importlib.util.spec_from_file_location(
+    "fleet_smoke",
+    pathlib.Path(__file__).resolve().parents[1] / "scripts"
+    / "fleet_smoke.py")
+fleet_smoke = importlib.util.module_from_spec(_FLEET_SPEC)
+_FLEET_SPEC.loader.exec_module(fleet_smoke)
+
+PROJ = ProjectorConfig(width=fleet_smoke.PROJ_W,
+                       height=fleet_smoke.PROJ_H)
+H, W = fleet_smoke.CAM_H, fleet_smoke.CAM_W
+
+
+def _stream_params() -> StreamParams:
+    doc = dict(fleet_smoke.STREAM_PARAMS)
+    merge = merge_mod.MergeParams(**doc.pop("merge"))
+    return dataclasses.replace(StreamParams(), merge=merge, **doc)
+
+
+def _config(store_dir=None, **kw) -> ServeConfig:
+    kw.setdefault("stream", _stream_params())
+    kw.setdefault("warmup", False)
+    return ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1, 2),
+                       linger_ms=5.0, queue_depth=16, workers=1,
+                       store_dir=store_dir, **kw)
+
+
+@pytest.fixture(scope="module")
+def serve_stack():
+    cam = synthetic.default_calibration(H, W, PROJ)
+    stack, _ = synthetic.render_scan(synthetic.Scene(), *cam, H, W, PROJ)
+    return stack
+
+
+@pytest.fixture(scope="module")
+def serve_ring():
+    cam = synthetic.default_calibration(H, W, PROJ)
+    scene = synthetic.Scene(
+        wall_z=None,
+        spheres=(synthetic.Sphere((0.0, 2.0, 500.0), 80.0, 0.9),
+                 synthetic.Sphere((55.0, -30.0, 460.0), 35.0, 0.7),
+                 synthetic.Sphere((-60.0, 35.0, 530.0), 30.0, 0.8)))
+    scans = synthetic.render_turntable_scans(
+        scene, n_stops=4, degrees_per_stop=12.0,
+        cam_K=cam[0], proj_K=cam[1], R=cam[2], T=cam[3],
+        cam_height=H, cam_width=W, proj=PROJ)
+    return [s for s, _ in scans]
+
+
+# ---------------------------------------------------------------------------
+# Units: breaker, ring, transport faults (no jax, no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_trip_halfopen_close():
+    b = CircuitBreaker(window=8, min_samples=4, failure_rate=0.5,
+                       cooldown_s=0.1)
+    assert b.open_remaining() is None
+    for _ in range(2):
+        assert b.note_ok() is False
+    tripped = False
+    for _ in range(4):
+        t, rate, n = b.note_failure()
+        tripped = tripped or t
+    assert tripped and b.open_remaining() is not None
+    assert b.open_rate >= 0.5
+    time.sleep(0.15)                     # cooldown lapses: half-open
+    assert b.open_remaining() is None
+    assert b.note_ok() is True           # probe success closes it
+    # Window cleared on close: old failures can't re-trip instantly.
+    t, _, n = b.note_failure()
+    assert not t and n == 1
+
+
+def test_hash_ring_stable_and_minimal_remap():
+    ring = HashRing(["a", "b", "c"], vnodes=64)
+    keys = [f"key-{i}" for i in range(200)]
+    owners = {k: ring.node_for(k) for k in keys}
+    # Deterministic: a fresh ring with the same nodes agrees everywhere.
+    ring2 = HashRing(["c", "a", "b"], vnodes=64)
+    assert all(ring2.node_for(k) == owners[k] for k in keys)
+    # Removing one node remaps ONLY its keys (survivors keep theirs).
+    ring.remove("b")
+    for k in keys:
+        new = ring.node_for(k)
+        if owners[k] != "b":
+            assert new == owners[k]
+        else:
+            assert new in ("a", "c")
+    # preference() lists distinct nodes, owner first.
+    pref = ring2.preference("key-0")
+    assert pref[0] == owners["key-0"] and sorted(pref) == ["a", "b", "c"]
+    assert ring2.preference("key-0", avoid={pref[0]})[0] == pref[1]
+
+
+def test_peer_fault_plan_env_and_deterministic_faults(monkeypatch):
+    monkeypatch.setenv("SL_PEER_FAULTS",
+                       '{"seed": 7, "drop_rate": 0.5, "latency_s": 0.2, '
+                       '"latency_rate": 0.5, "bogus": 1}')
+    plan = PeerFaultPlan.from_env()
+    assert plan == PeerFaultPlan(seed=7, drop_rate=0.5, latency_s=0.2,
+                                 latency_rate=0.5)
+
+    class _Inner:
+        calls = 0
+
+        def request(self, method, url, body=None, headers=None,
+                    timeout_s=5.0):
+            _Inner.calls += 1
+            return 200, {}, b"ok"
+
+    slept = []
+    t = FaultyPeerTransport(plan, inner=_Inner(), sleep=slept.append)
+    outcomes = []
+    for _ in range(64):
+        try:
+            t.get("http://x/cache/k", timeout_s=1.0)
+            outcomes.append("ok")
+        except OSError:
+            outcomes.append("drop")
+    assert t.drops > 10 and t.delays > 5      # both fault kinds fired
+    assert slept and all(s == 0.2 for s in slept)
+    # Same seed → same schedule.
+    t2 = FaultyPeerTransport(plan, inner=_Inner(), sleep=lambda s: None)
+    outcomes2 = []
+    for _ in range(64):
+        try:
+            t2.get("http://x/cache/k", timeout_s=1.0)
+            outcomes2.append("ok")
+        except OSError:
+            outcomes2.append("drop")
+    assert outcomes == outcomes2
+    monkeypatch.setenv("SL_PEER_FAULTS", "not json")
+    assert PeerFaultPlan.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# PeerCacheClient against fake transports
+# ---------------------------------------------------------------------------
+
+
+class _FakeTransport:
+    """Scriptable peer endpoint: {url_prefix: {key: (payload, meta,
+    fmt)}}; unknown keys 404. Counts every request per URL."""
+
+    def __init__(self, peers: dict, delay_s: float = 0.0,
+                 fail: set | None = None):
+        self.peers = peers
+        self.delay_s = delay_s
+        self.fail = fail or set()
+        self.calls: list[str] = []
+        self.lock = threading.Lock()
+
+    def get(self, url, timeout_s=5.0):
+        with self.lock:
+            self.calls.append(url)
+        base, _, key = url.rpartition("/cache/")
+        if base in self.fail:
+            raise urllib.error.URLError(ConnectionRefusedError("down"))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        entry = self.peers.get(base, {}).get(key)
+        if entry is None:
+            return 404, {}, b"{}"
+        payload, meta, fmt = entry
+        return 200, {"X-Content-Meta": json.dumps(meta),
+                     "X-Content-Format": fmt}, payload
+
+
+def test_peer_cache_hit_miss_and_negative_ttl():
+    reg = trace.MetricsRegistry()
+    t = _FakeTransport({"http://a": {"k1": (b"mesh", {"points": 3},
+                                            "ply")}})
+    pc = PeerCacheClient(["http://a", "http://b"], transport=t,
+                         negative_ttl_s=0.2, registry=reg)
+    payload, meta, fmt = pc.lookup("k1")
+    assert payload == b"mesh" and meta["points"] == 3 and fmt == "ply"
+    assert pc.stats()["hits"] == 1
+    # Fleet-wide miss: counted once, then negative-TTL'd (no new
+    # requests until the TTL lapses).
+    assert pc.lookup("k2") is None
+    n = len(t.calls)
+    assert pc.lookup("k2") is None
+    assert len(t.calls) == n                 # served from negative cache
+    time.sleep(0.25)
+    assert pc.lookup("k2") is None           # TTL lapsed: re-probed
+    assert len(t.calls) > n
+
+
+def test_peer_cache_single_flight_dedup():
+    reg = trace.MetricsRegistry()
+    t = _FakeTransport({"http://a": {"k": (b"x", {}, "ply")}},
+                       delay_s=0.15)
+    pc = PeerCacheClient(["http://a"], transport=t, budget_s=2.0,
+                         registry=reg)
+    results = []
+    threads = [threading.Thread(target=lambda: results.append(
+        pc.lookup("k"))) for _ in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert all(r is not None and r[0] == b"x" for r in results)
+    assert len(t.calls) == 1                 # ONE fetch for 6 racers
+
+
+def test_peer_breaker_and_backoff_skip_dead_peer():
+    reg = trace.MetricsRegistry()
+    t = _FakeTransport({"http://b": {}}, fail={"http://a"})
+    pc = PeerCacheClient(["http://a", "http://b"], transport=t,
+                         negative_ttl_s=0.0, breaker_min_samples=2,
+                         breaker_failure_rate=0.5,
+                         breaker_cooldown_s=30.0, backoff_base_s=0.0,
+                         registry=reg)
+    for i in range(6):
+        assert pc.lookup(f"k{i}") is None
+    st = pc.stats()
+    # The breaker opens after min_samples failures; from then on the
+    # dead peer is SKIPPED instead of re-probed on every admission.
+    a_calls = sum(1 for u in t.calls if u.startswith("http://a/"))
+    assert a_calls == 2
+    assert st["skips"] == 4 and st["breaker_trips"] == 1
+    assert st["fetch_failures"] == a_calls
+    # Backoff alone (no breaker) also suppresses re-probes.
+    t2 = _FakeTransport({}, fail={"http://a"})
+    pc2 = PeerCacheClient(["http://a"], transport=t2,
+                          negative_ttl_s=0.0, breaker_min_samples=99,
+                          backoff_base_s=60.0, registry=trace.
+                          MetricsRegistry())
+    for i in range(4):
+        assert pc2.lookup(f"k{i}") is None
+    assert len(t2.calls) == 1                 # backing off after one
+
+
+def test_peer_lookup_budget_never_stalls():
+    reg = trace.MetricsRegistry()
+    t = _FakeTransport({"http://a": {}, "http://b": {}, "http://c": {}},
+                       delay_s=0.2)
+    pc = PeerCacheClient(["http://a", "http://b", "http://c"],
+                         transport=t, timeout_s=1.0, budget_s=0.3,
+                         registry=reg)
+    t0 = time.monotonic()
+    assert pc.lookup("k") is None
+    # Bounded by the budget (0.3 s) + at most one in-flight request's
+    # tail, NOT 3 peers x 0.2 s each — a slow fleet degrades to a local
+    # miss without serializing every peer.
+    assert time.monotonic() - t0 < 0.75
+
+
+# ---------------------------------------------------------------------------
+# Handoff stream store (no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_session_stream_mirror_dedup_and_cleanup(tmp_path):
+    vol = str(tmp_path / "wal")
+    shared = str(tmp_path / "handoff")
+    sink = SessionStreamStore(shared)
+    s = JournalStore(vol, sink=sink)
+    s.append({"op": "session", "session_id": "s1", "scan_id": "scan-1",
+              "options": {"preview_every": 2}, "replica": "rA"})
+    rel = s.put_stack("s1-j1", np.ones((2, 3, 4), np.uint8))
+    s.append({"op": "stop", "session_id": "s1", "job_id": "j1",
+              "stack": rel})
+    rel2 = s.put_stack("s1-j2", np.full((2, 3, 4), 7, np.uint8))
+    s.append({"op": "stop", "session_id": "s1", "job_id": "j2",
+              "stack": rel2})
+    s.append({"op": "stop_failed", "session_id": "s1", "job_id": "j2"})
+    # Duplicate stop line (an adopter's re-journal): deduped on read.
+    s.append({"op": "stop", "session_id": "s1", "job_id": "j1",
+              "stack": rel})
+
+    info = sink.read_session("s1")
+    assert info is not None and info.scan_id == "scan-1"
+    assert info.options == {"preview_every": 2}
+    assert sink.owner("s1") == "rA"
+    # j2 failed service-side → excluded; j1 deduped to one entry.
+    assert [jid for jid, _ in info.stops] == ["j1"]
+    assert np.array_equal(sink.load_blob(info.stops[0][1]),
+                          np.ones((2, 3, 4), np.uint8))
+    # Ownership claim via direct append (the adoption path).
+    sink.append({"op": "session_owner", "session_id": "s1",
+                 "replica": "rB"})
+    assert sink.owner("s1") == "rB"
+    # A local-scope end must NOT touch the stream...
+    s.append({"op": "session_end", "session_id": "s1",
+              "reason": "handed_off", "scope": "local"})
+    # ...nor may a NON-owner's end (a stale double-hosted copy
+    # expiring by idle TTL after its session was adopted by rB) —
+    # nuking the adopter's stream would lose its acked stops.
+    s.append({"op": "session_end", "session_id": "s1",
+              "reason": "idle_ttl", "replica": "rA"})
+    time.sleep(0.2)
+    assert sink.has_session("s1")
+    # ...the OWNER's end tombstones the stream and frees its blobs.
+    s.append({"op": "session_end", "session_id": "s1",
+              "reason": "finalized", "replica": "rB"})
+    s.close()
+    assert not sink.has_session("s1")
+    assert sink.list_sessions() == []
+    assert sink.stats()["blobs"] == 0
+
+
+def test_compaction_preserves_replica_and_stop_ids(tmp_path):
+    """Journal compaction must carry the session head's ownership stamp
+    and the stops' job ids through the rewrite: dropping them would
+    make the NEXT recovery misread a still-owned session as handed off
+    (owner vs None) and break late stop_failed matching."""
+    vol = str(tmp_path / "wal")
+    s = JournalStore(vol)
+    s.append({"op": "session", "session_id": "s1", "scan_id": "x",
+              "options": {}, "replica": "rA"})
+    for jid in ("j1", "j2"):
+        rel = s.put_stack(f"s1-{jid}", np.ones((1, 2, 2), np.uint8))
+        s.append({"op": "stop", "session_id": "s1", "job_id": jid,
+                  "stack": rel})
+    s.close()
+    s2 = JournalStore(vol, compact_min_dead=1)
+    s2.append({"op": "note", "kind": "force-compact"})  # dead op
+    deadline = time.monotonic() + 5.0
+    while s2.stats()["compactions"] < 1:
+        assert time.monotonic() < deadline, "compaction never ran"
+        time.sleep(0.02)
+    # Post-compaction, a late stop_failed must still match its stop.
+    s2.append({"op": "stop_failed", "session_id": "s1", "job_id": "j2"})
+    s2.close()
+    st = read_live_state(vol)
+    (sess,) = st.sessions
+    assert sess.replica == "rA"
+    assert [jid for jid, _ in sess.stops] == ["j1"]
+
+
+def test_session_stream_tolerates_torn_and_headless(tmp_path):
+    sink = SessionStreamStore(str(tmp_path))
+    sink.append({"op": "stop", "session_id": "sX", "job_id": "j",
+                 "blob": "nope.npy"})
+    assert sink.read_session("sX") is None    # headless: unknown
+    sink.append({"op": "session", "session_id": "sX", "scan_id": "x",
+                 "options": {}, "replica": "rA"})
+    with open(tmp_path / "sX.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"op": "stop", "session_id": "sX", "blo')   # torn tail
+    info = sink.read_session("sX")
+    assert info is not None and info.replica == "rA"
+    assert [jid for jid, _ in info.stops] == ["j"]
+
+
+# ---------------------------------------------------------------------------
+# Client failover rotation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_client_transport_failover_rotation(serve_stack):
+    svc = ReconstructionService(_config()).start()
+    http = ServeHTTPServer(svc, port=0).start()
+    try:
+        # Dead replica first in the list: the submit's first attempt
+        # raises TransportError (connection refused), rotates, and the
+        # RETRY lands on the live replica.
+        client = ServeClient(["http://127.0.0.1:1",
+                              f"http://127.0.0.1:{http.port}"],
+                             timeout_s=60.0, retries=2,
+                             retry_backoff_s=0.01)
+        jid = client.submit(serve_stack)
+        st = client.wait(jid, timeout_s=120.0)
+        assert st["status"] == "done"
+        assert client.base_url.endswith(str(http.port))
+        # retries=0 restores the raw surface: the dead URL surfaces as
+        # a typed TransportError (retryable taxonomy), not a raw
+        # URLError.
+        raw = ServeClient(["http://127.0.0.1:1"], retries=0,
+                          timeout_s=5.0)
+        with pytest.raises(TransportError):
+            raw.submit(serve_stack)
+        # wait() on a multi-URL client rotates past a replica that
+        # does not KNOW the job (its 404 is a wrong-replica answer
+        # after rotation, not a terminal fact). Replica B here is a
+        # second service that never saw the submit.
+        b = ReconstructionService(_config())        # registry only;
+        hb = ServeHTTPServer(b, port=0).start()     # never started
+        try:
+            poller = ServeClient([f"http://127.0.0.1:{http.port}",
+                                  f"http://127.0.0.1:{hb.port}"],
+                                 timeout_s=60.0)
+            jid2 = poller.submit(serve_stack + np.uint8(5))
+            poller._rotate()                        # now pointing at B
+            st2 = poller.wait(jid2, timeout_s=120.0, poll_s=0.05)
+            assert st2["status"] == "done"
+        finally:
+            hb.stop()
+    finally:
+        http.stop()
+        svc.drain(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# Cross-replica shared cache over real HTTP
+# ---------------------------------------------------------------------------
+
+
+def test_cross_replica_peer_cache_hit_http(serve_stack):
+    a = ReconstructionService(_config()).start()
+    ha = ServeHTTPServer(a, port=0).start()
+    b = None
+    try:
+        done = a.submit_array(serve_stack)
+        assert done.wait(120.0) and done.status == "done"
+        b = ReconstructionService(_config(
+            peers=(f"http://127.0.0.1:{ha.port}",))).start()
+        dup = b.submit_array(serve_stack)
+        # Answered AT admission from the peer: no queue, no compute.
+        assert dup.status == "done"
+        assert dup.result_meta["content_cache_hit"] is True
+        assert dup.result_meta["cache_source"] == "peer"
+        assert dup.result_bytes == done.result_bytes
+        assert b.peer_cache.stats()["hits"] == 1
+        # Re-cached locally: the next duplicate is a LOCAL hit.
+        dup2 = b.submit_array(serve_stack)
+        assert dup2.result_meta["cache_source"] == "local"
+        # Peer probes ride peek(): A's admission counters untouched.
+        assert a.content_cache.stats()["hits"] == 0
+        # A novel stack misses fleet-wide and still computes locally.
+        novel = b.submit_array(serve_stack + np.uint8(3))
+        assert novel.wait(120.0) and novel.status == "done"
+        assert not novel.result_meta.get("content_cache_hit")
+    finally:
+        ha.stop()
+        a.drain(timeout=10.0)
+        if b is not None:
+            b.drain(timeout=10.0)
+
+
+def test_corrupt_cache_blob_quarantined_not_raised(tmp_path,
+                                                   serve_stack):
+    """Satellite bar: a bit-flipped disk payload must count as a miss
+    and be quarantined — never raise into admission (local or peer)."""
+    store = str(tmp_path / "vol")
+    svc = ReconstructionService(_config(store)).start()
+    try:
+        done = svc.submit_array(serve_stack)
+        assert done.wait(120.0) and done.status == "done"
+        key = done.content_key
+        blob = pathlib.Path(store) / "content" / f"{key}.bin"
+        deadline = time.monotonic() + 10.0
+        while not blob.exists():   # on_terminal's put runs after wait()
+            assert time.monotonic() < deadline, "artifact never cached"
+            time.sleep(0.02)
+        raw = bytearray(blob.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF               # flip one byte
+        blob.write_bytes(bytes(raw))
+        # Hit path: integrity check fails → quarantine → treated as a
+        # miss → the resubmit COMPUTES (and repopulates the cache).
+        dup = svc.submit_array(serve_stack)
+        assert dup.wait(120.0) and dup.status == "done"
+        assert not dup.result_meta.get("content_cache_hit")
+        st = svc.content_cache.stats()
+        assert st["corrupt_quarantined"] == 1
+        q = pathlib.Path(store) / "content" / "quarantine"
+        assert (q / f"{key}.bin").exists()
+        # Recomputed artifact is cached again and hits clean.
+        deadline = time.monotonic() + 10.0
+        while not blob.exists():   # recompute's put also trails wait()
+            assert time.monotonic() < deadline, "artifact not re-cached"
+            time.sleep(0.02)
+        dup2 = svc.submit_array(serve_stack)
+        assert dup2.result_meta.get("content_cache_hit") is True
+    finally:
+        svc.drain(timeout=10.0)
+
+
+def test_corrupt_cache_blob_quarantined_at_load(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.serve import (
+        ContentCache,
+    )
+
+    d = str(tmp_path / "content")
+    c = ContentCache(max_bytes=1 << 20, dir=d,
+                     registry=trace.MetricsRegistry())
+    c.put("a" * 64, b"payload-1", {}, "ply")
+    # Truncate on disk behind the cache's back.
+    p = pathlib.Path(d) / f"{'a' * 64}.bin"
+    p.write_bytes(b"pay")
+    c2 = ContentCache(max_bytes=1 << 20, dir=d,
+                      registry=trace.MetricsRegistry())
+    assert c2.get("a" * 64) is None              # miss, no raise
+    assert c2.stats()["corrupt_quarantined"] == 1
+    assert c2.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Session adoption (handoff) in process
+# ---------------------------------------------------------------------------
+
+
+def test_adopt_session_from_handoff_stream(tmp_path, serve_ring):
+    shared = str(tmp_path / "handoff")
+    a = ReconstructionService(_config(
+        str(tmp_path / "va"), handoff_dir=shared,
+        replica_id="rA")).start()
+    sid = a.create_session({})["session_id"]
+    for s in serve_ring[:2]:
+        assert a.submit_session_stop(sid, s).wait(120.0)
+    a.abort()                                    # kill -9, no drain
+
+    b = ReconstructionService(_config(
+        str(tmp_path / "vb"), handoff_dir=shared,
+        replica_id="rB")).start()
+    try:
+        out = b.adopt_session(sid)
+        assert out["adopted"] is True and out["stops_fused"] == 2
+        assert any(e.kind == "session_adopted"
+                   for e in events.tail(50))
+        # Idempotent: adopting again is a no-op report.
+        again = b.adopt_session(sid)
+        assert again["adopted"] is False and again["stops_fused"] == 2
+        # The stream's owner moved to rB.
+        assert SessionStreamStore(shared).owner(sid) == "rB"
+        # The adopted session keeps accepting stops and finalizes.
+        assert b.submit_session_stop(sid, serve_ring[2]).wait(120.0)
+        fin = b.finalize_session(sid, "ply")
+        assert fin.result_bytes.startswith(b"ply")
+    finally:
+        assert b.drain(timeout=30.0)
+    # The adopter's OWN journal drains clean (it re-journaled the
+    # session, then ended it at finalize... which also removed the
+    # shared stream).
+    assert read_live_state(str(tmp_path / "vb")).empty
+    assert SessionStreamStore(shared).list_sessions() == []
+
+    # The ORIGINAL replica restarting with --recover must skip the
+    # handed-off session (tombstone, flight event) and drain clean —
+    # NOT resurrect a second live copy.
+    a2 = ReconstructionService(_config(
+        str(tmp_path / "va"), handoff_dir=shared,
+        replica_id="rA")).start(recover_from=True)
+    with pytest.raises(Exception):
+        a2.sessions.get(sid)
+    assert any(e.kind == "session_skipped_handed_off"
+               for e in events.tail(50))
+    assert a2.drain(timeout=30.0)
+    assert read_live_state(str(tmp_path / "va")).empty
+
+
+def test_recover_session_when_handoff_stream_missing(tmp_path,
+                                                     serve_ring):
+    """A MISSING handoff stream (the mirror never wrote — shared-volume
+    failure, or handoff enabled after the session started) means the
+    local WAL holds the ONLY copy: recovery must rebuild the session,
+    not tombstone acked stops away — and it re-mirrors the stream so
+    the session is adoptable again."""
+    shared = str(tmp_path / "handoff")
+    va = str(tmp_path / "va")
+    a = ReconstructionService(_config(
+        va, handoff_dir=shared, replica_id="rA")).start()
+    sid = a.create_session({})["session_id"]
+    assert a.submit_session_stop(sid, serve_ring[0]).wait(120.0)
+    a.abort()
+    # Simulate the mirror having never landed.
+    SessionStreamStore(shared).drop_session(sid)
+
+    a2 = ReconstructionService(_config(
+        va, handoff_dir=shared, replica_id="rA")).start(
+            recover_from=True)
+    try:
+        assert a2.sessions.get(sid).session.stops_fused == 1
+        assert any(e.kind == "session_recovered_without_stream"
+                   for e in events.tail(50))
+        # Healed: the stream exists again with the head AND the stop.
+        sink = SessionStreamStore(shared)
+        assert sink.has_session(sid)
+        info = sink.read_session(sid)
+        assert info.replica == "rA" and len(info.stops) == 1
+        a2.sessions.delete(sid)
+        assert a2.drain(timeout=30.0)
+    finally:
+        if any(w.alive for w in a2.workers):
+            a2.abort()
+    assert read_live_state(va).empty
+    assert SessionStreamStore(shared).list_sessions() == []
+
+
+# ---------------------------------------------------------------------------
+# Router (in process, real HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_router_hash_admission_sticky_sessions_and_handoff(
+        tmp_path, serve_stack, serve_ring):
+    shared = str(tmp_path / "handoff")
+    members = []
+    for i in range(2):
+        svc = ReconstructionService(_config(
+            str(tmp_path / f"v{i}"), handoff_dir=shared,
+            replica_id=f"r{i}")).start()
+        http = ServeHTTPServer(svc, port=0).start()
+        members.append((svc, http))
+    urls = [f"http://127.0.0.1:{h.port}" for _, h in members]
+    router = FleetRouter(urls, check_interval_s=0.2)
+    rh = RouterHTTPServer(router, port=0).start()
+    client = ServeClient(f"http://127.0.0.1:{rh.port}", timeout_s=120.0)
+    try:
+        # Consistent-hash admission: the duplicate lands on the SAME
+        # replica and hits its local cache.
+        st1 = client.wait(client.submit(serve_stack), timeout_s=120.0)
+        assert st1["status"] == "done"
+        st2 = client.wait(client.submit(serve_stack), timeout_s=60.0)
+        assert st2["result"]["content_cache_hit"] is True
+        assert st2["result"]["cache_source"] == "local"
+        # /status and /result follow the job's placement via the router.
+        assert client.result(st2["job_id"]).startswith(b"ply")
+
+        # Sticky session: stop 1 pins; SIGKILL-equivalent of the pinned
+        # replica; stop 2 through the router triggers adoption on the
+        # survivor and succeeds.
+        sid = client.create_session()
+        stj = client.wait(client.submit_stop(sid, serve_ring[0]),
+                          timeout_s=120.0)
+        assert stj["status"] == "done"
+        pin = router.session_url(sid)
+        assert pin in urls
+        # A FRESH router (restart: pins are memory) must re-learn the
+        # live session by PROBING, not steal it via adoption.
+        router2 = FleetRouter(urls, check_interval_s=0.2).start()
+        try:
+            assert router2.route_session(sid) == pin
+            assert router2.stats()["session_repins"] == 0
+        finally:
+            router2.stop()
+        victim = members[urls.index(pin)]
+        victim[0].abort()
+        victim[1].stop()
+        stj2 = client.wait(client.submit_stop(sid, serve_ring[1]),
+                           timeout_s=180.0)
+        assert stj2["status"] == "done"
+        assert router.session_url(sid) != pin
+        assert router.stats()["session_repins"] == 1
+        sst = client.session_status(sid)
+        assert sst["stops_fused"] == 2
+        # The router stays ready on the survivor; /readyz says so.
+        assert client.readyz()["ready"] is True
+        assert len(router.ready_replicas()) == 1
+
+        # DEFINITIVE unknowns answer 404, not a retry-forever 503: a
+        # bogus id (every ready replica denies it, no handoff stream),
+        # a bare /session/ path (no id at all), and an ENDED session
+        # after the router dropped its pin — the exact case where a
+        # 503 would have a poller sweeping the whole fleet forever.
+        base = f"http://127.0.0.1:{rh.port}"
+
+        def _get_status(path):
+            try:
+                with urllib.request.urlopen(base + path,
+                                            timeout=30.0) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert _get_status("/session/deadbeef0000") == 404
+        assert _get_status("/session/") == 404
+        client.delete_session(sid)          # ends it; router unpins
+        assert _get_status(f"/session/{sid}") == 404
+    finally:
+        rh.stop()
+        for svc, http in members:
+            if any(w.alive for w in svc.workers):
+                svc.drain(timeout=10.0)
+                http.stop()
+
+
+# ---------------------------------------------------------------------------
+# The fleet chaos gate (slow; sanitize CI job + ISSUE 9 acceptance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_chaos_gate(tmp_path, serve_stack, serve_ring):
+    """3 real subprocess replicas under offered load; SIGKILL one
+    mid-session with peer-network faults injected; assert: no acked job
+    or session lost (re-pinned session finalizes BITWISE-identically to
+    an uninterrupted run; acked jobs complete under their original ids
+    after fresh-node recovery), cross-replica duplicates hit the shared
+    cache, faults degrade to local behavior without stalling admission,
+    survivors show zero steady-state program-cache misses, and every
+    journal + the handoff volume drain clean."""
+    import signal as _signal
+
+    def _metric(text: str, name: str) -> float:
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(name):
+                try:
+                    total += float(line.rsplit(" ", 1)[1])
+                except (ValueError, IndexError):
+                    continue
+        return total
+
+    # Uninterrupted single-replica reference for bitwise parity (same
+    # spawn recipe — fleet of one, no peers, no faults).
+    ref_shared = str(tmp_path / "ref")
+    (ref_member,), _ = fleet_smoke.spawn_fleet(ref_shared, n=1,
+                                               sanitize=False)
+    ref_proc, ref_port, _ = ref_member
+    try:
+        rc = ServeClient(f"http://127.0.0.1:{ref_port}", timeout_s=120.0)
+        ref_sid = rc.create_session()
+        for s in serve_ring:
+            st = rc.wait(rc.submit_stop(ref_sid, s), timeout_s=300.0)
+            assert st["status"] == "done", st
+        fin = rc.finalize_session(ref_sid, result_format="ply")
+        ref_bytes = rc.result(fin["job_id"])
+    finally:
+        ref_proc.send_signal(_signal.SIGTERM)
+        ref_proc.wait(timeout=120.0)
+
+    # The fleet: 3 subprocess replicas with peer-network faults armed
+    # (drops + latency on every GET /cache hop), one in-process router.
+    shared = str(tmp_path / "fleet")
+    faults = json.dumps({"seed": 11, "drop_rate": 0.2,
+                         "latency_s": 0.05, "latency_rate": 0.3})
+    members, ports = fleet_smoke.spawn_fleet(
+        shared, n=3, sanitize=False,
+        env_extra={"SL_PEER_FAULTS": faults})
+    procs = {i: m[0] for i, m in enumerate(members)}
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    router = FleetRouter(urls, check_interval_s=0.25)
+    rh = RouterHTTPServer(router, port=0).start()
+    client = ServeClient(f"http://127.0.0.1:{rh.port}", timeout_s=120.0,
+                         retries=6, retry_backoff_s=0.2,
+                         retry_budget_s=120.0)
+
+    counters = {"done": 0, "hits": 0, "failed": 0}
+    errors: list[str] = []
+    pending: list[str] = []    # acked ids parked until recovery (below)
+    stop_load = threading.Event()
+
+    def load_loop():
+        from structured_light_for_3d_model_replication_tpu.serve. \
+            client import ServeClientError
+
+        i = 0
+        while not stop_load.is_set():
+            dup = i % 3 == 0
+            stack_v = (serve_stack if dup
+                       else serve_stack + np.uint8(10 + (i % 40)))
+            try:
+                jid = client.submit(stack_v)
+            except Exception as e:  # surfaced to the main thread
+                errors.append(f"submit: {type(e).__name__}: {e}")
+                return
+            try:
+                st = client.wait(jid, timeout_s=20.0)
+            except ServeClientError as e:
+                if dup and "unknown job" in str(e):
+                    # Admission-time cache hit acked by the killed
+                    # replica: terminal AT the ack, never journaled
+                    # — its id died with the in-memory registry
+                    # (the PR-8 contract; the ack carried
+                    # status=done). Counts as the hit it was.
+                    counters["done"] += 1
+                    counters["hits"] += 1
+                else:
+                    # An in-flight job pinned to the killed replica
+                    # answers 404/503 until the fresh node recovers it
+                    # — an ACKED job, so PARK it and keep offering
+                    # load (blocking here would serialize the whole
+                    # window behind one recovery); the post-load drain
+                    # below polls every parked id to completion, where
+                    # losing one is the exact bug this gate catches.
+                    pending.append(jid)
+                i += 1
+                continue
+            if st["status"] == "done":
+                counters["done"] += 1
+                if st["result"].get("content_cache_hit"):
+                    counters["hits"] += 1
+            else:
+                counters["failed"] += 1
+                errors.append(f"job failed: {st}")
+                return
+            i += 1
+
+    try:
+        # Warm the session lane + pin a session through the router.
+        sid = client.create_session()
+        for s in serve_ring[:2]:
+            st = client.wait(client.submit_stop(sid, s),
+                             timeout_s=300.0)
+            assert st["status"] == "done", st
+        pin = router.session_url(sid)
+        victim_idx = ports.index(int(pin.rsplit(":", 1)[1]))
+        survivor_idxs = [i for i in range(3) if i != victim_idx]
+
+        # Steady-state baseline on the survivors AFTER the warmup +
+        # session traffic: program-cache misses must not grow from here.
+        survivors = {i: ServeClient(urls[i], timeout_s=60.0)
+                     for i in survivor_idxs}
+        misses0 = {i: _metric(c.metrics(),
+                              "serve_program_cache_misses_total")
+                   for i, c in survivors.items()}
+
+        loader = threading.Thread(target=load_loop, daemon=True)
+        loader.start()
+        time.sleep(3.0)
+
+        # Duplicate-hit ratio across replicas UNDER peer faults: push
+        # the same stack at every replica directly, twice. The first
+        # may compute (a dropped peer hop degrades to a local miss by
+        # design), but the SECOND must hit — the shared cache keeps
+        # duplicates answered whether the artifact arrived by peer
+        # fetch or local recompute. Admission stays bounded throughout.
+        peer_sourced = 0
+        for u in urls:
+            c = ServeClient(u, timeout_s=120.0)
+            for attempt in range(2):
+                t0 = time.monotonic()
+                std = c.wait(c.submit(serve_stack), timeout_s=120.0)
+                assert std["status"] == "done", std
+                assert time.monotonic() - t0 < 120.0
+                if std["result"].get("cache_source") == "peer":
+                    peer_sourced += 1
+            assert std["result"].get("content_cache_hit") is True, \
+                f"duplicate at {u} recomputed twice: {std}"
+
+        # Acked burst straight at the victim, then SIGKILL it.
+        victim_client = ServeClient(urls[victim_idx], timeout_s=60.0)
+        burst = [victim_client.submit(serve_stack + np.uint8(100 + i))
+                 for i in range(4)]
+        procs[victim_idx].kill()
+        procs[victim_idx].wait(timeout=30.0)
+        t_kill = time.monotonic()
+
+        # The session survives: next stop re-pins onto a survivor.
+        stj = client.wait(client.submit_stop(sid, serve_ring[2]),
+                          timeout_s=300.0)
+        assert stj["status"] == "done", stj
+        failover_s = time.monotonic() - t_kill
+        assert router.session_url(sid) != pin
+        assert client.session_status(sid)["stops_fused"] == 3
+
+        # With the victim DEAD its peer slot fails on every survivor:
+        # duplicates still answer bounded (dead peer → breaker/backoff
+        # → local behavior, never a stall in admission).
+        for i in survivor_idxs:
+            c = survivors[i]
+            t0 = time.monotonic()
+            std = c.wait(c.submit(serve_stack + np.uint8(77)),
+                         timeout_s=120.0)
+            assert std["status"] == "done", std
+            assert time.monotonic() - t0 < 120.0
+
+        # Fresh-node recovery: a replacement process on the SAME port
+        # over the dead replica's journal — acked burst jobs complete
+        # under their ORIGINAL ids, reachable through the router.
+        repl_proc, _, _ = fleet_smoke.spawn_replica(
+            shared, victim_idx, ports, recover=True, sanitize=False,
+            env_extra={"SL_PEER_FAULTS": faults})
+        procs[victim_idx] = repl_proc
+        deadline = time.monotonic() + 60.0
+        while urls[victim_idx] not in router.ready_replicas():
+            assert time.monotonic() < deadline, \
+                "router never saw the replacement replica ready"
+            time.sleep(0.1)
+        recovered = gone = 0
+        for jid in burst:
+            try:
+                st = client.wait(jid, timeout_s=300.0)
+            except Exception:
+                gone += 1      # finished pre-kill; registry died with
+                continue       # the process (the PR-8 contract)
+            assert st["status"] == "done", st
+            recovered += 1
+        assert recovered + gone == len(burst)
+        assert recovered >= 1, "no acked job survived the kill window"
+
+        stop_load.set()
+        loader.join(timeout=300.0)
+        assert not errors, errors[:3]
+        # Every parked acked job completes now that the replacement
+        # node is up — zero acked jobs lost, under their original ids.
+        from structured_light_for_3d_model_replication_tpu.serve. \
+            client import ServeClientError
+        drain_deadline = time.monotonic() + 420.0
+        for jid in pending:
+            while True:
+                try:
+                    st = client.wait(jid, timeout_s=30.0)
+                    break
+                except ServeClientError as e:
+                    assert time.monotonic() < drain_deadline, \
+                        f"parked acked job {jid} lost: {e}"
+                    time.sleep(1.0)
+            assert st["status"] == "done", st
+            counters["done"] += 1
+            if st["result"].get("content_cache_hit"):
+                counters["hits"] += 1
+        assert counters["done"] >= 6
+        assert counters["hits"] >= 1
+
+        # Re-pinned session finalizes BITWISE-identically to the
+        # uninterrupted reference.
+        st = client.wait(client.submit_stop(sid, serve_ring[3]),
+                         timeout_s=300.0)
+        assert st["status"] == "done", st
+        fin = client.finalize_session(sid, result_format="ply")
+        assert client.result(fin["job_id"]) == ref_bytes
+
+        # Zero steady-state program-cache growth on the survivors.
+        for i, c in survivors.items():
+            assert _metric(c.metrics(),
+                           "serve_program_cache_misses_total") \
+                == misses0[i], f"replica r{i} compiled mid-steady-state"
+
+        # Journal-clean drain fleet-wide + empty handoff volume.
+        for i, proc in procs.items():
+            proc.send_signal(_signal.SIGTERM)
+        for i, proc in procs.items():
+            assert proc.wait(timeout=180.0) == 0, f"replica r{i} drain"
+        for i in range(3):
+            state = read_live_state(fleet_smoke.replica_store(shared, i))
+            assert not state.jobs and not state.sessions, \
+                f"replica r{i} journal dirty"
+        assert SessionStreamStore(
+            fleet_smoke.handoff_dir(shared)).list_sessions() == []
+        print(f"fleet chaos: failover {failover_s:.2f}s, "
+              f"{counters['done']} loaded jobs ({counters['hits']} dup "
+              f"hits), {recovered}/{len(burst)} burst jobs recovered")
+    finally:
+        stop_load.set()
+        rh.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()

@@ -75,6 +75,13 @@ The remaining BASELINE configs are measured too and written to
     adoption) and a same-port ``--recover`` replacement proves acked
     jobs survive — emits the ``fleet_scans_per_s`` and
     ``fleet_failover_s`` headline lines.
+11. TSDF streaming previews (`fusion/`): the config-8 24-stop session
+    with ``representation="tsdf"`` — per-stop incremental volume
+    integration + colored extraction instead of the coarse-Poisson
+    re-solve — emits the ``tsdf_preview_s`` headline line (median
+    per-stop preview seconds; vs_baseline = Poisson preview median /
+    TSDF median, > 1 means TSDF is faster), with stops 5-24 asserted
+    compile-free.
 
 ``SL_BENCH_ONLY=name1,name2`` (config names as recorded in
 BENCH_DETAILS) restricts a run to just those configs — the nightly
@@ -663,6 +670,96 @@ def main():
 
     if "stacks_np" in state and "full_s" in state:
         guarded("stream_incremental_360", config8)
+
+    # ------------------------------------------------------------------
+    # Config 11: TSDF streaming previews vs the coarse-Poisson previewer.
+    # Same 24-stop session as config 8, representation="tsdf": each stop
+    # INTEGRATES into the fused brick volume (fusion/, one donated
+    # scatter) and the preview is a direct colored extraction — no
+    # per-stop re-solve. Headline `tsdf_preview_s` = median per-stop
+    # preview seconds counted REPRESENTATION-FAIRLY: integrate_s (the
+    # stop's volume fuse, timed in the session with a blocking host
+    # pull) + preview_s (extraction), vs the Poisson previewer whose
+    # preview_s already contains its whole per-stop re-solve
+    # (vs_baseline > 1 means TSDF previews are faster). Steady state
+    # (stops 5-24) asserted compile-free, including extraction (fixed
+    # compaction floors).
+    # ------------------------------------------------------------------
+    def config11():
+        from structured_light_for_3d_model_replication_tpu.stream import (
+            IncrementalSession,
+            StreamParams,
+        )
+        from structured_light_for_3d_model_replication_tpu.utils import (
+            sanitize,
+        )
+
+        stacks_np = state["stacks_np"]
+        base = state["params"]
+
+        def run_session(tag, rep, shift):
+            sp = StreamParams(
+                merge=base.merge, method="sequential",
+                view_cap=base.view_cap, model_cap=131_072,
+                preview_points=16_384, preview_depth=6,
+                final_depth=10, expected_stops=24,
+                representation=rep, tsdf_grid_depth=8,
+                tsdf_max_bricks=16_384)
+            sess = IncrementalSession(
+                calib, proj.col_bits, proj.row_bits, params=sp,
+                key=jax.random.PRNGKey(11), scan_id=f"bench11-{tag}")
+            previews = []
+
+            def stop_cost(meta):
+                # integrate (0.0 for poisson) + extraction/solve.
+                return meta["preview_s"] + meta.get("integrate_s", 0.0)
+
+            for k in range(4):
+                r = sess.add_stop(stacks_np[k] + np.uint8(shift))
+                if r.preview:
+                    previews.append(stop_cost(sess.preview_meta))
+            with sanitize.no_compile_region(f"bench11-{tag}-steady"):
+                for k in range(4, 24):
+                    r = sess.add_stop(stacks_np[k] + np.uint8(shift))
+                    if r.preview:
+                        previews.append(stop_cost(sess.preview_meta))
+            return sess, previews
+
+        _log("[11] warming both previewer lanes (untimed pass)...")
+        run_session("warm-tsdf", "tsdf", 0)
+        run_session("warm-poisson", "poisson", 0)
+        sess_t, prev_t = run_session("tsdf", "tsdf", 2)
+        sess_p, prev_p = run_session("poisson", "poisson", 2)
+        assert sess_t.stops_fused == 24, sess_t.status_dict()
+        assert len(prev_t) >= 20 and len(prev_p) >= 20
+        tsdf_s = statistics.median(prev_t)
+        poisson_s = statistics.median(prev_p)
+        colored = sess_t.preview.vertex_colors is not None
+        print(json.dumps({
+            "metric": "tsdf_preview_s",
+            "value": round(tsdf_s, 4), "unit": "s",
+            "vs_baseline": round(poisson_s / tsdf_s, 2) if tsdf_s
+            else None,
+        }), flush=True)
+        details["tsdf_stream_preview"] = {
+            "value_s": round(tsdf_s, 4),
+            "per_stop_includes_integrate_s": True,
+            "tsdf_preview_median_s": round(tsdf_s, 4),
+            "poisson_preview_median_s": round(poisson_s, 4),
+            "tsdf_preview_s_per_stop": [round(t, 4) for t in prev_t],
+            "poisson_preview_s_per_stop": [round(t, 4) for t in prev_p],
+            "preview_faces": int(sess_t.preview_meta["faces"]),
+            "preview_colored": bool(colored),
+            "volume_stats": sess_t._mesher.stats(),
+            "steady_state_compiles": 0,  # asserted by no_compile_region
+        }
+        _log(f"[11] TSDF preview median {tsdf_s * 1e3:.0f} ms/stop vs "
+             f"Poisson {poisson_s * 1e3:.0f} ms/stop "
+             f"({poisson_s / max(tsdf_s, 1e-9):.1f}x), colored={colored}")
+        flush_details()
+
+    if "stacks_np" in state and "params" in state:
+        guarded("tsdf_stream_preview", config11)
     state.pop("stacks_np", None)  # free host memory before configs 3-5
     state.pop("params", None)
 

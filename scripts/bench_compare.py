@@ -38,6 +38,7 @@ import sys
 _DETAILS_ALIASES = {
     "full_360_scan_to_mesh": "full_360_scan_to_mesh_s",
     "full_360_24x46_1080p": "full_360_scan_24x46_1080p_s",
+    "tsdf_stream_preview": "tsdf_preview_s",
 }
 
 
@@ -46,7 +47,8 @@ def higher_is_better(metric: str) -> bool:
     (config [9]'s ``soak_scans_per_s``, config [10]'s
     ``fleet_scans_per_s``) invert — going UP is the improvement, going
     down the regression. Latency-shaped fleet lines
-    (``fleet_failover_s``) keep the lower-wins default."""
+    (``fleet_failover_s``) and config [11]'s per-stop preview latency
+    (``tsdf_preview_s``) keep the lower-wins default."""
     return metric.endswith("_per_s")
 
 

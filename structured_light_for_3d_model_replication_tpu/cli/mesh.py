@@ -15,10 +15,15 @@ import sys
 
 
 def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(prog="mesh",
-                                description="Mesh a .ply into an .stl")
+    p = argparse.ArgumentParser(
+        prog="mesh",
+        description="Mesh a .ply cloud into an .stl (or a vertex-"
+                    "colored mesh .ply)")
     p.add_argument("--input", "-i", required=True, help="input .ply")
-    p.add_argument("--output", "-o", required=True, help="output .stl")
+    p.add_argument("--output", "-o", required=True,
+                   help="output mesh: .stl, or .ply for a vertex-"
+                        "colored PLY mesh (colors need "
+                        "--representation tsdf and a colored cloud)")
     p.add_argument("--mode", choices=("watertight", "surface"),
                    default="watertight")
     p.add_argument("--depth", type=int, default=8,
@@ -48,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default="auto",
                    help="iso-surface extractor: device marching on TPU "
                         "backends (auto), or force either engine")
+    p.add_argument("--representation", choices=("poisson", "tsdf"),
+                   default="poisson",
+                   help="scene representation (docs/MESHING.md): "
+                        "'poisson' watertight print path, 'tsdf' the "
+                        "fused brick-grid path — open surfaces, "
+                        "per-vertex COLOR carried into a .ply output")
     return p
 
 
@@ -62,13 +73,22 @@ def main(argv=None) -> int:
         cloud = merge.remove_background(cloud)
     if args.remove_outliers:
         cloud = merge.remove_outliers(cloud)
-    mesh = meshing.reconstruct_stl(
-        cloud, args.output, mode=args.mode, depth=args.depth,
-        quantile_trim=args.trim, orientation_mode=args.orientation,
-        radii_multipliers=args.radii,
-        preconditioner=args.preconditioner, extraction=args.extraction)
+    kw = dict(mode=args.mode, depth=args.depth,
+              quantile_trim=args.trim, orientation_mode=args.orientation,
+              radii_multipliers=args.radii,
+              preconditioner=args.preconditioner,
+              extraction=args.extraction,
+              representation=args.representation)
+    if args.output.lower().endswith(".ply"):
+        mesh = meshing.mesh_from_cloud(cloud, **kw)
+        ply_io.write_ply_mesh(args.output, mesh)
+    else:
+        mesh = meshing.reconstruct_stl(cloud, args.output, **kw)
+    colored = getattr(mesh, "vertex_colors", None) is not None \
+        and args.output.lower().endswith(".ply")
     print(f"{args.input}: {len(cloud)} pts -> {args.output} "
-          f"({len(mesh.vertices)} verts, {len(mesh.faces)} faces)",
+          f"({len(mesh.vertices)} verts, {len(mesh.faces)} faces"
+          f"{', colored' if colored else ''})",
           file=sys.stderr)
     return 0
 

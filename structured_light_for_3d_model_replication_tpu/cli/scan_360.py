@@ -38,9 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "'..._<deg>deg_AUTO' session folder name when "
                         "present")
     p.add_argument("--stl", default=None,
-                   help="also mesh the merged cloud to this STL (watertight "
-                        "screened Poisson; the full scan→print path in one "
-                        "command)")
+                   help="also mesh the merged cloud to this path (watertight "
+                        "screened Poisson by default; the full scan→print "
+                        "path in one command). A .ply extension writes a "
+                        "vertex-colored mesh instead of STL — pair it with "
+                        "--representation tsdf to keep the scan's colors")
     p.add_argument("--mesh-depth", type=int, default=8)
     s = p.add_argument_group("streaming (docs/STREAMING.md)")
     s.add_argument("--stream", action="store_true",
@@ -56,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="coarse Poisson depth of the per-stop previews")
     s.add_argument("--preview-every", type=int, default=1,
                    help="emit a preview every N fused stops (0 = off)")
+    s.add_argument("--representation", choices=("poisson", "tsdf"),
+                   default="poisson",
+                   help="scene representation (docs/STREAMING.md, batch "
+                        "and --stream): 'tsdf' fuses into a brick volume "
+                        "(fusion/) — streaming stops integrate instead of "
+                        "re-solving, and the final mesh carries vertex "
+                        "color when --stl names a .ply (STL drops color)")
     g = p.add_argument_group("quality gates (docs/ROBUSTNESS.md)")
     g.add_argument("--no-gates", action="store_true",
                    help="disable the quality gates (abort-on-anything "
@@ -167,10 +176,27 @@ def main(argv=None) -> int:
     if args.stl:
         from ..models import meshing
 
+        if args.representation == "tsdf" \
+                and not args.stl.lower().endswith(".ply"):
+            print("note: --representation tsdf meshes carry vertex color "
+                  "only into a .ply output; STL drops it",
+                  file=sys.stderr)
         # Terminal guard: a mesh failure (or an empty mesh) degrades to
         # "you still have the merged PLY" instead of crashing the run.
         try:
-            mesh = meshing.mesh_360(merged, args.stl, depth=args.mesh_depth)
+            if args.stl.lower().endswith(".ply"):
+                from ..io import ply as ply_io
+
+                # quantile_trim 0.0 = the mesh_360 watertight default —
+                # the output extension must not change the geometry.
+                mesh = meshing.mesh_from_cloud(
+                    merged, depth=args.mesh_depth, quantile_trim=0.0,
+                    representation=args.representation)
+                ply_io.write_ply_mesh(args.stl, mesh)
+            else:
+                mesh = meshing.mesh_360(
+                    merged, args.stl, depth=args.mesh_depth,
+                    representation=args.representation)
         except Exception as e:
             health.note("meshing failed (%s) — merged cloud kept at %s",
                         e, args.output)
@@ -222,6 +248,7 @@ def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
         gates=gates,
         preview_depth=args.preview_depth,
         preview_every=args.preview_every,
+        representation=args.representation,
         final_depth=args.mesh_depth,
         expected_stops=max(labels) + 1)
     sess = IncrementalSession(cal, col_bits, row_bits, params=params,
@@ -239,7 +266,10 @@ def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
                 + f", {res.seconds:.1f}s)")
         print(line, file=sys.stderr)
         if res.preview and sess.preview is not None:
-            write_stl(preview_path, sess.preview)
+            if preview_path.lower().endswith(".ply"):
+                ply_io.write_ply_mesh(preview_path, sess.preview)
+            else:
+                write_stl(preview_path, sess.preview)
             if first_preview_s is None:
                 first_preview_s = time.monotonic() - t0
                 print(f"first preview {first_preview_s:.1f}s after stop "
@@ -268,8 +298,17 @@ def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
           f"stops -> {args.output} ({len(fin.cloud)} points)",
           file=sys.stderr)
     if args.stl and fin.mesh is not None:
-        write_stl(args.stl, fin.mesh)
-        print(f"meshed -> {args.stl} ({len(fin.mesh.faces)} faces)",
+        colored = getattr(fin.mesh, "vertex_colors", None) is not None
+        if args.stl.lower().endswith(".ply"):
+            ply_io.write_ply_mesh(args.stl, fin.mesh)
+        else:
+            if colored:
+                print("note: STL drops the mesh's vertex colors — name "
+                      "a .ply with --stl to keep them", file=sys.stderr)
+                colored = False
+            write_stl(args.stl, fin.mesh)
+        print(f"meshed -> {args.stl} ({len(fin.mesh.faces)} faces"
+              f"{', colored' if colored else ''})",
               file=sys.stderr)
     health.emit()
     if args.health_json:

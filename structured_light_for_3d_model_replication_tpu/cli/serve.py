@@ -67,6 +67,21 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.stream.preview_depth,
                    help="coarse Poisson depth of per-stop session "
                         "previews (finalize uses the full depth)")
+    p.add_argument("--representation", choices=("poisson", "tsdf"),
+                   default=d.stream.representation,
+                   help="default session scene representation "
+                        "(docs/STREAMING.md): 'tsdf' previews integrate "
+                        "incrementally (fusion/) and finalize meshes "
+                        "carry vertex color; per-session override via "
+                        "the POST /session body")
+    p.add_argument("--mesh-representation", choices=("poisson", "tsdf"),
+                   default=d.mesh_representation,
+                   help="scene representation for one-shot STL/mesh_ply "
+                        "results (docs/MESHING.md)")
+    p.add_argument("--no-session-warmup", action="store_true",
+                   help="skip the session-lane program warmup (the "
+                        "first session — or a failover adoption — will "
+                        "pay those compiles)")
     p.add_argument("--proj-width", type=int, default=d.proj.width,
                    help="projector width (fixes the protocol bit count)")
     p.add_argument("--proj-height", type=int, default=d.proj.height)
@@ -224,7 +239,8 @@ def main(argv=None) -> int:
     try:
         stream = _stream_params(
             dataclasses.replace(defaults.stream,
-                                preview_depth=args.preview_depth),
+                                preview_depth=args.preview_depth,
+                                representation=args.representation),
             args.stream_json)
     except (ValueError, TypeError) as e:
         print(f"error: bad --stream-json: {e}", file=sys.stderr)
@@ -237,7 +253,9 @@ def main(argv=None) -> int:
         buckets=buckets,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(",")),
         warmup=not args.no_warmup,
+        warmup_sessions=not args.no_session_warmup,
         mesh_depth=args.mesh_depth,
+        mesh_representation=args.mesh_representation,
         max_sessions=args.max_sessions,
         store_dir=args.store_dir,
         content_cache=not args.no_content_cache,

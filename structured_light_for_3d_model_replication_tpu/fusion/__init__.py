@@ -1,0 +1,44 @@
+"""fusion/ — the fused TSDF scene representation.
+
+The second scene representation next to Poisson→marching (ROADMAP:
+"fused TSDF/Gaussian backend"): a sparse brick-grid truncated-signed-
+distance volume fused incrementally on device (`ops/tsdf.py`, donated
+in-place integration, optional pallas combine kernel), extracted as a
+VERTEX-COLORED mesh through the marching-tets compaction machinery
+(`fusion/extract.py` over `ops/marching_jax.py`'s tables).
+
+What it unlocks that the Poisson path cannot:
+
+* **color** — the reference pipeline's per-point RGB survives into the
+  mesh (`io/ply.write_ply_mesh` carries it out);
+* **open scenes** — unobserved space extracts as NOTHING (observation-
+  masked cells), not a hallucinated watertight closure;
+* **incremental previews** — `fusion/preview.TSDFPreviewMesher`
+  integrates each streaming stop into the persistent volume instead of
+  re-solving the whole model (bench [11] `tsdf_preview_s`).
+
+Dispatch: ``models/meshing.mesh_from_cloud(representation="tsdf")`` for
+batch clouds (sign from oriented normals), ``StreamParams(
+representation="tsdf")`` / the serve session option for streaming (sign
+from the per-stop viewing rays). The Poisson path stays the watertight
+print path and the NumPy TSDF oracle (`ops/tsdf.integrate_oracle`) pins
+device parity. docs/MESHING.md and docs/STREAMING.md cover semantics.
+
+The Gaussian/appearance tier (splat rendering on top of this SDF, per
+Gaussian-Plus-SDF SLAM) is the remaining ROADMAP item above this layer.
+"""
+
+from ..ops.tsdf import TSDFParams, TSDFState, integrate_oracle
+from .extract import extract_colored
+from .preview import TSDFPreviewMesher
+from .volume import TSDFVolume, fit_bounds
+
+__all__ = [
+    "TSDFParams",
+    "TSDFState",
+    "TSDFPreviewMesher",
+    "TSDFVolume",
+    "extract_colored",
+    "fit_bounds",
+    "integrate_oracle",
+]

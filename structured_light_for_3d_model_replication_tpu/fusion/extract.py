@@ -1,0 +1,221 @@
+"""Vertex-colored iso-surface extraction from a TSDF brick volume.
+
+Reuses the device-side sparse marching machinery of
+:mod:`..ops.marching_jax` — the (M, 729) corner-frame assembly, the
+prefix-sum cell compaction and the static tet tables — with two TSDF
+additions:
+
+* an **observation mask**: a cell emits triangles only when ALL 8 of its
+  corners carry integration weight (> ``min_weight``). Unobserved space
+  never interpolates, so open scenes extract as open surfaces instead of
+  the phantom walls a fill value would mint — the non-watertight
+  capability the Poisson path cannot offer.
+* **color interpolation**: per-channel (M, 729) corner frames ride the
+  same gathers as χ, and each triangle vertex linearly interpolates RGB
+  with the exact ``t`` of its position — per-vertex color for free.
+
+Capacities are bucketed with a caller-settable FLOOR (``cells_floor`` /
+``tris_floor``): the streaming previewer pins generous floors once so a
+growing model re-uses one compiled program per phase instead of minting
+a fresh one each time the active-cell count crosses a power of two
+(zero steady-state compiles, the stream acceptance bar). The host tail
+(outward vote, weight trim, weld) mirrors ``extract_sparse_jax``, with
+the weld carrying first-occurrence vertex colors through the dedup.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..io.stl import TriangleMesh
+from ..ops import marching_jax as mj
+from ..ops import tsdf as tsdf_ops
+from ..ops.marching import _CORNERS
+from ..ops.poisson_sparse import BS
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@jax.jit
+def _phase_frames(chi, weight, rgb, nbr, block_valid, min_weight):
+    """Corner frames for χ / weight / RGB + the observation-masked
+    active-cell mask. χ uses the own-brick clamp fallback (no spurious
+    crossings, same as the marching extractors); weight falls back to 0
+    (an absent neighbor is UNOBSERVED — its cells must not emit)."""
+    m = chi.shape[0]
+    nb8 = mj._nb8_table(nbr)
+    rows = nb8[:, jnp.asarray(mj._CASE9, jnp.int32)]        # (M, 729)
+    src = jnp.asarray(mj._SRC9, jnp.int32)[None, :]
+    present = rows < m
+
+    def frame(vals, clamp_fallback: bool):
+        pad = jnp.concatenate([vals, jnp.zeros((1,) + vals.shape[1:],
+                                               vals.dtype)])
+        v = pad[rows, src]
+        if clamp_fallback:
+            fb = vals[:, jnp.asarray(mj._CLAMP9, jnp.int32)]
+        else:
+            fb = jnp.zeros_like(v)
+        if v.ndim == 3:
+            return jnp.where(present[..., None], v, fb)
+        return jnp.where(present, v, fb)
+
+    c9 = frame(chi, True)
+    w9 = frame(weight, False)
+    rgb9 = frame(rgb, True)
+
+    inside = c9 > 0.0
+    observed = w9 > min_weight
+    any_in = all_in = all_obs = None
+    for j in range(8):
+        cidx = jnp.asarray(mj._CIDX[:, j], jnp.int32)
+        blk = inside[:, cidx]
+        obs = observed[:, cidx]
+        any_in = blk if any_in is None else (any_in | blk)
+        all_in = blk if all_in is None else (all_in & blk)
+        all_obs = obs if all_obs is None else (all_obs & obs)
+    active = any_in & ~all_in & all_obs & block_valid[:, None]
+    return c9, rgb9, active, jnp.sum(active.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("T",))
+def _phase_triangles_colored(cells, rgb9, weight, block_coords, T: int):
+    """`marching_jax._phase_triangles` with RGB interpolation: returns
+    (tris (T, 3, 3) grid coords, colors (T, 3, 3), density (T,) = the
+    cell's own integration weight)."""
+    bk, ck, v8, case = cells
+    iso = jnp.float32(0.0)
+    nt = jnp.asarray(mj._NTRI, jnp.int32)[case]              # (K, 6)
+    tv = (jnp.arange(2, dtype=jnp.int32)[None, None, :]
+          < nt[:, :, None]).reshape(-1)
+    rank = jnp.cumsum(tv.astype(jnp.int32)) - 1
+    dest = jnp.where(tv, jnp.minimum(rank, T), T)
+    src = jnp.zeros((T + 1,), jnp.int32).at[dest].set(
+        jnp.arange(tv.shape[0], dtype=jnp.int32), mode="drop")[:T]
+
+    k = src // 12
+    t = (src % 12) // 2
+    j = src % 2
+    caseT = case[k, t]
+    epc = jnp.asarray(mj._EP_CUBE, jnp.int32)[t, caseT, j]   # (T, 3, 2)
+    v8k = v8[k]                                              # (T, 8)
+    va = jnp.take_along_axis(v8k, epc[:, :, 0], axis=1)
+    vb = jnp.take_along_axis(v8k, epc[:, :, 1], axis=1)
+    # Per-cell 8-corner colors, gathered once per triangle row.
+    c8 = rgb9[bk[k][:, None], jnp.asarray(mj._CIDX, jnp.int32)[ck[k]]]
+    ca = jnp.take_along_axis(c8, epc[:, :, 0, None], axis=1)  # (T, 3, 3)
+    cb = jnp.take_along_axis(c8, epc[:, :, 1, None], axis=1)
+    base = (block_coords[bk[k]] * BS
+            + jnp.asarray(mj._CELL_XYZ, jnp.int32)[ck[k]])
+    corners = jnp.asarray(_CORNERS, jnp.int32)
+    pa = (base[:, None, :] + corners[epc[:, :, 0]]).astype(jnp.float32)
+    pb = (base[:, None, :] + corners[epc[:, :, 1]]).astype(jnp.float32)
+    denom = vb - va
+    safe = jnp.abs(denom) > 1e-12
+    tt = jnp.where(safe, (iso - va) / jnp.where(safe, denom, 1.0), 0.5)
+    tt = jnp.clip(tt, 0.0, 1.0).astype(jnp.float32)
+    tris = pa + tt[..., None] * (pb - pa)
+    cols = ca + tt[..., None] * (cb - ca)
+    flip = jnp.asarray(mj._FLIP, jnp.bool_)[t, caseT, j]
+    tris = jnp.where(flip[:, None, None], tris[:, ::-1, :], tris)
+    cols = jnp.where(flip[:, None, None], cols[:, ::-1, :], cols)
+    dens = weight[bk[k], ck[k]]
+    return tris, cols, dens
+
+
+def _weld_colored(tris: _np.ndarray, cols: _np.ndarray,
+                  decimals: int = 6):
+    """`marching.weld` with first-occurrence vertex colors carried
+    through the rounded-vertex dedup."""
+    flat = tris.reshape(-1, 3)
+    key = _np.round(flat, decimals)
+    uniq, first, inv = _np.unique(key, axis=0, return_index=True,
+                                  return_inverse=True)
+    faces = inv.reshape(-1, 3).astype(_np.int32)
+    good = ((faces[:, 0] != faces[:, 1]) & (faces[:, 1] != faces[:, 2])
+            & (faces[:, 0] != faces[:, 2]))
+    vcols = cols.reshape(-1, 3)[first]
+    return uniq.astype(_np.float32), faces[good], vcols
+
+
+def extract_colored(state, params, origin, voxel_size,
+                    min_weight: float = 0.0,
+                    quantile_trim: float = 0.0,
+                    cells_floor: int = 4096,
+                    tris_floor: int = 8192,
+                    with_colors: bool = True) -> TriangleMesh:
+    """TSDF volume → welded vertex-colored :class:`TriangleMesh`.
+
+    ``min_weight`` masks under-observed corners (0.0 = any observation
+    counts); ``quantile_trim`` drops the lowest-weight triangle fraction
+    (the Poisson density-trim semantics applied to integration weight).
+    ``cells_floor``/``tris_floor`` pin the compaction capacities — pass
+    generous floors from steady-state callers to avoid bucket-growth
+    recompiles. Empty volumes return an empty mesh, never raise."""
+    nbr, block_valid = tsdf_ops.neighbor_table(state, params)
+    c9, rgb9, active, count = _phase_frames(
+        state.tsdf, state.weight, state.rgb, nbr, block_valid,
+        jnp.float32(min_weight))
+    n_cells = int(count)
+    if n_cells == 0:
+        return TriangleMesh(_np.zeros((0, 3), _np.float32),
+                            _np.zeros((0, 3), _np.int32))
+    K = mj._bucket(n_cells, floor=cells_floor)
+    if K > cells_floor:
+        # Bounded re-bucket (a compile) — steady-state callers should
+        # raise their floor to cover the surface they expect.
+        log.debug("TSDF extraction outgrew cells_floor=%d (%d active "
+                  "cells) — re-bucketed to %d", cells_floor, n_cells, K)
+    cell_ids = mj._phase_cells(active, K)
+    count_d, cells = mj._phase_count(c9, cell_ids, jnp.float32(0.0), K)
+    nt = int(count_d)
+    if nt == 0:
+        return TriangleMesh(_np.zeros((0, 3), _np.float32),
+                            _np.zeros((0, 3), _np.int32))
+    T = mj._bucket(nt, floor=tris_floor)
+    if T > tris_floor:
+        log.debug("TSDF extraction outgrew tris_floor=%d (%d "
+                  "triangles) — re-bucketed to %d", tris_floor, nt, T)
+    tris_d, cols_d, dens_d = _phase_triangles_colored(
+        cells, rgb9, state.weight, state.brick_coords, T)
+    # Full-capacity readback, host slice — NOT the device per-nt slice
+    # `marching_jax` uses: that mints a (cheap) compile per distinct
+    # count, which the zero-steady-state-compile bar of the streaming
+    # previewer forbids. The floors bound the readback (a few MB), and
+    # the batch path amortizes it over one call.
+    tris = _np.asarray(tris_d, _np.float64)[:nt]
+    cols = _np.asarray(cols_d, _np.float64)[:nt]
+    dens_np = _np.asarray(dens_d)[:nt]
+
+    # Global outward decision (one all-or-nothing flip — the device
+    # winding is already field-consistent, same as extract_sparse_jax).
+    cen = tris.mean(axis=1)
+    nrm = _np.cross(tris[:, 1] - tris[:, 0], tris[:, 2] - tris[:, 0])
+    vote = _np.einsum("ij,ij->i", nrm, cen - cen.mean(axis=0))
+    if _np.sum(_np.sign(vote)) <= 0:
+        tris = tris[:, ::-1, :]
+        cols = cols[:, ::-1, :]
+
+    if quantile_trim > 0.0 and tris.shape[0]:
+        keep = dens_np > _np.quantile(dens_np, quantile_trim)
+        tris = tris[keep]
+        cols = cols[keep]
+
+    verts, faces, vcols = _weld_colored(tris, cols)
+    # Samples live at voxel CENTERS: grid coord v maps to world
+    # origin + (v + 0.5) * voxel.
+    world = (verts + _np.float32(0.5)) * _np.float32(voxel_size) \
+        + _np.asarray(origin, _np.float32)
+    mesh = TriangleMesh(world.astype(_np.float32), faces)
+    if with_colors:
+        mesh.vertex_colors = _np.clip(_np.round(vcols), 0,
+                                      255).astype(_np.uint8)
+    if len(mesh.faces):
+        mesh.compute_vertex_normals()
+    return mesh

@@ -1,0 +1,121 @@
+"""TSDFVolume: the host-side handle on a device TSDF brick volume.
+
+Owns the (donated) device state plus the world mapping (origin + voxel
+size), logs capacity overflows (degrade to holes, never an error — the
+model_cap rule of `stream/session.py` applied to bricks), and fronts the
+two integration flavors:
+
+* :meth:`integrate_from_camera` — streaming stops: inward directions
+  along the viewing rays from the stop's camera center;
+* :meth:`integrate_oriented` — batch clouds: inward = −oriented normal
+  (the `models/meshing` dispatch path).
+
+``fit_bounds`` picks the world mapping the way `ops/poisson.
+normalize_points` does (isotropic padded cube), quantized so the brick
+grid covers it exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..io.stl import TriangleMesh
+from ..ops import tsdf as tsdf_ops
+from ..utils.log import get_logger
+from .extract import extract_colored
+
+log = get_logger(__name__)
+
+
+def fit_bounds(lo, hi, params: tsdf_ops.TSDFParams,
+               pad_frac: float = 0.15):
+    """(origin, voxel_size) covering the padded isotropic cube around
+    [lo, hi] with the volume's ``2^grid_depth`` voxels."""
+    lo = np.asarray(lo, np.float32)
+    hi = np.asarray(hi, np.float32)
+    extent = float(np.max(hi - lo))
+    extent = extent if extent > 1e-12 else 1.0
+    side = extent * (1.0 + 2.0 * float(pad_frac))
+    voxel = side / params.resolution
+    center = 0.5 * (lo + hi)
+    origin = center - 0.5 * side
+    return origin.astype(np.float32), float(voxel)
+
+
+class TSDFVolume:
+    """One TSDF scene: fixed params, fixed world mapping, fused state."""
+
+    def __init__(self, params: tsdf_ops.TSDFParams, origin, voxel_size,
+                 use_pallas: bool | None = None):
+        self.params = params
+        self.origin = np.asarray(origin, np.float32)
+        self.voxel_size = float(voxel_size)
+        self.use_pallas = use_pallas
+        self._state = tsdf_ops.init_state(params)
+        self.n_bricks = 0
+        self.n_dropped = 0
+        self.stops_integrated = 0
+
+    @classmethod
+    def from_bounds(cls, params: tsdf_ops.TSDFParams, lo, hi,
+                    pad_frac: float = 0.15,
+                    use_pallas: bool | None = None) -> "TSDFVolume":
+        origin, voxel = fit_bounds(lo, hi, params, pad_frac=pad_frac)
+        return cls(params, origin, voxel, use_pallas=use_pallas)
+
+    # ------------------------------------------------------------------
+
+    def _integrate(self, points, colors, valid, dirs) -> int:
+        self._state, n_wanted = tsdf_ops.integrate(
+            self._state, self.params, points, colors, valid, dirs,
+            self.origin, self.voxel_size, use_pallas=self.use_pallas)
+        n_wanted = int(n_wanted)
+        cap = int(self.params.max_bricks)
+        if n_wanted > cap and self.n_dropped == 0:
+            log.warning(
+                "TSDF brick pool overflowed max_bricks=%d (%d wanted) — "
+                "excess bricks dropped (holes in the extracted surface)",
+                cap, n_wanted)
+        self.n_dropped = max(self.n_dropped, n_wanted - cap)
+        self.n_bricks = min(n_wanted, cap)
+        self.stops_integrated += 1
+        return n_wanted
+
+    def integrate_from_camera(self, points, colors, valid, cam) -> int:
+        """Fuse one stop observed from camera center ``cam`` (3,); all
+        arrays world-frame (device or host). Returns wanted bricks."""
+        dirs = tsdf_ops.camera_dirs(jnp.asarray(points, jnp.float32),
+                                    jnp.asarray(cam, jnp.float32))
+        return self._integrate(points, colors, valid, dirs)
+
+    def integrate_oriented(self, points, colors, valid, normals) -> int:
+        """Fuse an oriented cloud: inward = −(outward normal)."""
+        dirs = -jnp.asarray(normals, jnp.float32)
+        return self._integrate(points, colors, valid, dirs)
+
+    # ------------------------------------------------------------------
+
+    def extract(self, min_weight: float = 0.0, quantile_trim: float = 0.0,
+                cells_floor: int = 4096, tris_floor: int = 8192,
+                with_colors: bool = True) -> TriangleMesh:
+        return extract_colored(
+            self._state, self.params, self.origin, self.voxel_size,
+            min_weight=min_weight, quantile_trim=quantile_trim,
+            cells_floor=cells_floor, tris_floor=tris_floor,
+            with_colors=with_colors)
+
+    def to_dense(self):
+        """Dense (tsdf, weight, rgb) host arrays (oracle layout)."""
+        return tsdf_ops.state_to_dense(self._state, self.params)
+
+    def stats(self) -> dict:
+        return {
+            "bricks": int(self.n_bricks),
+            "max_bricks": int(self.params.max_bricks),
+            "bricks_dropped": int(self.n_dropped),
+            "stops_integrated": int(self.stops_integrated),
+            "voxel_size": round(self.voxel_size, 6),
+            "grid_depth": int(self.params.grid_depth),
+        }

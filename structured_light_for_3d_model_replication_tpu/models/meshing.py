@@ -85,6 +85,9 @@ def mesh_from_cloud(
     preconditioner: str = "additive",
     extraction: str = "auto",
     max_blocks: int | None = None,
+    representation: str = "poisson",
+    tsdf_max_bricks: int = 8192,
+    cg_x0=None,
 ) -> TriangleMesh:
     """Poisson-mesh a cloud (the body of `reconstruct_stl` / `mesh_360`).
 
@@ -106,17 +109,43 @@ def mesh_from_cloud(
     solver's band budget (None = its default, with its own
     overflow-retry). All three only apply to the deep (sparse) path;
     the dense ≤ 8 path is untouched.
+
+    ``representation`` dispatches the scene representation
+    (docs/MESHING.md): ``"poisson"`` (default) is the watertight print
+    path above; ``"tsdf"`` fuses the oriented cloud into a sparse
+    brick-grid TSDF (`fusion/`) and extracts a VERTEX-COLORED mesh —
+    open where the data is open, colors carried from ``cloud.colors``.
+    ``depth`` maps onto the TSDF grid depth (clamped to 5–9; the volume
+    is ``2^depth`` voxels per axis) and ``quantile_trim`` trims the
+    lowest-weight triangle fraction; ``tsdf_max_bricks`` bounds the
+    brick pool (overflow degrades to holes, logged). ``cg_x0``
+    warm-starts the DENSE Poisson CG from a previous solve's χ grid
+    (streaming finalize; ignored by the sparse and TSDF paths).
     """
     if mode not in ("watertight", "surface"):
         raise ValueError(f"unknown mesh mode {mode!r}")
     if extraction not in ("auto", "host", "device"):
         # Fail BEFORE the multi-second solve, not in the extractor after.
         raise ValueError(f"unknown extraction engine {extraction!r}")
+    if representation not in ("poisson", "tsdf"):
+        raise ValueError(f"unknown representation {representation!r} "
+                         "(expected 'poisson' or 'tsdf')")
     pts = np.asarray(cloud.points, np.float32)
     if pts.shape[0] < 16:
         raise ValueError(f"too few points to mesh ({pts.shape[0]})")
     normals = ensure_oriented_normals(cloud, orientation_mode,
                                       camera=camera)
+
+    if representation == "tsdf":
+        trim = quantile_trim if mode == "watertight" \
+            else max(quantile_trim, 0.25)
+        mesh = _tsdf_mesh(cloud, pts, normals, depth, trim,
+                          tsdf_max_bricks)
+        log.info("TSDF-meshed %d points -> %d verts / %d faces "
+                 "(depth=%d, colored=%s)", pts.shape[0],
+                 len(mesh.vertices), len(mesh.faces), depth,
+                 mesh.vertex_colors is not None)
+        return mesh
 
     if mode == "surface":
         mesh = _ball_pivot_mesh(pts, normals, radii_multipliers)
@@ -141,11 +170,50 @@ def mesh_from_cloud(
                                        engine=extraction)
     else:
         grid = poisson.reconstruct(pts, normals, depth=int(depth),
-                                   cg_iters=cg_iters)
+                                   cg_iters=cg_iters, x0=cg_x0)
         mesh = marching.extract(grid, quantile_trim=trim)
     log.info("meshed %d points -> %d verts / %d faces (mode=%s depth=%d)",
              pts.shape[0], len(mesh.vertices), len(mesh.faces), mode, depth)
     return mesh
+
+
+def _tsdf_mesh(cloud: PointCloud, pts: np.ndarray, normals: np.ndarray,
+               depth: int, quantile_trim: float,
+               max_bricks: int) -> TriangleMesh:
+    """Oriented cloud → fused TSDF → vertex-colored mesh (fusion/).
+
+    Sign comes from the oriented normals (inward = −n̂). The point count
+    is bucketed to powers of two so arbitrary clouds reuse a handful of
+    compiled integrate programs (the marching capacity rule)."""
+    from ..fusion import TSDFParams, TSDFVolume
+    from ..ops.marching_jax import _bucket
+
+    grid_depth = min(max(int(depth), 5), 9)
+    params = TSDFParams(grid_depth=grid_depth,
+                        max_bricks=int(max_bricks))
+    n = pts.shape[0]
+    cap = _bucket(n)
+    pad = cap - n
+    has_colors = cloud.colors is not None \
+        and len(cloud.colors) == n
+    cols = np.asarray(cloud.colors, np.float32) if has_colors \
+        else np.zeros((n, 3), np.float32)
+    pts_p = np.concatenate([pts, np.zeros((pad, 3), np.float32)])
+    cols_p = np.concatenate([cols, np.zeros((pad, 3), np.float32)])
+    nrm_p = np.concatenate([normals.astype(np.float32),
+                            np.tile(np.asarray([[0.0, 0.0, 1.0]],
+                                               np.float32), (pad, 1))])
+    val_p = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+    vol = TSDFVolume.from_bounds(params, pts.min(axis=0),
+                                 pts.max(axis=0))
+    vol.integrate_oriented(pts_p, cols_p, val_p, nrm_p)
+    if vol.n_dropped:
+        log.warning("TSDF mesh dropped %d brick(s) past "
+                    "max_bricks=%d — raise tsdf_max_bricks or lower "
+                    "depth if the surface shows holes", vol.n_dropped,
+                    int(max_bricks))
+    return vol.extract(quantile_trim=quantile_trim,
+                       with_colors=has_colors)
 
 
 def _ball_pivot_mesh(pts: np.ndarray, normals: np.ndarray,

@@ -147,10 +147,17 @@ def screen_weights(density, screen):
     return screen * density / jnp.maximum(wmean, 1e-12)
 
 
-@functools.partial(jax.jit, static_argnames=("resolution", "cg_iters"))
-def _solve(points, normals, valid, resolution: int, cg_iters: int,
-           screen: float, rtol=3e-4):
+@functools.partial(jax.jit,
+                   static_argnames=("resolution", "cg_iters", "warm"))
+def _solve(points, normals, valid, x0, resolution: int, cg_iters: int,
+           screen: float, rtol=3e-4, *, warm: bool = True):
     R = resolution
+    if not warm:
+        # Cold start: the zeros grid is a workspace ALLOCATED INSIDE the
+        # program — hoisting it to the caller would pin an extra
+        # non-donated 2^3d operand (67 MB at depth 8) for the whole
+        # solve. ``x0`` is a 0-d placeholder here.
+        x0 = jnp.zeros((R, R, R), jnp.float32)
     grid_pts, origin, scale = normalize_points(points, valid, R)
     vw = splat(grid_pts, jnp.concatenate(
         [normals, jnp.ones((points.shape[0], 1), jnp.float32)], axis=-1),
@@ -175,7 +182,6 @@ def _solve(points, normals, valid, resolution: int, cg_iters: int,
         return -A(x)
 
     dinv = 1.0 / (6.0 + W)
-    x0 = jnp.zeros((R, R, R), jnp.float32)
     r0 = b - matvec(x0)
     z0 = dinv * r0
     rz0 = jnp.vdot(r0, z0)
@@ -198,19 +204,20 @@ def _solve(points, normals, valid, resolution: int, cg_iters: int,
         p = z + beta * p
         return x, r, p, rz_new, jnp.vdot(r, r), it + 1
 
-    chi, _, _, _, _, _ = jax.lax.while_loop(
+    chi, _, _, _, _, iters = jax.lax.while_loop(
         cond, body, (x0, r0, z0, rz0, jnp.vdot(r0, r0), jnp.int32(0)))
 
     # Iso level: density-weighted mean of chi at the samples.
     chi_at_pts = gather(chi, grid_pts)
     wpts = valid.astype(jnp.float32) * gather(density, grid_pts)
     iso = jnp.sum(chi_at_pts * wpts) / jnp.maximum(jnp.sum(wpts), 1.0)
-    return PoissonGrid(chi, density, iso, origin, scale)
+    return PoissonGrid(chi, density, iso, origin, scale), iters
 
 
 def reconstruct(points, normals, valid=None, depth: int = 6,
                 cg_iters: int = 300, screen: float = 4.0,
-                rtol: float = 3e-4) -> PoissonGrid:
+                rtol: float = 3e-4, x0=None,
+                return_iters: bool = False) -> PoissonGrid:
     """Screened-Poisson solve on a 2^depth dense grid.
 
     Drop-in for the solve half of `create_from_point_cloud_poisson`
@@ -220,14 +227,29 @@ def reconstruct(points, normals, valid=None, depth: int = 6,
     ``cg_iters`` caps the PCG; the residual stop (``rtol``, same knob and
     measured-equal-quality 3e-4 default as
     :func:`..poisson_sparse.reconstruct_sparse`) usually ends it sooner.
+
+    ``x0`` WARM-STARTS the CG from a previous solve's χ grid (same
+    resolution; the streaming previewer threads its last preview grid
+    through — the solution barely moves between stops, so the residual
+    stop fires after far fewer iterations). ``return_iters`` additionally
+    returns the iteration count the residual stop settled at — the
+    measurable half of the warm-start contract (tests/test_stream.py).
     """
     if depth > 8:
         raise ValueError(
             f"depth={depth} > 8: dense-grid Poisson is capped at 256³ "
             "(the reference similarly guards depth > 16)")
+    R = 2 ** depth
     points = jnp.asarray(points, jnp.float32)
     normals = jnp.asarray(normals, jnp.float32)
     if valid is None:
         valid = jnp.ones(points.shape[0], dtype=bool)
-    return _solve(points, normals, valid, 2 ** depth, cg_iters, screen,
-                  rtol)
+    warm = x0 is not None
+    if warm and x0.shape != (R, R, R):
+        raise ValueError(f"x0 shape {x0.shape} does not match the "
+                         f"depth-{depth} grid ({R}³)")
+    grid, iters = _solve(
+        points, normals, valid,
+        x0 if warm else jnp.zeros((), jnp.float32),
+        R, cg_iters, screen, rtol, warm=warm)
+    return (grid, int(iters)) if return_iters else grid

@@ -1136,10 +1136,15 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
     # before the band phases allocate), then the separable prolongation.
     # rtol forwards: the coarse chi becomes the fine band's Dirichlet
     # halo, so coarse accuracy bounds what the caller's rtol can buy.
-    coarse = dense_poisson._solve(points, normals, valid,
-                                  2 ** min(coarse_depth, depth),
-                                  coarse_iters, jnp.float32(screen),
-                                  rtol=rtol)
+    rc = 2 ** min(coarse_depth, depth)
+    # warm=False: the cold-start zeros grid allocates INSIDE the jitted
+    # solve (hoisting it pinned an extra non-donated rc³ operand for the
+    # whole coarse phase — see dense_poisson._solve).
+    coarse, _ = dense_poisson._solve(points, normals, valid,
+                                     jnp.zeros((), jnp.float32),
+                                     rc, coarse_iters,
+                                     jnp.float32(screen), rtol=rtol,
+                                     warm=False)
     b, x0 = _prolong_band(coarse.chi, rhs, nbr, block_valid, block_coords,
                           2 ** depth, 2 ** min(coarse_depth, depth))
     if preconditioner == "jacobi":

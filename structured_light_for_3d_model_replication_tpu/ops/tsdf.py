@@ -1,0 +1,350 @@
+"""Sparse brick-grid TSDF integration (device-side, in-place).
+
+The second scene representation next to the Poisson solve
+(`ops/poisson*.py`): a truncated-signed-distance volume fused one stop at
+a time, Gaussian-Plus-SDF SLAM style (PAPERS.md) — per-point COLOR rides
+along (the Poisson path discards it), unobserved space stays open
+(non-watertight scenes), and per-stop integration is a fixed-shape
+scatter instead of a from-scratch solve.
+
+Layout follows `ops/poisson_sparse.py`: the volume is a virtual
+``2^grid_depth`` cube of voxels, stored as flat 8³ **bricks**
+(``BS = 8``; flat (cap, 512) per the solver's tile rule — a trailing
+(8, 8) shape pads 16× under the TPU (8, 128) tile). Splatonic's lesson
+(PAPERS.md) is that only the active surface *shell* needs processing, so
+brick storage is a fixed-capacity pool addressed through a DENSE brick
+directory (``(NB³,) int32`` slot map, NB = 2^grid_depth / 8 — 128 KB at
+depth 8): allocation is a prefix-sum over newly touched directory cells,
+never a host-side hash table, and every shape in the per-stop integrate
+program is static. The whole update runs as ONE jitted program with the
+volume buffers donated in/out — true in-place integration, the same
+discipline as `stream/session.py`'s ``_fuse_fn``.
+
+Sign convention: **positive = inside** (behind the observed surface),
+matching the Poisson χ so the marching extractors' ``inside = value >
+iso`` logic (iso = 0 here) carries over unchanged. Each valid point
+updates the ``(2·splat_radius+1)³`` voxel window around it with the
+projective point-to-plane distance ``dot(voxel_center − p, d̂)`` where
+``d̂`` is the per-point INWARD unit direction — the viewing ray for
+streaming stops (:func:`camera_dirs`), ``−n̂`` for oriented clouds —
+clamped to ±1 truncation unit. Weights taper linearly to the truncation
+band edge; TSDF/weight/RGB fold in by weighted running average with the
+classic weight clamp. No free-space carving: the target scenes are
+static turntable captures (documented in docs/MESHING.md).
+
+The elementwise combine (five (cap, 512)-shaped running-average updates)
+optionally runs as a fused Pallas kernel (:mod:`.tsdf_pallas`) behind
+``_backend.tpu_backend()``; :func:`integrate_oracle` is the NumPy oracle
+(dense grid, same formulas, float32) every device result is pinned
+against in tests/test_fusion.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import _backend
+from .poisson_sparse import BS
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+V = BS ** 3                  # 512 voxels per brick
+
+
+class TSDFParams(NamedTuple):
+    """Static (program-keying) half of a TSDF volume's configuration.
+
+    Hashable on purpose: these values are compile-time constants of the
+    integrate/extract programs (`jax.jit` static args), exactly like
+    ``PoissonParams`` keys the sparse solver."""
+
+    grid_depth: int = 8          # virtual cube = 2^grid_depth voxels/axis
+    max_bricks: int = 8192       # fixed brick-pool capacity
+    splat_radius: int = 1        # update window = (2r+1)³ voxels per point
+    trunc_voxels: float = 3.0    # truncation distance in voxels
+    max_weight: float = 64.0     # running-average weight clamp
+
+    @property
+    def resolution(self) -> int:
+        return 1 << int(self.grid_depth)
+
+    @property
+    def nb(self) -> int:
+        return self.resolution // BS
+
+
+class TSDFState(NamedTuple):
+    """Device-resident volume buffers (all shapes fixed by TSDFParams).
+
+    ``tsdf`` is in truncation units (±1 = ± one truncation distance),
+    positive inside; unobserved voxels hold −1 and weight 0 — extraction
+    masks them out, so open scenes stay open."""
+
+    dir_map: jnp.ndarray       # (NB³,) int32 brick slot, −1 = inactive
+    tsdf: jnp.ndarray          # (cap, 512) float32, trunc units, + inside
+    weight: jnp.ndarray        # (cap, 512) float32 accumulated weight
+    rgb: jnp.ndarray           # (cap, 512, 3) float32 running mean color
+    brick_coords: jnp.ndarray  # (cap, 3) int32 brick coords of each slot
+    n_bricks: jnp.ndarray      # () int32 active slots
+
+
+def init_state(params: TSDFParams) -> TSDFState:
+    cap = int(params.max_bricks)
+    nb3 = params.nb ** 3
+    return TSDFState(
+        dir_map=jnp.full((nb3,), -1, jnp.int32),
+        tsdf=jnp.full((cap, V), -1.0, jnp.float32),
+        weight=jnp.zeros((cap, V), jnp.float32),
+        rgb=jnp.zeros((cap, V, 3), jnp.float32),
+        brick_coords=jnp.zeros((cap, 3), jnp.int32),
+        n_bricks=jnp.zeros((), jnp.int32),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _window_offsets(radius: int) -> _np.ndarray:
+    r = int(radius)
+    g = _np.mgrid[-r:r + 1, -r:r + 1, -r:r + 1]
+    return g.reshape(3, -1).T.astype(_np.int32)        # ((2r+1)³, 3)
+
+
+def _combine(tsdf, weight, rgb, num, den, rgbnum, max_weight,
+             use_pallas: bool):
+    """Weighted running-average fold of one stop's scatter sums.
+
+    RGB and TSDF divide by the PRE-clamp weight sum (the mathematically
+    correct mean); only the stored weight is clamped — the KinectFusion
+    recipe, kept identical between the XLA form, the pallas kernel and
+    the NumPy oracle."""
+    if use_pallas:
+        from . import tsdf_pallas
+
+        return tsdf_pallas.combine_pallas(tsdf, weight, rgb, num, den,
+                                          rgbnum, max_weight)
+    wsum = weight + den
+    safe = jnp.maximum(wsum, 1e-12)
+    new_tsdf = jnp.where(den > 0.0, (tsdf * weight + num) / safe, tsdf)
+    new_rgb = jnp.where((den > 0.0)[..., None],
+                        (rgb * weight[..., None] + rgbnum)
+                        / safe[..., None], rgb)
+    return new_tsdf, jnp.minimum(wsum, max_weight), new_rgb
+
+
+@functools.lru_cache(maxsize=None)
+def _integrate_fn(params: TSDFParams, use_pallas: bool):
+    """One stop → volume, ONE launch, volume buffers donated in/out."""
+    depth = int(params.grid_depth)
+    cap = int(params.max_bricks)
+    radius = int(params.splat_radius)
+    r_vox = 1 << depth
+    nb = r_vox // BS
+    nb3 = nb ** 3
+    offs = jnp.asarray(_window_offsets(radius), jnp.int32)
+    trunc = jnp.float32(params.trunc_voxels)
+    wmax = jnp.float32(params.max_weight)
+
+    def run(dir_map, tsdf, weight, rgb, coords, n_bricks,
+            points, colors, valid, dirs, origin, voxel):
+        # -- per-point voxel window + projective TSDF samples ------------
+        g = (points - origin[None, :]) / voxel             # (P, 3) grid
+        v0 = jnp.floor(g).astype(jnp.int32)
+        vox = v0[:, None, :] + offs[None, :, :]            # (P, K, 3)
+        inb = jnp.all((vox >= 0) & (vox < r_vox), axis=-1)
+        ok = valid[:, None] & inb
+        center = vox.astype(jnp.float32) + 0.5
+        sdf = jnp.sum((center - g[:, None, :]) * dirs[:, None, :],
+                      axis=-1)                             # voxel units
+        u = jnp.clip(sdf / trunc, -1.0, 1.0)
+        w = jnp.where(ok, jnp.maximum(1.0 - jnp.abs(u), 0.0), 0.0)
+        ok = ok & (w > 0.0)
+
+        # -- allocate newly touched bricks (prefix-sum, static shape) ----
+        bc = vox >> 3                                      # brick coords
+        cell = (bc[..., 0] * nb + bc[..., 1]) * nb + bc[..., 2]
+        cell_s = jnp.where(ok, cell, nb3)
+        touched = jnp.zeros((nb3 + 1,), jnp.int32).at[
+            cell_s.reshape(-1)].max(1, mode="drop")[:nb3]
+        new = (touched > 0) & (dir_map < 0)
+        rank = jnp.cumsum(new.astype(jnp.int32)) - 1
+        slot = n_bricks + rank
+        alloc_ok = new & (slot < cap)
+        dir_map = jnp.where(alloc_ok, slot, dir_map)
+        n_wanted = n_bricks + jnp.sum(new.astype(jnp.int32))
+        cid = jnp.arange(nb3, dtype=jnp.int32)
+        bxyz = jnp.stack([cid // (nb * nb), (cid // nb) % nb, cid % nb],
+                         axis=1)
+        dest = jnp.where(alloc_ok, slot, cap)
+        coords = coords.at[dest].set(bxyz, mode="drop")
+
+        # -- scatter the stop's weighted sums into the brick pool --------
+        slot_pt = dir_map[jnp.where(ok, cell, 0)]          # (P, K)
+        intra = ((vox[..., 0] & 7) * BS + (vox[..., 1] & 7)) * BS \
+            + (vox[..., 2] & 7)
+        flat = jnp.where(ok & (slot_pt >= 0), slot_pt * V + intra,
+                         cap * V).reshape(-1)
+        num = jnp.zeros((cap * V,), jnp.float32).at[flat].add(
+            (w * u).reshape(-1), mode="drop").reshape(cap, V)
+        den = jnp.zeros((cap * V,), jnp.float32).at[flat].add(
+            w.reshape(-1), mode="drop").reshape(cap, V)
+        rgbnum = jnp.zeros((cap * V, 3), jnp.float32).at[flat].add(
+            (w[..., None] * colors[:, None, :]).reshape(-1, 3),
+            mode="drop").reshape(cap, V, 3)
+
+        tsdf, weight, rgb = _combine(tsdf, weight, rgb, num, den, rgbnum,
+                                     wmax, use_pallas)
+        return (dir_map, tsdf, weight, rgb, coords,
+                jnp.minimum(n_wanted, cap), n_wanted)
+
+    return jax.jit(run, donate_argnums=(0, 1, 2, 3, 4))
+
+
+def integrate(state: TSDFState, params: TSDFParams, points, colors,
+              valid, dirs, origin, voxel_size,
+              use_pallas: bool | None = None):
+    """Fuse one stop (world-frame arrays) into the volume.
+
+    ``points`` (P, 3) f32, ``colors`` (P, 3) f32 (0–255 scale),
+    ``valid`` (P,) bool, ``dirs`` (P, 3) f32 unit INWARD directions
+    (:func:`camera_dirs` / ``−normals``). Returns ``(state, n_wanted)``
+    — ``n_wanted > params.max_bricks`` means the pool overflowed and the
+    excess bricks were dropped (holes, never an error; the caller logs).
+    The state buffers are DONATED: the passed-in state must not be
+    reused."""
+    if use_pallas is None:
+        use_pallas = _backend.tpu_backend()
+    out = _integrate_fn(params, bool(use_pallas))(
+        state.dir_map, state.tsdf, state.weight, state.rgb,
+        state.brick_coords, state.n_bricks,
+        jnp.asarray(points, jnp.float32), jnp.asarray(colors, jnp.float32),
+        jnp.asarray(valid, bool), jnp.asarray(dirs, jnp.float32),
+        jnp.asarray(origin, jnp.float32),
+        jnp.asarray(voxel_size, jnp.float32))
+    return TSDFState(*out[:6]), out[6]
+
+
+@jax.jit
+def camera_dirs(points, cam):
+    """Unit inward directions for a streaming stop: along the viewing
+    ray, away from the camera center ``cam`` (3,) — behind the observed
+    point is inside. Degenerate points at the camera get a safe axis."""
+    d = points - cam[None, :]
+    n = jnp.linalg.norm(d, axis=-1, keepdims=True)
+    return jnp.where(n > 1e-9, d / jnp.maximum(n, 1e-9),
+                     jnp.asarray([0.0, 0.0, 1.0], jnp.float32))
+
+
+@functools.lru_cache(maxsize=None)
+def _neighbor_fn(params: TSDFParams):
+    """(state) → (nbr (cap, 6), block_valid (cap,)) for the marching
+    extractors: face-neighbor slots through the dense directory, absent
+    (or out-of-band) = cap — the `poisson_sparse` ``nbr`` contract."""
+    cap = int(params.max_bricks)
+    nb = params.nb
+    nb3 = nb ** 3
+    dirs6 = jnp.asarray([[1, 0, 0], [-1, 0, 0], [0, 1, 0],
+                         [0, -1, 0], [0, 0, 1], [0, 0, -1]], jnp.int32)
+
+    def run(dir_map, coords, n_bricks):
+        row_ok = jnp.arange(cap, dtype=jnp.int32) < n_bricks
+        nbc = coords[:, None, :] + dirs6[None, :, :]       # (cap, 6, 3)
+        inb = jnp.all((nbc >= 0) & (nbc < nb), axis=-1)
+        cell = (nbc[..., 0] * nb + nbc[..., 1]) * nb + nbc[..., 2]
+        slot = dir_map[jnp.where(inb, cell, 0)]
+        nbr = jnp.where(inb & (slot >= 0) & row_ok[:, None], slot, cap)
+        # A neighbor row past n_bricks (stale slot) also reads as absent.
+        nbr = jnp.where(nbr < n_bricks, nbr, cap)
+        return nbr.astype(jnp.int32), row_ok
+
+    return jax.jit(run)
+
+
+def neighbor_table(state: TSDFState, params: TSDFParams):
+    return _neighbor_fn(params)(state.dir_map, state.brick_coords,
+                                state.n_bricks)
+
+
+# ---------------------------------------------------------------------------
+# NumPy oracle (dense grid, same float32 formulas)
+# ---------------------------------------------------------------------------
+
+
+def integrate_oracle(dense, points, colors, valid, dirs, origin,
+                     voxel_size, params: TSDFParams):
+    """Dense-grid NumPy reference for :func:`integrate`.
+
+    ``dense`` is ``None`` (fresh volume) or the ``(tsdf, weight, rgb)``
+    triple a previous call returned — dense ``(R, R, R[, 3])`` float32
+    arrays. Same window, same projective distance, same running-average
+    fold, all in float32; the only divergence from the device op is
+    scatter-add ORDER (parity is allclose, not bitwise)."""
+    r_vox = params.resolution
+    if dense is None:
+        tsdf = _np.full((r_vox,) * 3, -1.0, _np.float32)
+        weight = _np.zeros((r_vox,) * 3, _np.float32)
+        rgb = _np.zeros((r_vox,) * 3 + (3,), _np.float32)
+    else:
+        tsdf, weight, rgb = (a.copy() for a in dense)
+    pts = _np.asarray(points, _np.float32)
+    cols = _np.asarray(colors, _np.float32)
+    val = _np.asarray(valid, bool)
+    dr = _np.asarray(dirs, _np.float32)
+    origin = _np.asarray(origin, _np.float32)
+    voxel = _np.float32(voxel_size)
+    trunc = _np.float32(params.trunc_voxels)
+
+    g = (pts - origin[None, :]) / voxel
+    v0 = _np.floor(g).astype(_np.int64)
+    num = _np.zeros_like(tsdf)
+    den = _np.zeros_like(weight)
+    rgbnum = _np.zeros_like(rgb)
+    for off in _window_offsets(params.splat_radius):
+        vox = v0 + off[None, :]
+        ok = val & _np.all((vox >= 0) & (vox < r_vox), axis=-1)
+        center = vox.astype(_np.float32) + _np.float32(0.5)
+        sdf = _np.sum((center - g) * dr, axis=-1, dtype=_np.float32)
+        u = _np.clip(sdf / trunc, -1.0, 1.0).astype(_np.float32)
+        w = _np.where(ok, _np.maximum(1.0 - _np.abs(u), 0.0),
+                      0.0).astype(_np.float32)
+        ok = ok & (w > 0.0)
+        ix, iy, iz = (vox[ok, i] for i in range(3))
+        _np.add.at(num, (ix, iy, iz), w[ok] * u[ok])
+        _np.add.at(den, (ix, iy, iz), w[ok])
+        _np.add.at(rgbnum, (ix, iy, iz), w[ok, None] * cols[ok])
+
+    wsum = weight + den
+    safe = _np.maximum(wsum, _np.float32(1e-12))
+    tsdf = _np.where(den > 0.0, (tsdf * weight + num) / safe, tsdf)
+    rgb = _np.where((den > 0.0)[..., None],
+                    (rgb * weight[..., None] + rgbnum) / safe[..., None],
+                    rgb)
+    weight = _np.minimum(wsum, _np.float32(params.max_weight))
+    return tsdf.astype(_np.float32), weight.astype(_np.float32), \
+        rgb.astype(_np.float32)
+
+
+def state_to_dense(state: TSDFState, params: TSDFParams):
+    """Brick-pool state → dense ``(tsdf, weight, rgb)`` host arrays (the
+    oracle's layout), for parity comparison and debugging."""
+    r_vox = params.resolution
+    tsdf = _np.full((r_vox,) * 3, -1.0, _np.float32)
+    weight = _np.zeros((r_vox,) * 3, _np.float32)
+    rgb = _np.zeros((r_vox,) * 3 + (3,), _np.float32)
+    n = int(state.n_bricks)
+    coords = _np.asarray(state.brick_coords)[:n]
+    t = _np.asarray(state.tsdf)[:n].reshape(n, BS, BS, BS)
+    w = _np.asarray(state.weight)[:n].reshape(n, BS, BS, BS)
+    c = _np.asarray(state.rgb)[:n].reshape(n, BS, BS, BS, 3)
+    for i, (bx, by, bz) in enumerate(coords):
+        sl = (slice(bx * BS, bx * BS + BS), slice(by * BS, by * BS + BS),
+              slice(bz * BS, bz * BS + BS))
+        tsdf[sl] = t[i]
+        weight[sl] = w[i]
+        rgb[sl] = c[i]
+    return tsdf, weight, rgb

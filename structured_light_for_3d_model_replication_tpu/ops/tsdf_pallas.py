@@ -1,0 +1,83 @@
+"""Pallas TPU kernel for the TSDF running-average combine.
+
+The XLA form of the per-stop fold (`tsdf._combine`) materializes the
+weight sum, its safe reciprocal and the where-masks as separate HBM
+intermediates over five (cap, 512)/(cap, 512, 3) arrays. This kernel
+fuses the whole fold into one streamed pass over brick blocks: every
+intermediate lives in VMEM, each brick row is read and written exactly
+once. The 512-voxel brick minor dimension is 4 native (8, 128) f32
+lanes (the flat-brick tile rule of `ops/poisson_pallas.py`), and the
+RGB channels enter as three separate (cap, 512) planes so every operand
+in the kernel shares that layout — no 3-minor relayouts for Mosaic.
+
+Numerical contract pinned against the XLA form in interpret mode by
+tests/test_fusion.py; the XLA path stays the oracle and CPU fallback
+(dispatch in `tsdf.integrate` behind ``_backend.tpu_backend()``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import _backend
+from .poisson_sparse import BS
+
+_V = BS ** 3
+
+
+def available() -> bool:
+    return _backend.tpu_backend()
+
+
+def _kernel(wmax_ref, tsdf_ref, w_ref, num_ref, den_ref,
+            r_ref, g_ref, b_ref, rn_ref, gn_ref, bn_ref,
+            tsdf_out, w_out, r_out, g_out, b_out):
+    t = tsdf_ref[...]
+    w = w_ref[...]
+    den = den_ref[...]
+    wsum = w + den
+    inv = 1.0 / jnp.maximum(wsum, 1e-12)
+    hit = den > 0.0
+    tsdf_out[...] = jnp.where(hit, (t * w + num_ref[...]) * inv, t)
+    w_out[...] = jnp.minimum(wsum, wmax_ref[...])
+    for c_ref, cn_ref, c_out in ((r_ref, rn_ref, r_out),
+                                 (g_ref, gn_ref, g_out),
+                                 (b_ref, bn_ref, b_out)):
+        c = c_ref[...]
+        c_out[...] = jnp.where(hit, (c * w + cn_ref[...]) * inv, c)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "cb"))
+def combine_pallas(tsdf, weight, rgb, num, den, rgbnum, max_weight,
+                   interpret: bool = False, cb: int = 8):
+    """Fused running-average fold; same contract as ``tsdf._combine``.
+
+    ``tsdf``/``weight``/``num``/``den`` are (cap, 512) f32; ``rgb``/
+    ``rgbnum`` (cap, 512, 3). ``cb`` bricks per grid step (off-multiple
+    capacities fall back to cb=1; the usual power-of-two ≥ 8 capacities
+    take the full-speed path)."""
+    cap = tsdf.shape[0]
+    if cap % cb:
+        # Integration must DEGRADE, never raise (the fusion contract):
+        # an off-multiple capacity falls back to one-brick grid steps —
+        # slower, same numbers.
+        cb = 1
+    wmax = jnp.full((cb, _V), max_weight, jnp.float32)
+    chans = [rgb[:, :, i] for i in range(3)]
+    nchans = [rgbnum[:, :, i] for i in range(3)]
+    spec = pl.BlockSpec((cb, _V), lambda c: (c, 0))
+    outs = pl.pallas_call(
+        _kernel,
+        grid=(cap // cb,),
+        in_specs=[pl.BlockSpec((cb, _V), lambda c: (0, 0))]
+        + [spec] * 10,
+        out_specs=[spec] * 5,
+        out_shape=[jax.ShapeDtypeStruct((cap, _V), jnp.float32)] * 5,
+        interpret=interpret,
+    )(wmax, tsdf, weight, num, den, *chans, *nchans)
+    t_new, w_new, r_new, g_new, b_new = outs
+    return t_new, w_new, jnp.stack([r_new, g_new, b_new], axis=-1)

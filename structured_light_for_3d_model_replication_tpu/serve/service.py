@@ -69,6 +69,7 @@ log = get_logger(__name__)
 _PRIORITY_NAMES = {"high": 0, "normal": 1, "low": 2}
 _CONTENT_TYPES = {"ply": "application/x-ply",
                   "stl": "model/stl",
+                  "mesh_ply": "application/x-ply",  # vertex-colored mesh
                   "json": "application/json"}  # session-stop payloads
 
 
@@ -88,7 +89,18 @@ class ServeConfig:
     batch_sizes: tuple = (1, 2, 4, 8)
     max_cache_entries: int = 32
     warmup: bool = True            # precompile buckets × batch sizes
+    # Warm the SESSION-lane jit programs too (stream/warmup.py): per-stop
+    # registration, windowed refine, model fuse and the preview chain
+    # compile at replica start instead of inside the first session — the
+    # failover window a survivor pays when it adopts a dead replica's
+    # session (ROADMAP; asserted by the fleet chaos gate). Only applies
+    # when ``warmup`` is on.
+    warmup_sessions: bool = True
     mesh_depth: int = 7            # STL results: Poisson depth
+    # Scene representation for one-shot STL/mesh_ply results
+    # (docs/MESHING.md): "poisson" watertight print path, "tsdf" the
+    # fused colored-surface path (fusion/).
+    mesh_representation: str = "poisson"
     completed_cap: int = 256       # terminal jobs kept for /status///result
     # Byte budget for retained result payloads (a 1080p PLY is ~30 MB —
     # 256 of those would pin ~8 GB; the count cap alone doesn't bound
@@ -292,7 +304,9 @@ class ReconstructionService:
                             gates=self.config.gates,
                             mesh_depth=self.config.mesh_depth,
                             registry=self.registry, tracer=self.tracer,
-                            name=name, governor=self.governor)
+                            name=name, governor=self.governor,
+                            mesh_representation=self.config
+                            .mesh_representation)
 
     def _restart_worker(self, wedged: DeviceWorker) -> DeviceWorker:
         """Watchdog callback: replace one wedged worker with a fresh
@@ -358,6 +372,19 @@ class ReconstructionService:
                     keys, self.config.batch_sizes)
                 log.info("warmup: %d programs in %.1fs",
                          len(self._warmup_report), time.monotonic() - t0)
+                if self.config.warmup_sessions:
+                    # Session-lane warmup (stream/warmup.py): an adopted
+                    # or recovered session must find every per-stop
+                    # program already compiled — the fleet failover
+                    # window is otherwise dominated by these compiles.
+                    from ..stream.warmup import warm_session_programs
+
+                    for h, w in self.config.buckets:
+                        self._warmup_report[f"session:{h}x{w}"] = \
+                            warm_session_programs(
+                                self.config.stream, h * w,
+                                col_bits=self.config.proj.col_bits,
+                                row_bits=self.config.proj.row_bits)
             if recover_from:
                 self._recover()
         except BaseException:
@@ -639,7 +666,8 @@ class ReconstructionService:
         cfg = self.config
         return (f"{cfg.proj.col_bits}/{cfg.proj.row_bits}/"
                 f"{cfg.decode_cfg}/{cfg.tri_cfg}/"
-                f"mesh{cfg.mesh_depth}/{result_format}")
+                f"mesh{cfg.mesh_depth}/{cfg.mesh_representation}/"
+                f"{result_format}")
 
     def submit_array(self, stack: np.ndarray, result_format: str = "ply",
                      priority="normal",
@@ -879,9 +907,9 @@ class ReconstructionService:
         final artifact, and land it as a terminal job in the ordinary
         registry — the existing ``GET /result`` path serves it. Runs on
         the calling thread (one full pose solve + merge + mesh)."""
-        if result_format not in ("ply", "stl"):
+        if result_format not in ("ply", "stl", "mesh_ply"):
             raise StackFormatError(
-                f"result_format must be 'ply' or 'stl', "
+                f"result_format must be 'ply', 'stl' or 'mesh_ply', "
                 f"got {result_format!r}")
         entry = self.sessions.get(session_id)
         cfg = self.config
@@ -902,13 +930,23 @@ class ReconstructionService:
                     f"session {session_id} finalized but its result "
                     "job fell out of the bounded registry — the "
                     "artifact is gone; re-scan")
-            result = entry.session.finalize(mesh=result_format == "stl")
+            result = entry.session.finalize(
+                mesh=result_format in ("stl", "mesh_ply"))
             if result_format == "stl":
                 from .worker import _stl_bytes
 
                 payload = _stl_bytes(result.mesh)
                 meta = {"vertices": int(len(result.mesh.vertices)),
                         "faces": int(len(result.mesh.faces))}
+            elif result_format == "mesh_ply":
+                # Vertex-colored final mesh (colors survive only under
+                # the TSDF representation; Poisson meshes carry none).
+                from .worker import _mesh_ply_bytes
+
+                payload = _mesh_ply_bytes(result.mesh)
+                meta = {"vertices": int(len(result.mesh.vertices)),
+                        "faces": int(len(result.mesh.faces)),
+                        "colored": result.mesh.vertex_colors is not None}
             else:
                 from .worker import _ply_bytes
 

@@ -46,9 +46,13 @@ log = get_logger(__name__)
 #: ``POST /session`` body keys a client may override per session. The
 #: merge/registration surface stays server-side (it keys compiled
 #: programs; per-session drift would mint fresh compiles — exactly what
-#: the warmed steady state forbids).
+#: the warmed steady state forbids). ``representation`` picks the
+#: preview/final scene representation ("poisson" | "tsdf" — the fusion/
+#: dispatch, docs/STREAMING.md; a non-default choice compiles its
+#: programs on first use unless the replica warmed that lane too).
 SESSION_OPTION_KEYS = ("preview_every", "preview_depth", "final_depth",
-                       "expected_stops", "method", "covis")
+                       "expected_stops", "method", "covis",
+                       "representation")
 
 
 class SessionLimitError(JobRejected):
@@ -206,6 +210,11 @@ class SessionManager:
             raise StackFormatError(
                 f"method must be 'sequential' or 'posegraph', got "
                 f"{overrides['method']!r}")
+        if "representation" in overrides \
+                and overrides["representation"] not in ("poisson", "tsdf"):
+            raise StackFormatError(
+                f"representation must be 'poisson' or 'tsdf', got "
+                f"{overrides['representation']!r}")
         for k in ("preview_every", "preview_depth", "final_depth",
                   "expected_stops"):
             if k in overrides:
@@ -290,12 +299,17 @@ class SessionManager:
     def _journal_end(self, session_id: str, reason: str) -> None:
         # The ending replica's id rides the op: the handoff sink
         # ignores an end from a NON-owner (a stale double-hosted copy
-        # expiring after its session was adopted elsewhere).
+        # expiring after its session was adopted elsewhere). Always
+        # SYNC: once this replica denies the session, the definitive-404
+        # contract needs the end tombstone ON the handoff stream before
+        # the router's adoption sweep can read it (a lazy end let a
+        # survivor "adopt" the half-ended stream) — and every caller is
+        # already on a path that blocks on a sync WAL append anyway.
         if self.store is not None:
             self.store.append({"op": "session_end",
                                "session_id": session_id,
                                "reason": reason,
-                               "replica": self.replica_id}, sync=False)
+                               "replica": self.replica_id})
 
     def get(self, session_id: str) -> ServeSession:
         with self._lock:

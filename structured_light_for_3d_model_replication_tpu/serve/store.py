@@ -295,14 +295,14 @@ class SessionStreamStore:
         tombstone line. The tombstone is POSITIVE evidence of the end —
         recovery must distinguish "ended somewhere" (tombstone) from
         "the mirror never wrote" (missing stream), because the latter
-        means the local WAL is the only copy and must recover."""
+        means the local WAL is the only copy and must recover.
+
+        Tombstone FIRST, blob unlinks after: a concurrent reader (the
+        router's adoption sweep) must see either the fully-live stream
+        or the tombstone — never a live head whose blobs are already
+        gone, which an adopter would dutifully "adopt" as an all-
+        degraded empty session."""
         info = self._read(session_id, include_failed=True)
-        if info is not None:
-            for _, blob in info.stops:
-                try:
-                    os.remove(self._blob_path(blob))
-                except OSError:
-                    log.debug("handoff blob %s already gone", blob)
         path = self._stream_path(session_id)
         tmp = f"{path}.tmp-{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
@@ -311,6 +311,12 @@ class SessionStreamStore:
                                 "reason": reason,
                                 "t_wall": time.time()}) + "\n")
         os.replace(tmp, path)
+        if info is not None:
+            for _, blob in info.stops:
+                try:
+                    os.remove(self._blob_path(blob))
+                except OSError:
+                    log.debug("handoff blob %s already gone", blob)
 
     def drop_session(self, session_id: str) -> None:
         """Hard-remove a stream file (the origin replica calls this

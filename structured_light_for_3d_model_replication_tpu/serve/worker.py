@@ -46,6 +46,14 @@ def _stl_bytes(mesh) -> bytes:
     return buf.getvalue()
 
 
+def _mesh_ply_bytes(mesh) -> bytes:
+    from ..io.ply import write_ply_mesh
+
+    buf = io.BytesIO()
+    write_ply_mesh(buf, mesh)
+    return buf.getvalue()
+
+
 class DeviceWorker:
     """Thread running the batch → launch → postprocess loop."""
 
@@ -55,11 +63,12 @@ class DeviceWorker:
                  registry: "trace.MetricsRegistry | None" = None,
                  tracer: "trace.Tracer | None" = None,
                  name: str = "serve-worker",
-                 governor=None):
+                 governor=None, mesh_representation: str = "poisson"):
         self.batcher = batcher
         self.cache = cache
         self.gates = gates
         self.mesh_depth = mesh_depth
+        self.mesh_representation = mesh_representation
         self.registry = registry if registry is not None else trace.REGISTRY
         self.tracer = tracer if tracer is not None else trace.GLOBAL
         # Overload governor (serve/governor.py): fed worker outcomes for
@@ -252,17 +261,23 @@ class DeviceWorker:
         meta = {"points": int(len(cloud)), "coverage": round(coverage, 4)}
         if job.result_format == "ply":
             return _ply_bytes(cloud), meta
-        # STL: the models/meshing tail (normals → sparse/dense Poisson →
-        # extraction → weld) on this job's cloud.
+        # STL / mesh_ply: the models/meshing tail (normals → solve →
+        # extraction → weld) on this job's cloud. ``mesh_ply`` keeps the
+        # representation's vertex colors (fusion/; STL cannot carry
+        # them).
         from ..models import meshing
 
         mesh = meshing.mesh_from_cloud(
             cloud, mode="watertight", depth=self.mesh_depth,
-            quantile_trim=0.0)
+            quantile_trim=0.0,
+            representation=self.mesh_representation)
         meta.update(vertices=int(len(mesh.vertices)),
-                    faces=int(len(mesh.faces)))
+                    faces=int(len(mesh.faces)),
+                    representation=self.mesh_representation)
         if len(mesh.faces) == 0:
             raise StopQualityError(
                 f"meshing produced 0 faces from {len(cloud)} points — "
                 "cloud too sparse for a watertight surface")
+        if job.result_format == "mesh_ply":
+            return _mesh_ply_bytes(mesh), meta
         return _stl_bytes(mesh), meta

@@ -28,12 +28,15 @@ Entry points: :class:`~.session.IncrementalSession` (in-process),
 live capture. docs/STREAMING.md has the architecture and semantics.
 """
 
-from .preview import PreviewMesher
+from .preview import PreviewMesher, make_previewer
 from .session import IncrementalSession, StopResult, StreamParams
+from .warmup import warm_session_programs
 
 __all__ = [
     "IncrementalSession",
     "PreviewMesher",
     "StopResult",
     "StreamParams",
+    "make_previewer",
+    "warm_session_programs",
 ]

@@ -62,6 +62,14 @@ class PreviewMesher:
     buffer (static ``cap`` slots + valid mask) and returns a host
     :class:`TriangleMesh`. All device work happens at shapes fixed by
     ``(points, depth)`` — stop count never appears in a shape.
+
+    Warm start: the previous preview's χ grid seeds the next solve's CG
+    (`poisson.reconstruct(x0=...)`). Between stops the model barely
+    moves, so the residual stop fires after a fraction of the cold
+    iteration count — ``last_cg_iters`` exposes the measured count (the
+    warm-start assertion in tests/test_stream.py). The grid's world
+    mapping is recomputed per call, so a shifting bbox only WEAKENS the
+    guess (CG converges from any x0), never corrupts it.
     """
 
     def __init__(self, points: int = 8192, depth: int = 6,
@@ -76,18 +84,50 @@ class PreviewMesher:
         self.quantile_trim = float(quantile_trim)
         self.normals_k = int(normals_k)
         self.cg_iters = int(cg_iters)
+        self.last_cg_iters: int | None = None
+        self._last_chi = None
 
     def __call__(self, model_pts, model_valid) -> TriangleMesh:
         p, normals, v = _sample_normals_fn(self.points, self.normals_k)(
             model_pts, model_valid)
-        grid = poisson.reconstruct(p, normals, valid=v, depth=self.depth,
-                                   cg_iters=self.cg_iters)
+        grid, iters = poisson.reconstruct(
+            p, normals, valid=v, depth=self.depth,
+            cg_iters=self.cg_iters, x0=self._last_chi, return_iters=True)
+        self.last_cg_iters = iters
+        self._last_chi = grid.chi
         mesh = marching.extract(grid, quantile_trim=self.quantile_trim)
-        log.debug("preview: %d sample slots -> %d faces (depth %d)",
-                  self.points, len(mesh.faces), self.depth)
+        log.debug("preview: %d sample slots -> %d faces (depth %d, "
+                  "%d CG iters)", self.points, len(mesh.faces),
+                  self.depth, iters)
         return mesh
+
+    @property
+    def last_chi(self):
+        """Latest preview χ grid — finalize warm-starts from it when the
+        final solve runs at the SAME dense depth (stream/session.py)."""
+        return self._last_chi
 
     @staticmethod
     def empty() -> TriangleMesh:
         return TriangleMesh(vertices=np.zeros((0, 3), np.float32),
                             faces=np.zeros((0, 3), np.int32))
+
+
+def make_previewer(params):
+    """StreamParams → the session's previewer: the coarse-Poisson
+    re-solver (default) or the incremental TSDF mesher
+    (``representation="tsdf"``, `fusion/preview.py`; both share the
+    ``__call__(model_pts, model_valid) -> TriangleMesh`` contract)."""
+    if params.representation == "tsdf":
+        from ..fusion.preview import TSDFPreviewMesher
+        from ..ops.tsdf import TSDFParams
+
+        return TSDFPreviewMesher(
+            voxel_size_hint=params.tsdf_voxel_scale
+            * params.merge.voxel_size,
+            params=TSDFParams(grid_depth=params.tsdf_grid_depth,
+                              max_bricks=params.tsdf_max_bricks),
+            quantile_trim=params.preview_trim)
+    return PreviewMesher(points=params.preview_points,
+                         depth=params.preview_depth,
+                         quantile_trim=params.preview_trim)

@@ -56,7 +56,7 @@ from ..utils import events, trace
 from ..utils.log import get_logger
 from ..models import merge as merge_mod
 from ..models import scan360 as scan360_mod
-from .preview import PreviewMesher
+from .preview import make_previewer
 
 log = get_logger(__name__)
 
@@ -103,6 +103,15 @@ class StreamParams:
     preview_points: int = 8192
     preview_depth: int = 6
     preview_trim: float = 0.05
+    # Scene representation for previews AND the final mesh dispatch
+    # (docs/MESHING.md): "poisson" = coarse re-solve previews + the
+    # watertight print path; "tsdf" = incremental fused-volume previews
+    # (fusion/, per-stop integration instead of a re-solve) and a
+    # vertex-COLORED final mesh.
+    representation: str = "poisson"
+    tsdf_voxel_scale: float = 2.0       # TSDF voxel = scale × merge voxel
+    tsdf_grid_depth: int = 8
+    tsdf_max_bricks: int = 4096
     # -- finalize ---------------------------------------------------------
     final_depth: int = 8
     final_trim: float = 0.0
@@ -264,6 +273,9 @@ class IncrementalSession:
         if params.method not in ("sequential", "posegraph"):
             raise ValueError(f"method must be 'sequential' or 'posegraph',"
                              f" got {params.method!r}")
+        if params.representation not in ("poisson", "tsdf"):
+            raise ValueError(f"representation must be 'poisson' or "
+                             f"'tsdf', got {params.representation!r}")
         self.calib = calib
         self.col_bits = col_bits
         self.row_bits = row_bits
@@ -295,9 +307,8 @@ class IncrementalSession:
         self._model_points = 0
         self._model_voxels = np.empty(0, np.int64)
         self._prev_cam_voxels = np.empty(0, np.int64)
-        self._mesher = PreviewMesher(points=params.preview_points,
-                                     depth=params.preview_depth,
-                                     quantile_trim=params.preview_trim)
+        self._mesher = make_previewer(params)
+        self._last_integrate_s = 0.0   # tsdf: this stop's fuse seconds
         self.preview = None
         self.preview_meta: dict = {}
         # Overload hook (serve/governor.py): while True, progressive
@@ -331,6 +342,7 @@ class IncrementalSession:
     def status_dict(self) -> dict:
         return {
             "scan_id": self.scan_id,
+            "representation": self.params.representation,
             "stops_fused": self.stops_fused,
             "stops_skipped": self.stops_skipped,
             "skipped": {str(k): v[0] for k, v in self._skipped.items()},
@@ -609,7 +621,25 @@ class IncrementalSession:
                         "(%d voxels) — previews sample a stratified "
                         "subset", p.model_cap, n_model)
         self._model_points = min(n_model, p.model_cap)
-        return np.asarray(moved)
+        moved_np = np.asarray(moved)
+        if p.representation == "tsdf":
+            # Incremental TSDF integration (fusion/preview.py): the
+            # stop's pose-transformed view fuses into the persistent
+            # volume here, so the preview is a pure extraction — no
+            # per-stop re-solve. The camera center in the model frame
+            # is the stop pose's translation (decode triangulates in
+            # the camera frame, camera at the origin). The valid-masked
+            # host copy only seeds the volume's lazy bounds — skip the
+            # per-stop fancy-index once the volume exists. Timed (the
+            # returned brick count blocks on the program) so preview
+            # latency can be reported as integrate + extract.
+            t_int = time.monotonic()
+            self._mesher.integrate_stop(
+                moved, sub_col, sub_val, self._poses[-1][:3, 3],
+                moved_np=moved_np[np.asarray(sub_val)]
+                if self._mesher.volume is None else None)
+            self._last_integrate_s = time.monotonic() - t_int
+        return moved_np
 
     def _maybe_preview(self, label: int) -> bool:
         p = self.params
@@ -633,8 +663,14 @@ class IncrementalSession:
             "faces": int(len(mesh.faces)),
             "vertices": int(len(mesh.vertices)),
             "depth": p.preview_depth,
+            "representation": p.representation,
             "model_points": self._model_points,
             "preview_s": round(dt, 3),
+            # TSDF: the per-stop volume fuse this preview extracts from
+            # (0.0 under poisson, whose __call__ re-solves inside
+            # preview_s) — preview_s + integrate_s is the representation-
+            # fair per-stop latency bench [11] compares.
+            "integrate_s": round(self._last_integrate_s, 3),
         }
         events.record("preview_emitted", faces=int(len(mesh.faces)),
                       depth=p.preview_depth, stops_fused=n,
@@ -764,9 +800,17 @@ class IncrementalSession:
         if want_mesh:
             from ..models import meshing
 
+            # Dense-path CG warm start: when finalize solves at the SAME
+            # dense depth the previews ran, the last preview χ is a
+            # near-solution (the model the previews watched IS the
+            # final model, coarser sampled) — thread it through.
+            x0 = getattr(self._mesher, "last_chi", None) \
+                if p.final_depth == p.preview_depth else None
             final_mesh = meshing.mesh_from_cloud(
                 merged, mode="watertight", depth=p.final_depth,
-                quantile_trim=p.final_trim)
+                quantile_trim=p.final_trim,
+                representation=p.representation,
+                tsdf_max_bricks=p.tsdf_max_bricks, cg_x0=x0)
         stats = {
             "stops_fused": n,
             "stops_skipped": len(self._skipped),

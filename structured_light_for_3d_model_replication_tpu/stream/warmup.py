@@ -1,0 +1,106 @@
+"""Session-lane program warmup: compile the streaming chain at start.
+
+The serve batch lanes precompile at startup (`serve/cache.warmup`), but
+a session's device programs — per-stop registration, the windowed pose
+refine, the model fuse, the preview chain — historically compiled inside
+the FIRST session that exercised them. On a fleet that is exactly the
+failover window: a survivor adopting a dead replica's session paid
+~30–40 s of session-lane jit compiles before the first re-pinned stop
+fused (ROADMAP). This module runs a tiny deterministic 3-stop synthetic
+ring through a throwaway :class:`~.session.IncrementalSession` at the
+REAL bucket pixel count and the REAL session params, so every program a
+recovered/adopted session will launch is already in the jit cache:
+
+* stop 1 — subsample + first fuse + first preview;
+* stop 2 — registration preprocess + edge ICP + consensus;
+* stop 3 — the fixed-window pose-graph refine (needs ≥ 2 edges).
+
+Not warmed (shapes depend on the final stop count, finalize-only):
+the full-ring pose solve, the axis-prior re-pass and the finalize
+merge. Those run once per session at finalize, outside the failover
+window the fleet chaos gate measures.
+
+The synthetic stops are a rotated sphere cap — enough structure for
+RANSAC/ICP to run its full program graph; the result is discarded.
+Covisibility gating is host-side (no programs) but the stops rotate by
+the ring step anyway so none is skipped as a duplicate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from ..utils.log import get_logger
+from .session import IncrementalSession, StreamParams
+
+log = get_logger(__name__)
+
+
+def _synthetic_stop(n_pixels: int, m_valid: int, step_deg: float,
+                    k: int) -> tuple:
+    """One deterministic fake decoded stop: ``m_valid`` points on a
+    bumpy sphere (radius 80 @ z=500, the synthetic-rig scale), rotated
+    ``k`` turntable steps; the remaining slots are invalid zeros."""
+    m = min(m_valid, n_pixels)
+    i = np.arange(m, dtype=np.float64)
+    phi = np.pi * (3.0 - np.sqrt(5.0))
+    y = 1.0 - 2.0 * (i + 0.5) / m
+    r = np.sqrt(np.maximum(1.0 - y * y, 0.0))
+    pts = np.stack([np.cos(phi * i) * r, y, np.sin(phi * i) * r], axis=1)
+    # Low-frequency bumps give ICP/FPFH non-degenerate structure.
+    pts *= (1.0 + 0.1 * np.sin(3.0 * pts[:, :1]) * np.cos(2.0 * pts[:, 2:]))
+    a = np.deg2rad(step_deg) * k
+    rot = np.array([[np.cos(a), 0.0, np.sin(a)],
+                    [0.0, 1.0, 0.0],
+                    [-np.sin(a), 0.0, np.cos(a)]])
+    pts = pts @ rot.T * 80.0 + np.array([0.0, 0.0, 500.0])
+    points = np.zeros((n_pixels, 3), np.float32)
+    points[:m] = pts.astype(np.float32)
+    # uint8, NOT float: decode hands sessions uint8 colors, and the
+    # subsample/fuse programs are keyed on that dtype — a float warmup
+    # would compile a lane no real stop ever uses.
+    colors = np.zeros((n_pixels, 3), np.uint8)
+    colors[:m] = 128
+    valid = np.zeros(n_pixels, bool)
+    valid[:m] = True
+    return points, colors, valid
+
+
+def warm_session_programs(params: StreamParams, n_pixels: int,
+                          col_bits: int = 8, row_bits: int = 8,
+                          stops: int = 3) -> dict:
+    """Compile the session-lane programs for ``(params, n_pixels)``.
+
+    Returns a small report dict (seconds, stops, representation). Safe
+    to call more than once — warm programs make reruns near-free (the
+    jit cache is process-global, exactly why this works)."""
+    t0 = time.monotonic()
+    # Gates and covisibility are host-side (they key no programs);
+    # disabling them guarantees every synthetic stop actually FUSES —
+    # a skipped stop would leave its programs cold.
+    wp = dataclasses.replace(params, gates=None, covis=False,
+                             preview_every=1)
+    sess = IncrementalSession(
+        calib=None, col_bits=col_bits, row_bits=row_bits, params=wp,
+        scan_id="warmup-session")
+    m_valid = min(n_pixels, 8192)
+    # step_deg may be None (ring step unknown until a real session);
+    # the synthetic rotation only shapes geometry, never a program.
+    step = wp.merge.step_deg if wp.merge.step_deg else 15.0
+    for k in range(max(3, int(stops))):
+        points, colors, valid = _synthetic_stop(
+            n_pixels, m_valid, step, k)
+        sess.add_decoded(points, colors, valid)
+    report = {
+        "seconds": round(time.monotonic() - t0, 3),
+        "stops": sess.stops_fused,
+        "pixels": int(n_pixels),
+        "representation": wp.representation,
+    }
+    log.info("session-lane warmup: %d synthetic stops @ %d px "
+             "(%s previews) in %.1fs", report["stops"], n_pixels,
+             wp.representation, report["seconds"])
+    return report

@@ -247,3 +247,44 @@ def test_fleet_headline_lines_and_direction(tmp_path, capsys):
     assert by_metric["fleet_scans_per_s"] == "REGRESSION"
     assert by_metric["fleet_failover_s"] == "REGRESSION"
     assert doc["regressions"] == 2
+
+
+def test_tsdf_headline_line_and_direction(tmp_path, capsys):
+    """Bench config [11] adds ``tsdf_preview_s`` — per-stop preview
+    latency, LOWER is better (a latency line, not throughput). The
+    trajectory tracks it from the headline line, the BENCH_DETAILS alias
+    lifts the same metric name, and --strict flags the latency going up."""
+    assert not bench_compare.higher_is_better("tsdf_preview_s")
+    tail = "\n".join([
+        _headline("full_360_scan_to_mesh_s", 5.9),
+        _headline("tsdf_preview_s", 0.05),
+        "[11] TSDF preview median 50 ms/stop vs Poisson 400 ms/stop",
+    ])
+    _round(tmp_path, 1, tail)
+    traj = bench_compare.load_history([str(tmp_path / "BENCH_r01.json")])
+    assert traj["tsdf_preview_s"] == [(1, 0.05)]
+
+    # A BENCH_DETAILS document maps config `tsdf_stream_preview` onto
+    # the same headline metric name via the alias table.
+    details = tmp_path / "details.json"
+    details.write_text(json.dumps({
+        "tsdf_stream_preview": {"value_s": 0.04,
+                                "poisson_preview_median_s": 0.4},
+    }), encoding="utf-8")
+    assert bench_compare.load_fresh(str(details)) == {
+        "tsdf_preview_s": 0.04}
+
+    # Preview latency DOWN: improvement, strict passes.
+    rc = _run(tmp_path, _fresh(tmp_path, "tsdf_preview_s", 0.04),
+              "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["rows"][0]["verdict"] == "improved"
+
+    # Preview latency UP beyond threshold: regression, strict fails.
+    rc = _run(tmp_path, _fresh(tmp_path, "tsdf_preview_s", 0.08),
+              "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["rows"][0]["verdict"] == "REGRESSION"
+    assert doc["regressions"] == 1

@@ -860,12 +860,18 @@ def test_fleet_chaos_gate(tmp_path, serve_stack, serve_ring):
         survivor_idxs = [i for i in range(3) if i != victim_idx]
 
         # Steady-state baseline on the survivors AFTER the warmup +
-        # session traffic: program-cache misses must not grow from here.
+        # session traffic: program-cache misses must not grow from here,
+        # and — the session-lane warmup contract — neither may the XLA
+        # compile counter while a survivor ADOPTS the victim's session
+        # (the failover window the ~30-40 s compile stall used to
+        # dominate; stream/warmup.py compiles that lane at start).
         survivors = {i: ServeClient(urls[i], timeout_s=60.0)
                      for i in survivor_idxs}
         misses0 = {i: _metric(c.metrics(),
                               "serve_program_cache_misses_total")
                    for i, c in survivors.items()}
+        compiles0 = {i: _metric(c.metrics(), "sl_compile_total")
+                     for i, c in survivors.items()}
 
         loader = threading.Thread(target=load_loop, daemon=True)
         loader.start()
@@ -905,6 +911,16 @@ def test_fleet_chaos_gate(tmp_path, serve_stack, serve_ring):
         failover_s = time.monotonic() - t_kill
         assert router.session_url(sid) != pin
         assert client.session_status(sid)["stops_fused"] == 3
+        # The adopting survivor replayed + fused the re-pinned session
+        # with ZERO session-lane compiles (warmed at replica start).
+        adopter_idx = ports.index(
+            int(router.session_url(sid).rsplit(":", 1)[1]))
+        adopter_compiles = _metric(survivors[adopter_idx].metrics(),
+                                   "sl_compile_total")
+        assert adopter_compiles == compiles0[adopter_idx], \
+            (f"survivor r{adopter_idx} compiled during session "
+             f"adoption: {compiles0[adopter_idx]} -> "
+             f"{adopter_compiles}")
 
         # With the victim DEAD its peer slot fails on every survivor:
         # duplicates still answer bounded (dead peer → breaker/backoff

@@ -1,0 +1,296 @@
+"""fusion/ TSDF scene representation: oracle parity, analytic surfaces,
+incremental==batch, degrade paths, recompile guard, dispatch, colored IO.
+
+Oracle strategy per SURVEY.md §4: the NumPy dense-grid integrator
+(`ops/tsdf.integrate_oracle`) pins the device brick-pool op at float32
+epsilon; analytic sphere/plane scenes bound the extracted iso-surface
+error in closed form.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.fusion import (
+    TSDFParams,
+    TSDFPreviewMesher,
+    TSDFVolume,
+    integrate_oracle,
+)
+from structured_light_for_3d_model_replication_tpu.io.ply import (
+    read_ply_mesh,
+    write_ply_mesh,
+)
+from structured_light_for_3d_model_replication_tpu.io.ply import PointCloud
+from structured_light_for_3d_model_replication_tpu.io.stl import TriangleMesh
+from structured_light_for_3d_model_replication_tpu.models import meshing
+from structured_light_for_3d_model_replication_tpu.ops import (
+    tsdf as tsdf_ops,
+)
+from structured_light_for_3d_model_replication_tpu.ops import (
+    tsdf_pallas,
+)
+from structured_light_for_3d_model_replication_tpu.utils import sanitize
+
+
+def fibonacci_sphere(n=4000, radius=1.0, center=(0.0, 0.0, 0.0)):
+    i = np.arange(n, dtype=np.float64)
+    phi = np.pi * (3.0 - np.sqrt(5.0))
+    y = 1.0 - 2.0 * (i + 0.5) / n
+    r = np.sqrt(np.maximum(1.0 - y * y, 0.0))
+    pts = np.stack([np.cos(phi * i) * r, y, np.sin(phi * i) * r], axis=1)
+    normals = pts.copy()
+    return (pts * radius + np.asarray(center)).astype(np.float32), \
+        normals.astype(np.float32)
+
+
+def _colored_sphere(n=4000):
+    pts, normals = fibonacci_sphere(n)
+    # Color = position-derived ramp, so interpolation errors would show.
+    cols = ((pts * 0.5 + 0.5) * 255.0).astype(np.float32)
+    return pts, normals, cols
+
+
+class TestOracleParity:
+    def test_device_matches_numpy_oracle(self, rng):
+        pts, normals, cols = _colored_sphere(3000)
+        valid = rng.random(3000) > 0.1          # exercise the mask
+        params = TSDFParams(grid_depth=6, max_bricks=1024)
+        vol = TSDFVolume.from_bounds(params, pts.min(0), pts.max(0))
+        dirs = -normals
+        vol.integrate_oriented(pts, cols, valid, normals)
+        oracle = integrate_oracle(None, pts, cols, valid, dirs,
+                                  vol.origin, vol.voxel_size, params)
+        t, w, rgb = oracle
+        td, wd, rgbd = vol.to_dense()
+        assert np.abs(w - wd).max() < 1e-4
+        obs = w > 0
+        assert obs.any()
+        assert np.abs((t - td)[obs]).max() < 1e-4
+        assert np.abs((rgb - rgbd)[obs]).max() < 1e-2  # 0-255 scale
+
+    def test_incremental_matches_batch(self):
+        """Integrating a clean ring stop-by-stop reassembles to the same
+        dense field as one batch integrate (weighted averages are
+        order-independent below the weight clamp; only scatter order
+        differs → allclose, the incremental-parity contract)."""
+        pts, normals, cols = _colored_sphere(4000)
+        valid = np.ones(4000, bool)
+        params = TSDFParams(grid_depth=6, max_bricks=1024)
+        batch = TSDFVolume.from_bounds(params, pts.min(0), pts.max(0))
+        batch.integrate_oriented(pts, cols, valid, normals)
+        incr = TSDFVolume(params, batch.origin, batch.voxel_size)
+        for k in range(4):                      # 4 "stops"
+            sl = slice(k * 1000, (k + 1) * 1000)
+            incr.integrate_oriented(pts[sl], cols[sl], valid[sl],
+                                    normals[sl])
+        tb, wb, rb = batch.to_dense()
+        ti, wi, ri = incr.to_dense()
+        np.testing.assert_allclose(wb, wi, atol=1e-4)
+        obs = wb > 1e-6
+        np.testing.assert_allclose(tb[obs], ti[obs], atol=1e-3)
+        np.testing.assert_allclose(rb[obs], ri[obs], atol=0.1)
+
+    def test_pallas_combine_interpret_parity(self, rng):
+        cap = 32
+        shp = (cap, 512)
+        tsdf = rng.normal(size=shp).astype(np.float32)
+        weight = rng.uniform(0, 5, size=shp).astype(np.float32)
+        rgb = rng.uniform(0, 255, size=shp + (3,)).astype(np.float32)
+        num = rng.normal(size=shp).astype(np.float32)
+        den = ((rng.uniform(size=shp) > 0.5)
+               * rng.uniform(0, 2, size=shp)).astype(np.float32)
+        rgbnum = rng.uniform(0, 255, size=shp + (3,)).astype(np.float32)
+        ref = tsdf_ops._combine(tsdf, weight, rgb, num, den, rgbnum,
+                                np.float32(8.0), use_pallas=False)
+        got = tsdf_pallas.combine_pallas(tsdf, weight, rgb, num, den,
+                                         rgbnum, np.float32(8.0),
+                                         interpret=True)
+        for a, b in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3)
+
+
+class TestAnalyticSurfaces:
+    def test_sphere_iso_surface_error(self):
+        pts, normals, cols = _colored_sphere(6000)
+        params = TSDFParams(grid_depth=6, max_bricks=1024)
+        vol = TSDFVolume.from_bounds(params, pts.min(0), pts.max(0))
+        vol.integrate_oriented(pts, cols, np.ones(6000, bool), normals)
+        mesh = vol.extract()
+        assert len(mesh.faces) > 500
+        d = np.linalg.norm(mesh.vertices, axis=1)
+        # Surface hugs the unit sphere to within a voxel.
+        assert abs(np.median(d) - 1.0) < vol.voxel_size
+        assert np.percentile(np.abs(d - 1.0), 90) < 2 * vol.voxel_size
+        # Colors interpolate the position ramp (uint8, 0-255).
+        assert mesh.vertex_colors is not None
+        expect = np.clip((mesh.vertices * 0.5 + 0.5) * 255.0, 0, 255)
+        err = np.abs(mesh.vertex_colors.astype(np.float64) - expect)
+        assert np.median(err) < 16.0
+
+    def test_plane_stays_open(self):
+        """A single observed plane extracts as a plane — no watertight
+        closure (the representation's open-scene capability)."""
+        rng = np.random.default_rng(3)
+        n = 5000
+        pts = np.stack([rng.uniform(-1, 1, n), rng.uniform(-1, 1, n),
+                        np.zeros(n)], axis=1).astype(np.float32)
+        normals = np.tile(np.asarray([[0.0, 0.0, 1.0]], np.float32),
+                          (n, 1))
+        params = TSDFParams(grid_depth=6, max_bricks=1024)
+        vol = TSDFVolume.from_bounds(params, pts.min(0), pts.max(0))
+        vol.integrate_oriented(pts, np.zeros((n, 3), np.float32),
+                               np.ones(n, bool), normals)
+        mesh = vol.extract(with_colors=False)
+        assert len(mesh.faces) > 100
+        # Every vertex near z=0: no back wall, no closure.
+        assert np.abs(mesh.vertices[:, 2]).max() < 2 * vol.voxel_size
+
+
+class TestDegradePaths:
+    def test_empty_volume_extracts_empty(self):
+        params = TSDFParams(grid_depth=5, max_bricks=64)
+        vol = TSDFVolume(params, np.zeros(3, np.float32), 0.1)
+        mesh = vol.extract()
+        assert len(mesh.vertices) == 0 and len(mesh.faces) == 0
+
+    def test_capacity_overflow_degrades_not_raises(self):
+        pts, normals, _ = _colored_sphere(4000)
+        params = TSDFParams(grid_depth=6, max_bricks=32)  # way too few
+        vol = TSDFVolume.from_bounds(params, pts.min(0), pts.max(0))
+        wanted = vol.integrate_oriented(
+            pts, np.zeros((4000, 3), np.float32), np.ones(4000, bool),
+            normals)
+        assert wanted > 32
+        assert vol.n_dropped == wanted - 32
+        assert vol.n_bricks == 32
+        mesh = vol.extract(with_colors=False)   # holes, but extracts
+        assert np.isfinite(mesh.vertices).all()
+
+    def test_out_of_volume_points_masked(self):
+        params = TSDFParams(grid_depth=5, max_bricks=64)
+        vol = TSDFVolume(params, np.zeros(3, np.float32), 0.1)
+        pts = np.asarray([[1e6, 1e6, 1e6], [0.5, 0.5, 0.5]], np.float32)
+        nr = np.tile(np.asarray([[0.0, 0.0, 1.0]], np.float32), (2, 1))
+        vol.integrate_oriented(pts, np.zeros((2, 3), np.float32),
+                               np.ones(2, bool), nr)
+        assert vol.n_bricks >= 1            # in-bounds point landed
+        t, w, _ = vol.to_dense()
+        assert np.isfinite(t).all()
+
+    def test_zero_steady_state_recompiles(self):
+        """After the first integrate+extract, further stops and
+        extractions at the same shapes compile NOTHING (the streaming
+        acceptance bar applied to the fusion lane)."""
+        pts, normals, cols = _colored_sphere(2048)
+        params = TSDFParams(grid_depth=6, max_bricks=512)
+        vol = TSDFVolume.from_bounds(params, pts.min(0), pts.max(0))
+
+        def stop(k):
+            sl = slice(k * 256, (k + 1) * 256)
+            vol.integrate_oriented(pts[sl], cols[sl],
+                                   np.ones(256, bool), normals[sl])
+            # Generous fixed floors: growth must not re-bucket.
+            return vol.extract(cells_floor=16384, tris_floor=131072)
+
+        stop(0)
+        stop(1)
+        with sanitize.no_compile_region("fusion-steady-state"):
+            for k in range(2, 6):
+                mesh = stop(k)
+        assert len(mesh.faces) > 0
+
+
+class TestRepresentationDispatch:
+    def test_mesh_from_cloud_tsdf_colored(self):
+        pts, _, cols = _colored_sphere(4000)
+        cloud = PointCloud(points=pts.copy(),
+                           colors=cols.astype(np.uint8))
+        mesh = meshing.mesh_from_cloud(cloud, depth=6,
+                                       representation="tsdf")
+        assert len(mesh.faces) > 500
+        assert mesh.vertex_colors is not None
+        assert mesh.vertex_colors.dtype == np.uint8
+        d = np.linalg.norm(mesh.vertices, axis=1)
+        assert abs(np.median(d) - 1.0) < 0.1
+
+    def test_uncolored_cloud_gives_uncolored_mesh(self):
+        pts, _, _ = _colored_sphere(3000)
+        mesh = meshing.mesh_from_cloud(PointCloud(points=pts.copy()),
+                                       depth=6, representation="tsdf")
+        assert len(mesh.faces) > 100
+        assert mesh.vertex_colors is None
+
+    def test_bad_representation_rejected_before_solve(self):
+        pts, _, _ = _colored_sphere(64)
+        with pytest.raises(ValueError, match="representation"):
+            meshing.mesh_from_cloud(PointCloud(points=pts),
+                                    representation="gaussian")
+
+
+class TestColoredMeshPly:
+    def _mesh(self):
+        v = np.asarray([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]],
+                       np.float32)
+        f = np.asarray([[0, 1, 2], [0, 2, 3], [0, 3, 1]], np.int32)
+        m = TriangleMesh(vertices=v, faces=f)
+        m.vertex_colors = np.asarray(
+            [[255, 0, 0], [0, 255, 0], [0, 0, 255], [40, 50, 60]],
+            np.uint8)
+        m.compute_vertex_normals()
+        return m
+
+    @pytest.mark.parametrize("binary", [True, False])
+    def test_roundtrip(self, tmp_path, binary):
+        m = self._mesh()
+        path = str(tmp_path / f"mesh-{binary}.ply")
+        write_ply_mesh(path, m, binary=binary)
+        back = read_ply_mesh(path)
+        np.testing.assert_array_equal(back.faces, m.faces)
+        np.testing.assert_allclose(back.vertices, m.vertices, atol=1e-5)
+        np.testing.assert_array_equal(back.vertex_colors,
+                                      m.vertex_colors)
+        assert back.vertex_normals is not None
+
+    def test_roundtrip_in_memory(self):
+        """The serving layer streams mesh PLY to HTTP — file objects
+        must work without a real fileno."""
+        m = self._mesh()
+        buf = io.BytesIO()
+        write_ply_mesh(buf, m)
+        back = read_ply_mesh(io.BytesIO(buf.getvalue()))
+        np.testing.assert_array_equal(back.faces, m.faces)
+        np.testing.assert_array_equal(back.vertex_colors,
+                                      m.vertex_colors)
+
+    def test_tsdf_mesh_survives_ply(self, tmp_path):
+        pts, _, cols = _colored_sphere(2000)
+        cloud = PointCloud(points=pts.copy(),
+                           colors=cols.astype(np.uint8))
+        mesh = meshing.mesh_from_cloud(cloud, depth=5,
+                                       representation="tsdf")
+        path = str(tmp_path / "sphere.ply")
+        write_ply_mesh(path, mesh)
+        back = read_ply_mesh(path)
+        assert len(back.faces) == len(mesh.faces)
+        assert back.vertex_colors is not None
+
+
+class TestPreviewMesher:
+    def test_incremental_preview_interface(self):
+        pts, normals, cols = _colored_sphere(2048)
+        pm = TSDFPreviewMesher(
+            voxel_size_hint=0.0,
+            params=TSDFParams(grid_depth=6, max_bricks=512))
+        assert len(pm(None, None).faces) == 0     # before any stop
+        cam = np.asarray([0.0, 0.0, 5.0], np.float32)
+        for k in range(4):
+            sl = slice(k * 512, (k + 1) * 512)
+            pm.integrate_stop(pts[sl], cols[sl], np.ones(512, bool),
+                              cam, moved_np=pts[sl])
+        mesh = pm(None, None)
+        assert len(mesh.faces) > 100
+        assert mesh.vertex_colors is not None
+        assert pm.stats()["stops_integrated"] == 4

@@ -244,9 +244,11 @@ def test_rtol_forwards_to_coarse_solve(rng, monkeypatch):
     seen = {}
     real = poisson._solve
 
-    def spy(points, normals, valid, res, iters, screen, rtol=3e-4):
+    def spy(points, normals, valid, x0, res, iters, screen, rtol=3e-4,
+            **kw):
         seen["rtol"] = float(rtol)
-        return real(points, normals, valid, res, iters, screen, rtol=rtol)
+        return real(points, normals, valid, x0, res, iters, screen,
+                    rtol=rtol, **kw)
 
     monkeypatch.setattr(poisson_sparse.dense_poisson, "_solve", spy)
     pts, nrm = _sphere_cloud(rng, 3_000)
@@ -269,8 +271,9 @@ def test_rtol_knob_stops_fine_cg_earlier(rng):
     (rhs, W, nbr, bvalid, bcoords, *_rest) = setup
     from structured_light_for_3d_model_replication_tpu.ops import poisson
 
-    coarse = poisson._solve(jnp.asarray(pts), jnp.asarray(nrm), valid,
-                            2 ** 6, 300, jnp.float32(4.0))
+    coarse, _ = poisson._solve(jnp.asarray(pts), jnp.asarray(nrm), valid,
+                               jnp.zeros((2 ** 6,) * 3, jnp.float32),
+                               2 ** 6, 300, jnp.float32(4.0))
     b, x0 = poisson_sparse._prolong_band(coarse.chi, rhs, nbr, bvalid,
                                          bcoords, 2 ** 7, 2 ** 6)
     _, it_tight = poisson_sparse._cg_sparse(b, W, x0, nbr, bvalid, 300,
@@ -402,8 +405,9 @@ def test_preconditioner_convergence_and_chi_parity(rng):
     (rhs, W, nbr, bvalid, bcoords, *_rest) = poisson_sparse._setup_sparse(
         jnp.asarray(pts), jnp.asarray(nrm), valid, R, 4096,
         jnp.float32(4.0))
-    coarse = poisson._solve(jnp.asarray(pts), jnp.asarray(nrm), valid,
-                            Rc, 200, jnp.float32(4.0), rtol=3e-4)
+    coarse, _ = poisson._solve(jnp.asarray(pts), jnp.asarray(nrm), valid,
+                               jnp.zeros((Rc,) * 3, jnp.float32),
+                               Rc, 200, jnp.float32(4.0), rtol=3e-4)
     b, x0 = poisson_sparse._prolong_band(coarse.chi, rhs, nbr, bvalid,
                                          bcoords, R, Rc)
     coarse_W = poisson.screen_weights(coarse.density, jnp.float32(4.0))
@@ -455,9 +459,11 @@ def test_deep_depth_auto_raises_coarse_grid(rng, monkeypatch):
     seen = []
     real = poisson._solve
 
-    def spy(points, normals, valid, res, iters, screen, rtol=3e-4):
+    def spy(points, normals, valid, x0, res, iters, screen, rtol=3e-4,
+            **kw):
         seen.append(res)
-        return real(points, normals, valid, res, iters, screen, rtol=rtol)
+        return real(points, normals, valid, x0, res, iters, screen,
+                    rtol=rtol, **kw)
 
     monkeypatch.setattr(poisson_sparse.dense_poisson, "_solve", spy)
     pts, nrm = _sphere_cloud(rng, 1500)

@@ -476,6 +476,39 @@ def test_serve_session_padded_stop_coverage(stream_service, serve_ring):
     assert cov_crop >= 0.8 * cov_full, (cov_crop, cov_full)
 
 
+def test_serve_session_tsdf_colored_mesh(stream_service, serve_ring):
+    """Session option representation="tsdf" + finalize format
+    "mesh_ply": the /result artifact is a vertex-colored PLY mesh."""
+    from structured_light_for_3d_model_replication_tpu.io.ply import (
+        read_ply_mesh,
+    )
+
+    _, client = stream_service
+    sid = client.create_session(representation="tsdf")
+    for stack in serve_ring[:2]:
+        st = client.wait(client.submit_stop(sid, stack), timeout_s=120.0)
+        assert st["status"] == "done", st
+    status = client.session_status(sid)
+    assert status["representation"] == "tsdf"
+    fin = client.finalize_session(sid, result_format="mesh_ply")
+    assert fin["result"]["colored"] is True, fin
+    body = client.result(fin["job_id"])
+    mesh = read_ply_mesh(io.BytesIO(body))
+    assert len(mesh.faces) > 0
+    assert mesh.vertex_colors is not None
+    client.delete_session(sid)
+
+
+def test_session_rejects_bad_representation(stream_service):
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClientError,
+    )
+
+    _, client = stream_service
+    with pytest.raises(ServeClientError, match="representation"):
+        client.create_session(representation="gaussian")
+
+
 def test_session_manager_ttl_expires_abandoned(monkeypatch):
     """An abandoned live session frees its slot after the idle TTL —
     max_sessions never wedges on crashed clients."""
@@ -497,6 +530,84 @@ def test_session_manager_ttl_expires_abandoned(monkeypatch):
     assert second.session_id != first.session_id
     with pytest.raises(Exception):
         mgr.get(first.session_id)         # expired entries are gone
+
+
+def test_preview_warm_start_fewer_cg_iters(rng):
+    """Stop N>1 warm-starts the preview CG from stop N-1's χ grid: on an
+    unchanged model the residual stop fires (near-)immediately — the
+    ROADMAP's streaming warm-start, measured."""
+    pts = rng.normal(size=(2048, 3)).astype(np.float32)
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    valid = np.ones(2048, bool)
+    pm = PreviewMesher(points=1024, depth=4, cg_iters=200)
+    pm(jnp.asarray(pts), jnp.asarray(valid))
+    cold = pm.last_cg_iters
+    pm(jnp.asarray(pts), jnp.asarray(valid))
+    warm = pm.last_cg_iters
+    assert cold is not None and cold > 3
+    assert warm < cold, (cold, warm)
+    assert warm <= 2          # exact solution in hand → immediate stop
+
+
+def test_tsdf_streaming_previews(single_stop_session, synth_scan,
+                                 small_calib):
+    """representation="tsdf": previews come from incremental volume
+    integration (fusion/), carry color, and finalize produces a
+    vertex-colored mesh."""
+    del single_stop_session   # ordering: share the decode programs
+    stack, _ = synth_scan
+    sp = dataclasses.replace(TINY_STREAM, representation="tsdf",
+                             tsdf_grid_depth=6, tsdf_max_bricks=1024,
+                             covis=False)
+    sess = IncrementalSession(small_calib, SMALL_PROJ.col_bits,
+                              SMALL_PROJ.row_bits, params=sp,
+                              scan_id="t-stream-tsdf")
+    r1 = sess.add_stop(stack)
+    assert r1.fused and r1.preview
+    assert sess.preview_meta["representation"] == "tsdf"
+    assert len(sess.preview.faces) > 0
+    assert sess.preview.vertex_colors is not None
+    assert sess.status_dict()["representation"] == "tsdf"
+    r2 = sess.add_stop(stack + np.uint8(1))
+    assert r2.fused
+    fin = sess.finalize(mesh=True)
+    assert fin.mesh.vertex_colors is not None
+    assert len(fin.mesh.faces) > 0
+
+
+def test_session_lane_warmup_precompiles(synth_scan, small_calib):
+    """After `warm_session_programs`, a FRESH session's first stops and
+    previews are pure execution (the replica-start warmup contract the
+    fleet failover rides; serve/service.py calls this per bucket)."""
+    from structured_light_for_3d_model_replication_tpu.stream import (
+        warm_session_programs,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        sanitize,
+    )
+
+    # Distinct knobs → programs unique to this test, so the assertion
+    # holds standalone, not just after the module's other sessions.
+    # covis off: the repeated view must FUSE (and register), not skip.
+    sp = dataclasses.replace(TINY_STREAM, window=4, preview_depth=3,
+                             covis=False)
+    stack, _ = synth_scan
+    pts, cols, vals = scan360.decode_stop(
+        stack, small_calib, SMALL_PROJ.col_bits, SMALL_PROJ.row_bits)
+    decoded = (np.asarray(pts), np.asarray(cols), np.asarray(vals))
+
+    warm_session_programs(sp, CAM_H * CAM_W,
+                          col_bits=SMALL_PROJ.col_bits,
+                          row_bits=SMALL_PROJ.row_bits)
+    sess = IncrementalSession(None, SMALL_PROJ.col_bits,
+                              SMALL_PROJ.row_bits, params=sp,
+                              scan_id="t-warmed")
+    with sanitize.no_compile_region("post-warmup-session"):
+        r = sess.add_decoded(*decoded)      # subsample + fuse + preview
+        r2 = sess.add_decoded(*decoded)     # + registration edge
+        r3 = sess.add_decoded(*decoded)     # + windowed pose refine
+    assert r.fused and r.preview
+    assert r2.fused and r3.fused
 
 
 def test_serve_session_errors(stream_service, serve_ring):

@@ -54,7 +54,14 @@ The remaining BASELINE configs are measured too and written to
 7. offered-load sweep against a local `serve/` instance (HTTP submit →
    bucketed continuous batcher → warmed program cache → device worker):
    synthetic 1080p stacks at concurrency 1/4/16, recording scans/s,
-   p50/p95 latency, and mean batch occupancy;
+   p50/p95 latency, and mean batch occupancy; 7b repeats the
+   measurement at 1/2/4/8 DEVICE LANES (serve/lanes.py — run with
+   XLA_FLAGS=--xla_force_host_platform_device_count=8 or on real
+   chips), recording scans/s per device count, per-lane job/occupancy
+   rows and device-memory gauges, asserting zero steady-state
+   recompiles per lane and ≥ 3× throughput at 8 devices vs 1 where the
+   host can express the parallelism — emits the
+   ``serve_scans_per_s_8dev`` headline line;
 8. streaming incremental reconstruction (`stream/`) on the same 24-stop
    scan: per-stop fusion with progressive previews — emits the
    ``first_preview_s`` and ``incremental_vs_batch_final_s`` headline
@@ -1182,6 +1189,162 @@ def main():
             levels
 
     guarded("serve_offered_load_1080p", config7)
+
+    # ------------------------------------------------------------------
+    # Config 7b: MULTI-DEVICE offered-load sweep — config 7's measurement
+    # repeated at 1/2/4/8 device lanes (serve/lanes.py: one worker pinned
+    # per chip, all pulling from one AdmissionQueue). Run under the
+    # forced-host-platform topology (XLA_FLAGS=--xla_force_host_platform_
+    # device_count=8, the dryrun_multichip trick) or on real chips.
+    # Reports scans_per_s per device count, per-lane job/occupancy rows
+    # and the sl_device_* memory gauges; asserts zero steady-state
+    # recompiles PER LANE at every level, and >= 3x throughput at 8
+    # devices vs 1 when the host can actually express that parallelism
+    # (8 virtual devices interleaving on a 2-core CI box cannot — the
+    # row then records scaling_asserted=false instead of lying either
+    # way). SL_BENCH_DEVSWEEP_TINY=1 shrinks stacks for the CI smoke;
+    # SL_BENCH_DEVSWEEP_STRICT=1/0 overrides the assert gate.
+    # ------------------------------------------------------------------
+    def config7b():
+        import threading
+
+        from structured_light_for_3d_model_replication_tpu.config import (
+            ProjectorConfig as _PC,
+        )
+        from structured_light_for_3d_model_replication_tpu.serve import (
+            JobRejected,
+            ReconstructionService,
+            ServeConfig,
+        )
+
+        n_local = len(jax.local_devices())
+        if n_local < 2:
+            _log(f"[7b] skipped: {n_local} local device(s) — force 8 "
+                 "with XLA_FLAGS=--xla_force_host_platform_device_count=8")
+            details["serve_multidevice_sweep"] = {
+                "skipped": f"{n_local} local device(s)"}
+            flush_details()
+            return
+
+        tiny = os.environ.get("SL_BENCH_DEVSWEEP_TINY") == "1"
+        if tiny:
+            sweep_proj = _PC(width=160, height=96)
+            sweep_stack = np.asarray(patterns.pattern_stack(
+                sweep_proj.width, sweep_proj.height, sweep_proj.col_bits,
+                sweep_proj.row_bits, sweep_proj.brightness))
+            jobs_per_dev = 6
+        else:
+            sweep_proj = proj
+            sweep_stack = np.zeros_like(stack_np)
+            sweep_stack[:, 400:656, 700:1084] = \
+                stack_np[:, 400:656, 700:1084]
+            jobs_per_dev = 6
+        sh, sw = sweep_stack.shape[1], sweep_stack.shape[2]
+        levels = [n for n in (1, 2, 4, 8) if n <= n_local]
+        strict_env = os.environ.get("SL_BENCH_DEVSWEEP_STRICT")
+        if strict_env is not None:
+            strict = strict_env == "1"
+        else:
+            # Virtual host devices share the machine's cores: asserting
+            # chip scaling needs at least one core per lane (real
+            # accelerators always pass this gate).
+            is_cpu = jax.devices()[0].platform == "cpu"
+            strict = (not is_cpu) or \
+                (os.cpu_count() or 1) >= max(levels)
+
+        rows = {}
+        for n_dev in levels:
+            cfg = ServeConfig(proj=sweep_proj, buckets=((sh, sw),),
+                              batch_sizes=(1, 2, 4), linger_ms=5.0,
+                              queue_depth=max(32, 8 * n_dev),
+                              workers=n_dev, devices=n_dev,
+                              content_cache=False,
+                              warmup_sessions=False)
+            svc = ReconstructionService(cfg)
+            t0 = time.perf_counter()
+            svc.start()
+            warm_s = time.perf_counter() - t0
+            warmed = len(svc._warmup_report)
+            n_jobs = jobs_per_dev * n_dev
+            conc = 2 * n_dev
+            errors: list = []
+
+            def client_loop(k, n_mine):
+                for j in range(n_mine):
+                    stack_v = sweep_stack + np.uint8(1 + (k + j) % 7)
+                    try:
+                        while True:
+                            try:
+                                job = svc.submit_array(stack_v)
+                                break
+                            except JobRejected as e:
+                                time.sleep(min(
+                                    getattr(e, "retry_after_s", None)
+                                    or 0.05, 0.25))
+                        if not job.wait(300.0) or job.status != "done":
+                            errors.append(job.status_dict())
+                    except Exception as e:  # a dead client thread would
+                        errors.append(f"{type(e).__name__}: {e}")
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(
+                target=client_loop, args=(k, n_jobs // conc))
+                for k in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            cache = svc.cache.stats()
+            snap = svc.registry.snapshot()
+            lane_jobs = dict(snap.get("serve_lane_jobs_total", {}))
+            lane_occ = snap.get("serve_lane_occupancy", {})
+            gauges = (svc.telemetry.sample_memory()
+                      if svc.telemetry is not None else {})
+            svc.drain(timeout=60.0)
+            if errors:
+                raise RuntimeError(
+                    f"[7b] {len(errors)} job(s) failed at {n_dev} "
+                    f"device(s): {errors[0]}")
+            done = (n_jobs // conc) * conc
+            rows[f"devices_{n_dev}"] = {
+                "jobs": done,
+                "scans_per_s": round(done / wall, 2),
+                "warmup_s": round(warm_s, 2),
+                "warmed_programs": warmed,
+                "steady_state_recompiles": cache["misses"] - warmed,
+                "lane_jobs": lane_jobs,
+                "lane_occupancy": lane_occ,
+                "device_memory": gauges,
+            }
+            _log(f"[7b] {n_dev} device(s): "
+                 f"{rows[f'devices_{n_dev}']['scans_per_s']} scans/s "
+                 f"({done} jobs in {wall:.1f}s, "
+                 f"lanes={sorted(lane_jobs)})")
+            # The per-lane zero-recompile bar: warmup covered every
+            # lane's program set, so the load compiled NOTHING.
+            assert cache["misses"] == warmed, cache
+
+        details["serve_multidevice_sweep"] = {
+            "stack": f"{sh}x{sw}x{sweep_stack.shape[0]}",
+            "tiny": tiny,
+            "scaling_asserted": strict,
+            "levels": rows,
+        }
+        flush_details()
+        if 8 in levels:
+            sps8 = rows["devices_8"]["scans_per_s"]
+            print(json.dumps({"metric": "serve_scans_per_s_8dev",
+                              "value": sps8, "unit": "scans/s",
+                              "direction": "higher_is_better"}),
+                  flush=True)
+            if strict:
+                sps1 = rows["devices_1"]["scans_per_s"]
+                assert sps8 >= 3.0 * sps1, (
+                    f"8-device throughput {sps8} < 3x single-device "
+                    f"{sps1} — the device dimension is not scaling")
+
+    guarded("serve_multidevice_sweep", config7b)
 
     # ------------------------------------------------------------------
     # Config 9: durability soak — sustained offered load against a

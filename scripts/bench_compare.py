@@ -45,11 +45,12 @@ _DETAILS_ALIASES = {
 def higher_is_better(metric: str) -> bool:
     """Most headline metrics are seconds (lower wins); throughput lines
     (config [9]'s ``soak_scans_per_s``, config [10]'s
-    ``fleet_scans_per_s``) invert — going UP is the improvement, going
-    down the regression. Latency-shaped fleet lines
+    ``fleet_scans_per_s``, and the suffixed device-sweep family like
+    config [7b]'s ``serve_scans_per_s_8dev``) invert — going UP is the
+    improvement, going down the regression. Latency-shaped fleet lines
     (``fleet_failover_s``) and config [11]'s per-stop preview latency
     (``tsdf_preview_s``) keep the lower-wins default."""
-    return metric.endswith("_per_s")
+    return metric.endswith("_per_s") or "_per_s_" in metric
 
 
 def _headline_metrics(text: str) -> dict[str, float]:

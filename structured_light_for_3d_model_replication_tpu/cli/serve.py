@@ -46,7 +46,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max wait for batch company before a partial "
                         "bucket flushes")
     p.add_argument("--workers", type=int, default=d.workers,
-                   help="device launch lanes (keep 1 per chip)")
+                   help="device launch lanes (one per chip: --workers 8 "
+                        "on an 8-chip host runs 8 pinned lanes pulling "
+                        "from one queue — docs/SERVING.md § multi-chip)")
+    p.add_argument("--devices", type=int, default=d.devices,
+                   help="spread worker lanes over at most this many "
+                        "local devices (default: all visible)")
+    p.add_argument("--shard-min-pixels", type=int,
+                   default=d.shard_min_pixels,
+                   help="buckets with padded H*W at or above this "
+                        "dispatch ONE cross-chip sharded program "
+                        "(camera rows over the device mesh) instead of "
+                        "serializing on a single lane; unset = off")
+    p.add_argument("--shard-devices", type=int, default=d.shard_devices,
+                   help="chips the sharded big-bucket tier spans "
+                        "(0 = all visible)")
     p.add_argument("--buckets",
                    default=",".join(f"{h}x{w}" for h, w in d.buckets),
                    help="comma-separated padded HxW shapes, e.g. "
@@ -250,6 +264,9 @@ def main(argv=None) -> int:
         queue_depth=args.queue_depth,
         linger_ms=args.linger_ms,
         workers=args.workers,
+        devices=args.devices,
+        shard_min_pixels=args.shard_min_pixels,
+        shard_devices=args.shard_devices,
         buckets=buckets,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(",")),
         warmup=not args.no_warmup,

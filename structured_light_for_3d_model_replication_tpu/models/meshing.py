@@ -88,6 +88,7 @@ def mesh_from_cloud(
     representation: str = "poisson",
     tsdf_max_bricks: int = 8192,
     cg_x0=None,
+    device_mesh=None,
 ) -> TriangleMesh:
     """Poisson-mesh a cloud (the body of `reconstruct_stl` / `mesh_360`).
 
@@ -121,6 +122,20 @@ def mesh_from_cloud(
     brick pool (overflow degrades to holes, logged). ``cg_x0``
     warm-starts the DENSE Poisson CG from a previous solve's χ grid
     (streaming finalize; ignored by the sparse and TSDF paths).
+
+    ``device_mesh`` (a ``parallel/mesh.py`` Mesh, docs/MESHING.md §
+    sharded solve) stages the cloud sharded over the mesh's space axis
+    before the DENSE (depth ≤ 8) Poisson solve: the solver jits leave
+    placement to propagation, so the committed input sharding is what
+    flips the splat/CG phases from replicated to sharded — one huge
+    solve spans chips (the serve tier's big-bucket dispatch) instead of
+    serializing on one. The band-sparse (depth > 8) solver keeps
+    single placement: its block-discovery scatters partition into
+    all-gather storms under GSPMD (measured: the depth-9 compile never
+    finishes on an 8-way host mesh), so sharding it needs explicit
+    per-phase specs — the ROADMAP's follow-on, not a free flip.
+    Host-side stages (normals, ball pivot, extraction readback) and
+    the TSDF path are unaffected.
     """
     if mode not in ("watertight", "surface"):
         raise ValueError(f"unknown mesh mode {mode!r}")
@@ -135,6 +150,36 @@ def mesh_from_cloud(
         raise ValueError(f"too few points to mesh ({pts.shape[0]})")
     normals = ensure_oriented_normals(cloud, orientation_mode,
                                       camera=camera)
+
+    def _sharded_cloud():
+        """Stage (points, normals, valid) over the device mesh. Point
+        counts are data-dependent (a valid-mask compaction), so the
+        cloud is padded up to a shard multiple with valid=False rows —
+        an uneven device_put is a hard error, and real scans are almost
+        never evenly divisible."""
+        import jax
+
+        from ..parallel import mesh as pmesh
+
+        n = pts.shape[0]
+        n_shards = int(device_mesh.devices.size)
+        pad = (-n) % n_shards
+        sp = pts
+        sn = np.ascontiguousarray(normals, np.float32)
+        sv = None
+        if pad:
+            sp = np.concatenate(
+                [sp, np.zeros((pad, 3), np.float32)])
+            sn = np.concatenate(
+                [sn, np.tile(np.asarray([[0.0, 0.0, 1.0]], np.float32),
+                             (pad, 1))])
+            sv = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+        sharded = pmesh.points_sharding(device_mesh)
+        sp = jax.device_put(sp, sharded)
+        sn = jax.device_put(sn, sharded)
+        if sv is not None:
+            sv = jax.device_put(sv, pmesh.samples_sharding(device_mesh))
+        return sp, sn, sv
 
     if representation == "tsdf":
         trim = quantile_trim if mode == "watertight" \
@@ -161,6 +206,8 @@ def mesh_from_cloud(
         # Block-budget overflow (→ dropped blocks → holes) is detected and
         # handled INSIDE reconstruct_sparse before the solve runs.
         kw = {} if max_blocks is None else {"max_blocks": int(max_blocks)}
+        # NOT solve_pts: the sparse solver keeps single placement (see
+        # the device_mesh docstring note).
         grid, n_blocks = poisson_sparse.reconstruct_sparse(
             pts, normals, depth=int(depth), cg_iters=cg_iters,
             preconditioner=preconditioner, **kw)
@@ -169,7 +216,12 @@ def mesh_from_cloud(
         mesh = marching.extract_sparse(grid, quantile_trim=trim,
                                        engine=extraction)
     else:
-        grid = poisson.reconstruct(pts, normals, depth=int(depth),
+        if device_mesh is not None:
+            solve_pts, solve_normals, solve_valid = _sharded_cloud()
+        else:
+            solve_pts, solve_normals, solve_valid = pts, normals, None
+        grid = poisson.reconstruct(solve_pts, solve_normals,
+                                   valid=solve_valid, depth=int(depth),
                                    cg_iters=cg_iters, x0=cg_x0)
         mesh = marching.extract(grid, quantile_trim=trim)
     log.info("meshed %d points -> %d verts / %d faces (mode=%s depth=%d)",

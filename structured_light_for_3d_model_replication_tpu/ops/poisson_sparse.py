@@ -39,6 +39,8 @@ from __future__ import annotations
 import functools
 from typing import NamedTuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -386,8 +388,16 @@ def _div_band_flat(Vflat, nbr):
 # phase's exist.
 
 
+# donate_argnames=(): nothing here is safely donatable — the retry loop
+# in reconstruct_sparse re-submits the SAME points/normals/valid when
+# the block budget overflows, and valid feeds _iso_sparse after the
+# solve. in_shardings=None leaves placement to propagation (committed
+# shardings pass through — the `parallel/` path relies on that) while
+# recording the sharding-readiness decision explicitly (docs/JAXLINT.md).
 @functools.partial(jax.jit,
-                   static_argnames=("resolution", "max_blocks"))
+                   static_argnames=("resolution", "max_blocks"),
+                   donate_argnames=(),
+                   in_shardings=None, out_shardings=None)
 def _setup_sparse(points, normals, valid, resolution: int, max_blocks: int,
                   screen):
     R = resolution
@@ -579,8 +589,14 @@ def _sep_weights(bcc, e, cr, Rc: int, W: int):
     return wgt, flat_idx
 
 
+# coarse_chi and rhs die here (the folded b replaces rhs; the coarse
+# field only seeds x0), so both donate — at a 10⁵-block band that is
+# two (M, BS³) buffers of headroom per solve. nbr/block_valid/
+# block_coords are NOT donated: the CG and extraction reuse them.
 @functools.partial(jax.jit, static_argnames=("resolution",
-                                             "coarse_resolution", "chunk"))
+                                             "coarse_resolution", "chunk"),
+                   donate_argnames=("coarse_chi", "rhs"),
+                   in_shardings=None, out_shardings=None)
 def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
                   resolution: int, coarse_resolution: int,
                   chunk: int = 8192):
@@ -632,7 +648,15 @@ def _prolong_band(coarse_chi, rhs, nbr, block_valid, block_coords,
     return b, x0
 
 
-@functools.partial(jax.jit, static_argnames=("cg_iters", "use_pallas"))
+# donate_argnames=() is a DECISION, not an omission: callers
+# legitimately re-solve one assembled (b, x0) system — the
+# preconditioner parity tests and probe scripts sweep rtol/precond over
+# the same buffers, and x0 is the warm-start surface (reconstruct_sparse
+# seeds it from a caller-held previous grid). Donating either breaks
+# that reuse the moment a backend honors donation (CPU does).
+@functools.partial(jax.jit, static_argnames=("cg_iters", "use_pallas"),
+                   donate_argnames=(),
+                   in_shardings=None, out_shardings=None)
 def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int,
                rtol=3e-4, use_pallas: bool | None = None):
     # rtol default is a PLAIN float (and matches the public 3e-4): a
@@ -708,9 +732,13 @@ def _cg_sparse(b, W, x0, nbr, block_valid, cg_iters: int,
     return jnp.where(band, chi, 0.0), iters  # (M, BS³) flat
 
 
+# Same donation contract as _cg_sparse: b/x0 are deliberately
+# re-solvable (donate nothing).
 @functools.partial(jax.jit, static_argnames=(
     "resolution", "coarse_resolution", "cg_iters", "use_pallas",
-    "precond", "precond_coarse_iters", "cheby_degree", "chunk"))
+    "precond", "precond_coarse_iters", "cheby_degree", "chunk"),
+    donate_argnames=(),
+    in_shardings=None, out_shardings=None)
 def _pcg_sparse(b, W, x0, nbr, block_valid, block_coords, coarse_W,
                 resolution: int, coarse_resolution: int, cg_iters: int,
                 rtol=3e-4, use_pallas: bool | None = None,
@@ -976,7 +1004,11 @@ def _pcg_sparse(b, W, x0, nbr, block_valid, block_coords, coarse_W,
     return jnp.where(band, chi, 0.0), iters
 
 
-@jax.jit
+# flat/w/cfound (the per-sample trilinear gather tables) die here;
+# chi/density are the returned grid's fields and valid is the caller's
+# — none of those may donate.
+@functools.partial(jax.jit, donate_argnames=("flat", "w", "cfound"),
+                   in_shardings=None, out_shardings=None)
 def _iso_sparse(chi, density, flat, w, cfound, valid):
     """Density-weighted mean of chi at the samples (8 trilinear corners
     per sample, gathered from the bricks)."""
@@ -988,6 +1020,57 @@ def _iso_sparse(chi, density, flat, w, cfound, valid):
     return jnp.sum(chi_pts * den_pts) / jnp.maximum(jnp.sum(den_pts), 1e-12)
 
 
+def _warm_start_seed(seed, prev: SparsePoissonGrid, block_coords,
+                     block_valid, origin, scale, resolution: int):
+    """Overlay a previous solve's χ onto the new band's CG seed.
+
+    Blocks present in BOTH bands (matched by integer block coordinate —
+    valid only when the grid normalization did not move) start from the
+    previous converged χ instead of the coarse prolongation; new blocks
+    keep the coarse seed. The previous chi is COPIED (``.at[].set``), so
+    the caller-held grid stays valid.
+    Returns ``(seed, matched_block_count)`` — 0 means the warm start was
+    skipped (resolution/normalization mismatch or disjoint bands)."""
+    if prev.resolution != resolution:
+        log.info("sparse warm start skipped: previous grid resolution "
+                 "%d != %d", prev.resolution, resolution)
+        return seed, 0
+    prev_origin = np.asarray(prev.origin, np.float64)
+    new_origin = np.asarray(origin, np.float64)
+    prev_scale = float(prev.scale)
+    new_scale = float(scale)
+    tol = 1e-5 * max(abs(prev_scale), abs(new_scale))
+    if abs(prev_scale - new_scale) > tol or not np.allclose(
+            prev_origin, new_origin, rtol=0.0, atol=tol * BS):
+        log.info("sparse warm start skipped: grid normalization moved "
+                 "(origin/scale differ) — the previous chi is not "
+                 "voxel-aligned with this band")
+        return seed, 0
+    pv = np.asarray(prev.block_valid)
+    nv = np.asarray(block_valid)
+    pi = np.nonzero(pv)[0]
+    ni = np.nonzero(nv)[0]
+    if pi.size == 0 or ni.size == 0:
+        return seed, 0
+    bits = 21  # nb_axis ≤ 2^13 at depth 16 — 21 bits/axis is ample
+
+    def pack(bc):
+        bc = bc.astype(np.int64)
+        return (bc[:, 0] << (2 * bits)) | (bc[:, 1] << bits) | bc[:, 2]
+
+    pk = pack(np.asarray(prev.block_coords)[pi])
+    nk = pack(np.asarray(block_coords)[ni])
+    order = np.argsort(pk)
+    pos = np.minimum(np.searchsorted(pk, nk, sorter=order), pk.size - 1)
+    hit = pk[order[pos]] == nk
+    if not hit.any():
+        return seed, 0
+    dst = jnp.asarray(ni[hit], jnp.int32)
+    src = jnp.asarray(pi[order[pos[hit]]], jnp.int32)
+    seed = seed.at[dst].set(jnp.asarray(prev.chi, jnp.float32)[src])
+    return seed, int(hit.sum())
+
+
 def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
                        cg_iters: int | None = None,
                        screen: float | None = None,
@@ -997,7 +1080,8 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
                        rtol: float | None = None,
                        preconditioner: str | None = None,
                        params: PoissonParams | None = None,
-                       with_stats: bool = False):
+                       with_stats: bool = False,
+                       x0: "SparsePoissonGrid | None" = None):
     """Band-sparse screened Poisson at depth 9-16 (module docstring).
 
     Matches the reference's octree-Poisson acceptance envelope: default
@@ -1036,10 +1120,21 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
     keywords is an error — silent precedence between the two was a
     depth-10-instead-of-15 footgun.
 
+    ``x0`` WARM-STARTS the fine-band CG from a previous solve's grid
+    (the sparse half of the PR-10 dense-preview contract,
+    ``poisson.reconstruct(x0=…)``): blocks present in both bands seed
+    from the previous converged χ instead of the coarse prolongation,
+    so a repeated solve of a barely-changed cloud (streaming finalize
+    after previews, re-mesh at new trim) spends measurably fewer outer
+    iterations. Accepted only when resolution AND grid normalization
+    (origin/scale) match — otherwise it is skipped with a log line and
+    the solve is exactly the cold one.
+
     ``with_stats`` appends a third return value, a dict with
     ``cg_iters_used`` (fine-band iterations the residual stop actually
-    spent) and ``preconditioner`` — the bench's ≤ 30-iteration gate and
-    the convergence tests read it instead of scraping logs.
+    spent), ``preconditioner`` and ``warm_start_blocks`` (matched
+    blocks seeded from ``x0``; 0 = cold) — the bench's ≤ 30-iteration
+    gate and the convergence tests read it instead of scraping logs.
     """
     given = {k: v for k, v in dict(
         depth=depth, cg_iters=cg_iters, screen=screen,
@@ -1145,10 +1240,24 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
                                      rc, coarse_iters,
                                      jnp.float32(screen), rtol=rtol,
                                      warm=False)
-    b, x0 = _prolong_band(coarse.chi, rhs, nbr, block_valid, block_coords,
-                          2 ** depth, 2 ** min(coarse_depth, depth))
+    b, seed = _prolong_band(coarse.chi, rhs, nbr, block_valid,
+                            block_coords, 2 ** depth,
+                            2 ** min(coarse_depth, depth))
+    warm_blocks = 0
+    if x0 is not None:
+        if not isinstance(x0, SparsePoissonGrid):
+            raise TypeError(
+                f"x0 must be a SparsePoissonGrid from a previous "
+                f"reconstruct_sparse call, got {type(x0).__name__}")
+        seed, warm_blocks = _warm_start_seed(
+            seed, x0, block_coords, block_valid, origin, scale,
+            2 ** depth)
+        if warm_blocks:
+            log.info("sparse Poisson depth=%d: warm start seeded %d "
+                     "band block(s) from the previous grid", depth,
+                     warm_blocks)
     if preconditioner == "jacobi":
-        chi, cg_used = _cg_sparse(b, W, x0, nbr, block_valid, cg_iters,
+        chi, cg_used = _cg_sparse(b, W, seed, nbr, block_valid, cg_iters,
                                   jnp.float32(rtol))
     else:
         # Coarse screen for the preconditioner's coarse operator: the
@@ -1159,7 +1268,7 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
                                                 jnp.float32(screen))
         om = params.smooth_omega
         chi, cg_used = _pcg_sparse(
-            b, W, x0, nbr, block_valid, block_coords, coarse_W,
+            b, W, seed, nbr, block_valid, block_coords, coarse_W,
             2 ** depth, 2 ** min(coarse_depth, depth), cg_iters,
             rtol=jnp.float32(rtol), precond=preconditioner,
             precond_coarse_iters=params.precond_coarse_iters,
@@ -1174,5 +1283,6 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
                              iso, origin, scale, 2 ** depth, nbr=nbr)
     if with_stats:
         return grid, n_blocks, {"cg_iters_used": int(cg_used),
-                                "preconditioner": preconditioner}
+                                "preconditioner": preconditioner,
+                                "warm_start_blocks": warm_blocks}
     return grid, n_blocks

@@ -53,5 +53,27 @@ def cloud_batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS, SPACE_AXIS, None))
 
 
+def points_sharding(mesh: Mesh) -> NamedSharding:
+    """(N, 3) unbatched clouds — the meshing solve's input: points over
+    space. The Poisson/TSDF solvers' jit programs carry
+    ``in_shardings=None`` (committed shardings pass through), so
+    staging a cloud with this sharding is what flips their splat /
+    normal phases from replicated to sharded (GSPMD derives the grid
+    collectives)."""
+    return NamedSharding(mesh, P(SPACE_AXIS, None))
+
+
+def samples_sharding(mesh: Mesh) -> NamedSharding:
+    """(N,) per-point scalars (validity masks, densities): over space."""
+    return NamedSharding(mesh, P(SPACE_AXIS))
+
+
+def serve_space_mesh(n_devices: int, devices=None) -> Mesh:
+    """The serving tier's sharded-bucket mesh: one job spans
+    ``n_devices`` chips with camera rows over the space axis (data=1 —
+    the batch dimension stays whole; `serve/cache.ProgramKey.shards`)."""
+    return make_mesh(data=1, space=int(n_devices), devices=devices)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

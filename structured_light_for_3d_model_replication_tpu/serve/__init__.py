@@ -63,6 +63,7 @@ from .jobs import (
     ServeError,
     StackFormatError,
 )
+from .lanes import DeviceLane, DeviceLanePool
 from .router import FleetRouter, RouterHTTPServer
 from .service import ReconstructionService, ServeConfig, ServeHTTPServer
 from .sessions import SessionLimitError, SessionManager, UnknownSessionError
@@ -78,6 +79,8 @@ __all__ = [
     "BucketKey",
     "CircuitBreaker",
     "ContentCache",
+    "DeviceLane",
+    "DeviceLanePool",
     "DeviceWorker",
     "FaultyPeerTransport",
     "FleetRouter",

@@ -100,6 +100,7 @@ class Batch:
     key: BucketKey
     jobs: list
     size: int
+    lane: "int | None" = None   # device-lane affinity the flush honored
 
     @property
     def occupancy(self) -> int:
@@ -138,8 +139,14 @@ class BucketBatcher:
         self.linger_s = float(linger_s)
         self.pad_quantum = int(pad_quantum)
         self._lock = threading.Lock()
-        # BucketKey -> list[(enqueue_t, Job)]
-        self._pending: dict[BucketKey, list] = {}
+        # (lane | None, BucketKey) -> list[(enqueue_t, Job)]. The lane
+        # half is device-lane AFFINITY (serve/lanes.py): jobs with
+        # lane=None coalesce freely and any worker may flush them; a
+        # session stop pinned to lane k only flushes to the worker on
+        # that lane (sticky sessions — its jit programs live on that
+        # chip). Affine and free jobs never share a batch: they launch
+        # through different executables.
+        self._pending: dict[tuple, list] = {}
 
     # ------------------------------------------------------------------
 
@@ -158,18 +165,23 @@ class BucketBatcher:
     # ------------------------------------------------------------------
 
     def _absorb(self, job: Job) -> None:
-        key = self.key_for(job)
+        key = (job.lane, self.key_for(job))
         with self._lock:
             self._pending.setdefault(key, []).append(
                 (time.monotonic(), job))
 
-    def _flushable(self, now: float, force: bool) -> BucketKey | None:
-        """Bucket due for flush: full beats lingering; among lingering
-        buckets the one whose oldest job has waited longest."""
+    def _flushable(self, now: float, force: bool,
+                   lane: "int | None") -> tuple | None:
+        """Pending key due for flush: full beats lingering; among
+        lingering ones, the oldest wait wins. A worker on ``lane`` may
+        flush free (lane=None) buckets and its own lane's buckets;
+        ``lane=None`` (no lane pool) flushes everything."""
         best = None
         with self._lock:
             for key, items in self._pending.items():
                 if not items:
+                    continue
+                if not (lane is None or key[0] is None or key[0] == lane):
                     continue
                 if len(items) >= self.max_batch:
                     return key
@@ -179,7 +191,7 @@ class BucketBatcher:
                         best = (age, key)
         return best[1] if best else None
 
-    def _take(self, key: BucketKey) -> Batch | None:
+    def _take(self, key: tuple) -> Batch | None:
         with self._lock:
             items = self._pending.get(key, [])
             take, rest = items[:self.max_batch], items[self.max_batch:]
@@ -198,17 +210,23 @@ class BucketBatcher:
                         "deadline lapsed while batching"))
         if not jobs:
             return None
-        return Batch(key=key, jobs=jobs,
-                     size=batch_size_for(len(jobs), self.batch_sizes))
+        return Batch(key=key[1], jobs=jobs,
+                     size=batch_size_for(len(jobs), self.batch_sizes),
+                     lane=key[0])
 
     # ------------------------------------------------------------------
 
-    def next_batch(self, timeout: float = 0.1,
-                   force: bool = False) -> Batch | None:
+    def next_batch(self, timeout: float = 0.1, force: bool = False,
+                   lane: "int | None" = None) -> Batch | None:
         """Next coalesced batch, or None after ``timeout``.
 
         ``force=True`` flushes partial buckets immediately (drain path:
-        linger is pointless when no more work is coming)."""
+        linger is pointless when no more work is coming). ``lane``
+        restricts the flush to free buckets plus that lane's affine
+        ones (the caller is a lane-pinned worker); absorption from the
+        queue is unrestricted — a worker may absorb another lane's job
+        into the shared pending state, where its own worker picks it up
+        within one loop tick."""
         deadline = time.monotonic() + timeout
         while True:
             # Absorb everything already queued without blocking.
@@ -218,7 +236,7 @@ class BucketBatcher:
                     break
                 self._absorb(job)
             now = time.monotonic()
-            key = self._flushable(now, force)
+            key = self._flushable(now, force, lane)
             if key is not None:
                 batch = self._take(key)
                 if batch is not None:

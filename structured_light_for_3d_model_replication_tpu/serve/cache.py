@@ -44,13 +44,29 @@ log = get_logger(__name__)
 
 @dataclasses.dataclass(frozen=True)
 class ProgramKey:
-    """BucketKey + batch size: one compiled executable."""
+    """BucketKey + batch size (+ placement): one compiled executable.
+
+    ``device`` pins the program to one chip (a `lanes.DeviceLane`
+    label like ``"cpu:0"``) — lanes on different devices hold DISTINCT
+    executables, so the zero-recompile steady-state assertion is
+    per-chip. ``shards`` > 0 selects the sharded cross-chip tier
+    instead: one program whose camera rows span that many devices
+    (`parallel/mesh.py`). ``device=None, shards=0`` is the historical
+    single-default-device program.
+    """
 
     bucket: BucketKey
     batch: int
+    device: str | None = None
+    shards: int = 0
 
     def label(self) -> str:
-        return f"B{self.batch}:{self.bucket.label()}"
+        base = f"B{self.batch}:{self.bucket.label()}"
+        if self.shards:
+            return f"{base}@mesh{self.shards}"
+        if self.device is not None:
+            return f"{base}@{self.device}"
+        return base
 
 
 class _Entry:
@@ -94,6 +110,83 @@ class ProgramCache:
             "cumulative wall-clock spent compiling programs")
         self._entries_gauge = self.registry.gauge(
             "serve_program_cache_entries", "resident compiled programs")
+        # Placement memos (tiny, bounded by devices × buckets): the
+        # sharding an input batch is staged with, and the device-placed
+        # calibration the executable was LOWERED against — AOT
+        # executables bake argument placement in, so the exact placed
+        # arrays must be reused at every call.
+        self._placements: dict = {}
+        self._placed_calibs: dict = {}
+
+    # -- placement (device lanes / sharded tier) -----------------------
+
+    def _sharding_for(self, key: ProgramKey):
+        """The input-batch sharding for ``key``: a SingleDeviceSharding
+        for a lane-pinned program, the rows-over-space NamedSharding for
+        a sharded one, None for the historical default placement."""
+        memo = (key.device, key.shards)
+        if memo in self._placements:
+            return self._placements[memo]
+        import jax
+
+        sharding = None
+        if key.shards:
+            from ..parallel import mesh as pmesh
+
+            m = pmesh.serve_space_mesh(
+                key.shards, devices=jax.local_devices()[:key.shards])
+            sharding = pmesh.stack_batch_sharding(m)
+        elif key.device is not None:
+            dev = next((d for d in jax.local_devices()
+                        if f"{d.platform}:{d.id}" == key.device), None)
+            if dev is None:
+                raise ValueError(
+                    f"ProgramKey names device {key.device!r} but no "
+                    "such local device exists")
+            sharding = jax.sharding.SingleDeviceSharding(dev)
+        self._placements[memo] = sharding
+        return sharding
+
+    def placed_calib(self, key: ProgramKey):
+        """The calibration pytree placed where ``key``'s program
+        expects it: on the lane's device, replicated over the sharded
+        tier's mesh, or wherever the provider left it (default keys).
+        Memoized per (bucket geometry, placement) — the arrays' identity
+        must persist so AOT calls always see the lowered placement."""
+        b = key.bucket
+        memo = (b.height, b.width, key.device, key.shards)
+        with self._lock:
+            placed = self._placed_calibs.get(memo)
+        if placed is not None:
+            return placed
+        calib = self.calib_provider(b.height, b.width)
+        if key.shards:
+            import jax
+
+            from ..parallel import mesh as pmesh
+
+            m = pmesh.serve_space_mesh(
+                key.shards, devices=jax.local_devices()[:key.shards])
+            calib = jax.device_put(calib, pmesh.replicated(m))
+        elif key.device is not None:
+            import jax
+
+            # SingleDeviceSharding is itself a device_put target.
+            calib = jax.device_put(calib, self._sharding_for(key))
+        with self._lock:
+            self._placed_calibs[memo] = calib
+        return calib
+
+    def stage(self, key: ProgramKey, batch):
+        """Stage one host batch array where ``key``'s executable expects
+        its input: the lane device, the sharded mesh, or default."""
+        import jax
+        import jax.numpy as jnp
+
+        sharding = self._sharding_for(key)
+        if sharding is None:
+            return jnp.asarray(batch)
+        return jax.device_put(batch, sharding)
 
     # ------------------------------------------------------------------
 
@@ -104,12 +197,13 @@ class ProgramCache:
         from ..models import pipeline
 
         b = key.bucket
-        calib = self.calib_provider(b.height, b.width)
+        calib = self.placed_calib(key)
         fn = pipeline.reconstruct_batch_fn(
             b.col_bits, b.row_bits, decode_cfg=b.decode_cfg,
             tri_cfg=b.tri_cfg, downsample=b.downsample)
         stack_spec = jax.ShapeDtypeStruct(
-            (key.batch, b.frames, b.height, b.width), jnp.uint8)
+            (key.batch, b.frames, b.height, b.width), jnp.uint8,
+            sharding=self._sharding_for(key))
         t0 = time.monotonic()
         compiled = fn.lower(stack_spec, calib).compile()
         dt = time.monotonic() - t0
@@ -170,18 +264,21 @@ class ProgramCache:
 
     # ------------------------------------------------------------------
 
-    def warmup(self, bucket_keys, batch_sizes) -> dict:
-        """Precompile every (bucket, batch) program; returns
-        {label: compile_s}. Called at service start so the first real
-        request of any configured shape is a hit."""
+    def warmup(self, bucket_keys, batch_sizes=(),
+               program_keys=()) -> dict:
+        """Precompile every (bucket, batch) program — plus any explicit
+        ``program_keys`` (the per-device / sharded lane set the service
+        routes to); returns {label: compile_s}. Called at service start
+        so the first real request of any configured shape is a hit."""
         out = {}
-        for bucket in bucket_keys:
-            for b in batch_sizes:
-                key = ProgramKey(bucket=bucket, batch=int(b))
-                with trace.span("serve.warmup", program=key.label()):
-                    t0 = time.monotonic()
-                    self.get(key)
-                    out[key.label()] = round(time.monotonic() - t0, 3)
+        keys = [ProgramKey(bucket=bucket, batch=int(b))
+                for bucket in bucket_keys for b in batch_sizes]
+        keys.extend(program_keys)
+        for key in keys:
+            with trace.span("serve.warmup", program=key.label()):
+                t0 = time.monotonic()
+                self.get(key)
+                out[key.label()] = round(time.monotonic() - t0, 3)
         # Warmup compiles are misses by construction; zero them out of the
         # steady-state signal? No — they stay counted (honest totals), and
         # the zero-recompile assertion compares counters AFTER warmup.

@@ -162,6 +162,12 @@ class Job:
     journal_kind: str | None = dataclasses.field(default=None, repr=False)
     session_id: str | None = dataclasses.field(default=None, repr=False)
     recovered: bool = dataclasses.field(default=False, repr=False)
+    # Device-lane affinity (serve/lanes.py): None = any worker may take
+    # this job; an index pins it to that lane's pending buckets so a
+    # streaming session's stops always run on the session's STICKY
+    # device (its jit programs were warmed there — migrating mid-scan
+    # would compile).
+    lane: int | None = dataclasses.field(default=None, repr=False)
 
     submitted_t: float = 0.0
     started_t: float | None = None

@@ -1,0 +1,204 @@
+"""Device lanes: one worker lane per local accelerator chip.
+
+Every `serve/` worker historically launched on the default device — the
+fleet tier scaled *replicas* while each process left all but one chip
+idle. This module gives the service its device dimension:
+
+* :class:`DeviceLane` — one launch lane pinned to one ``jax.Device``.
+  The lane's label (``"cpu:0"``, ``"tpu:3"``) rides the per-device
+  :class:`~.cache.ProgramKey`, so AOT executables — and the
+  zero-recompile steady-state assertion — stay per-chip.
+* :class:`DeviceLanePool` — enumerates ``jax.local_devices()`` once,
+  hands out lanes round-robin to the configured worker count, routes
+  each (bucket, batch) to either a lane-pinned program or the sharded
+  cross-chip tier (``shard_min_pixels``), and owns STICKY session →
+  lane placement: a streaming session is assigned the least-loaded
+  lane at creation and every stop it submits carries that lane's
+  affinity, so the session's jit programs (fuse, refine, preview —
+  warmed per lane at replica start) never migrate mid-scan.
+
+The pool is pure bookkeeping — no threads, no device I/O. Constructing
+one (without an explicit ``devices`` list) calls ``jax.local_devices()``,
+which initializes the backend: set platform/topology flags
+(``JAX_PLATFORMS``, ``--xla_force_host_platform_device_count``) before
+building a service.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from ..utils.log import get_logger
+from .batcher import BucketKey
+from .cache import ProgramKey
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceLane:
+    """One launch lane: a worker index pinned to one device."""
+
+    index: int            # lane number (== worker index)
+    device: object        # jax.Device
+    label: str            # "platform:id", the ProgramKey.device value
+
+    def __repr__(self) -> str:  # device objects repr verbosely
+        return f"DeviceLane({self.index}, {self.label})"
+
+
+def device_label(device) -> str:
+    return f"{device.platform}:{device.id}"
+
+
+class DeviceLanePool:
+    """Lane assignment + program routing over the local devices.
+
+    ``n_lanes`` worker lanes spread round-robin over up to
+    ``max_devices`` local devices (None = all). ``shard_min_pixels``
+    selects the sharded cross-chip tier: a bucket whose padded pixel
+    count meets the threshold dispatches ONE program spanning
+    ``shard_devices`` chips (rows sharded over the mesh's space axis,
+    `parallel/mesh.py`) instead of serializing on a single lane.
+    """
+
+    def __init__(self, n_lanes: int = 1, max_devices: int | None = None,
+                 shard_min_pixels: int | None = None,
+                 shard_devices: int = 0, devices=None):
+        if devices is None:
+            import jax
+
+            devices = jax.local_devices()
+        devices = list(devices)
+        if max_devices is not None:
+            devices = devices[:max(1, int(max_devices))]
+        if not devices:
+            raise ValueError("no local devices to build lanes over")
+        self.devices = devices
+        n_lanes = max(1, int(n_lanes))
+        self.lanes = [
+            DeviceLane(i, devices[i % len(devices)],
+                       device_label(devices[i % len(devices)]))
+            for i in range(n_lanes)
+        ]
+        self.shard_min_pixels = shard_min_pixels
+        # The sharded tier needs >= 2 chips to be worth a distinct
+        # program; 0 = span every device the pool can see.
+        self.shard_devices = (len(devices) if not shard_devices
+                              else min(int(shard_devices), len(devices)))
+        self._lock = threading.Lock()
+        self._session_lane: dict[str, DeviceLane] = {}
+        self._solve_meshes: dict[int, object] = {}
+
+    # -- lanes ---------------------------------------------------------
+
+    def lane(self, index: int) -> DeviceLane:
+        return self.lanes[index]
+
+    @property
+    def n_lanes(self) -> int:
+        return len(self.lanes)
+
+    @property
+    def multi_device(self) -> bool:
+        """True when the lanes actually span more than one chip. A
+        single-device pool routes through the HISTORICAL un-pinned
+        program keys and takes no session placement — bit-identical to
+        the pre-lane service (and its warmed program set)."""
+        return len({ln.label for ln in self.lanes}) > 1
+
+    def distinct_devices(self) -> list[DeviceLane]:
+        """First lane per distinct device — the warmup iteration set
+        (two lanes sharing a chip share its programs)."""
+        seen: dict[str, DeviceLane] = {}
+        for lane in self.lanes:
+            seen.setdefault(lane.label, lane)
+        return list(seen.values())
+
+    # -- program routing ----------------------------------------------
+
+    def shards_for(self, key: BucketKey) -> int:
+        """Shard count for a bucket: 0 (lane-pinned program) unless the
+        sharded tier is enabled, spans >1 chip, the bucket meets the
+        size threshold AND its row count splits evenly over the mesh
+        (GSPMD would pad an uneven split; refusing keeps the dispatch
+        decision — and the warmed program set — exact)."""
+        if (self.shard_min_pixels is None or self.shard_devices < 2
+                or key.height * key.width < self.shard_min_pixels
+                or key.height % self.shard_devices):
+            return 0
+        return self.shard_devices
+
+    def route(self, key: BucketKey, batch: int,
+              lane: DeviceLane | None) -> ProgramKey:
+        """The ProgramKey a (bucket, batch) launch uses from ``lane``:
+        the sharded cross-chip program when the bucket qualifies, else
+        the lane's per-device program."""
+        shards = self.shards_for(key)
+        if shards:
+            return ProgramKey(bucket=key, batch=batch, shards=shards)
+        device = (lane.label if lane is not None and self.multi_device
+                  else None)
+        return ProgramKey(bucket=key, batch=batch, device=device)
+
+    def solve_mesh(self, key: BucketKey):
+        """The `parallel/mesh.py` device mesh a sharded bucket's heavy
+        postprocess solves (Poisson via ``mesh_from_cloud(device_mesh=
+        …)``) span — None for lane-pinned buckets. Memoized: one Mesh
+        object per shard count."""
+        shards = self.shards_for(key)
+        if not shards:
+            return None
+        with self._lock:
+            mesh = self._solve_meshes.get(shards)
+            if mesh is None:
+                from ..parallel import mesh as pmesh
+
+                mesh = pmesh.serve_space_mesh(
+                    shards, devices=self.devices[:shards])
+                self._solve_meshes[shards] = mesh
+            return mesh
+
+    # -- sticky sessions ----------------------------------------------
+
+    def assign_session(self, session_id: str) -> DeviceLane:
+        """Sticky placement: the least-loaded lane (fewest live
+        sessions; ties break toward the lowest index — deterministic,
+        which the placement tests rely on). Idempotent per session."""
+        with self._lock:
+            lane = self._session_lane.get(session_id)
+            if lane is not None:
+                return lane
+            load = {ln.index: 0 for ln in self.lanes}
+            for assigned in self._session_lane.values():
+                load[assigned.index] = load.get(assigned.index, 0) + 1
+            lane = min(self.lanes, key=lambda ln: (load[ln.index],
+                                                   ln.index))
+            self._session_lane[session_id] = lane
+            return lane
+
+    def lane_for_session(self, session_id: str) -> DeviceLane | None:
+        with self._lock:
+            return self._session_lane.get(session_id)
+
+    def release_session(self, session_id: str) -> None:
+        with self._lock:
+            self._session_lane.pop(session_id, None)
+
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_lane: dict[int, int] = {ln.index: 0 for ln in self.lanes}
+            for lane in self._session_lane.values():
+                per_lane[lane.index] = per_lane.get(lane.index, 0) + 1
+        return {
+            "devices": [device_label(d) for d in self.devices],
+            "lanes": [{"index": ln.index, "device": ln.label,
+                       "sessions": per_lane.get(ln.index, 0)}
+                      for ln in self.lanes],
+            "shard_min_pixels": self.shard_min_pixels,
+            "shard_devices": (self.shard_devices
+                              if self.shard_min_pixels is not None else 0),
+        }

@@ -48,7 +48,7 @@ from ..stream import StreamParams
 from ..utils import events, telemetry, trace
 from ..utils.log import get_logger
 from .batcher import BucketBatcher, BucketKey
-from .cache import ContentCache, ProgramCache, ProgramKey, content_key
+from .cache import ContentCache, ProgramCache, content_key
 from .fleet import PeerCacheClient
 from .governor import GovernorParams, OverloadGovernor
 from .jobs import (
@@ -60,6 +60,7 @@ from .jobs import (
     StackFormatError,
     error_payload,
 )
+from .lanes import DeviceLanePool
 from .sessions import SessionManager, UnknownSessionError
 from .store import JournalStore, SessionStreamStore
 from .worker import DeviceWorker
@@ -85,6 +86,20 @@ class ServeConfig:
     queue_depth: int = 64          # bounded admission (backpressure above)
     linger_ms: float = 10.0        # max wait for batch company
     workers: int = 1               # device launch lanes
+    # Device dimension (serve/lanes.py; docs/SERVING.md § multi-chip):
+    # worker lanes spread round-robin over up to this many local
+    # devices (None = all of jax.local_devices()). workers=N with N
+    # chips visible is the one-lane-per-chip topology; the default
+    # workers=1 keeps the historical single-device service.
+    devices: int | None = None
+    # Sharded big-bucket tier: a bucket whose padded H*W meets this
+    # threshold dispatches ONE cross-chip program (camera rows sharded
+    # over parallel/mesh.py's space axis, spanning shard_devices chips;
+    # 0 = all visible) instead of serializing on a single lane — and
+    # its heavy Poisson postprocess solves over the same device mesh.
+    # None disables the tier.
+    shard_min_pixels: int | None = None
+    shard_devices: int = 0
     buckets: tuple = ((1080, 1920),)   # padded (H, W) shapes
     batch_sizes: tuple = (1, 2, 4, 8)
     max_cache_entries: int = 32
@@ -264,9 +279,18 @@ class ReconstructionService:
         self.governor = OverloadGovernor(
             config.governor, self.queue, self.registry,
             telemetry=self.telemetry, store=self.store)
+        # Device-lane pool (serve/lanes.py): every worker lane is pinned
+        # to one local device; sessions get sticky lanes; buckets past
+        # shard_min_pixels route to the cross-chip sharded tier.
+        self.lanes = DeviceLanePool(
+            n_lanes=max(1, config.workers),
+            max_devices=config.devices,
+            shard_min_pixels=config.shard_min_pixels,
+            shard_devices=config.shard_devices)
         self._workers_lock = threading.Lock()
         self._worker_seq = max(1, config.workers)
-        self.workers = [self._make_worker(f"serve-worker-{i}")
+        self.workers = [self._make_worker(f"serve-worker-{i}",
+                                          self.lanes.lane(i))
                         for i in range(max(1, config.workers))]
         self._jobs_lock = threading.Lock()
         self._jobs: OrderedDict[str, Job] = OrderedDict()
@@ -297,20 +321,25 @@ class ReconstructionService:
             session_ttl_s=config.session_ttl_s,
             store=self.store,
             preview_shed=self.governor.shed_previews,
-            replica_id=self.replica_id)
+            replica_id=self.replica_id,
+            lane_pool=self.lanes if self.lanes.multi_device else None)
 
-    def _make_worker(self, name: str) -> DeviceWorker:
+    def _make_worker(self, name: str, lane) -> DeviceWorker:
         return DeviceWorker(self.batcher, self.cache,
                             gates=self.config.gates,
                             mesh_depth=self.config.mesh_depth,
                             registry=self.registry, tracer=self.tracer,
                             name=name, governor=self.governor,
                             mesh_representation=self.config
-                            .mesh_representation)
+                            .mesh_representation,
+                            lane=lane, lane_pool=self.lanes)
 
     def _restart_worker(self, wedged: DeviceWorker) -> DeviceWorker:
         """Watchdog callback: replace one wedged worker with a fresh
-        lane. The wedged thread is asked to stop but cannot be killed —
+        lane ON THE SAME DEVICE — the wedged worker's sticky sessions
+        and per-device AOT programs live there, so a replacement that
+        migrated would compile (and strand every session pinned to the
+        lane). The wedged thread is asked to stop but cannot be killed —
         if its launch ever returns, Job's first-terminal-wins rule makes
         the race harmless."""
         wedged.request_stop()
@@ -318,7 +347,7 @@ class ReconstructionService:
         with self._workers_lock:
             self._worker_seq += 1
             repl = self._make_worker(
-                f"serve-worker-r{self._worker_seq}")
+                f"serve-worker-r{self._worker_seq}", wedged.lane)
             self.workers = [repl if w is wedged else w
                             for w in self.workers]
         repl.start()
@@ -365,11 +394,24 @@ class ReconstructionService:
             self.telemetry.install()   # before warmup: count its compiles
         try:
             if self.config.warmup:
-                keys = [self._bucket_key(h, w)
-                        for h, w in self.config.buckets]
+                # Warm EXACTLY the program set the lane router will
+                # dispatch to (serve/lanes.py): per-device keys for
+                # every distinct lane chip, the cross-chip sharded key
+                # for buckets past shard_min_pixels, the historical
+                # un-pinned keys on a single-device pool — so the
+                # zero-recompile steady state holds per chip.
                 t0 = time.monotonic()
+                pkeys, seen = [], set()
+                for h, w in self.config.buckets:
+                    bkey = self._bucket_key(h, w)
+                    for lane in self.lanes.distinct_devices():
+                        for b in self.config.batch_sizes:
+                            k = self.lanes.route(bkey, int(b), lane)
+                            if k not in seen:
+                                seen.add(k)
+                                pkeys.append(k)
                 self._warmup_report = self.cache.warmup(
-                    keys, self.config.batch_sizes)
+                    (), program_keys=pkeys)
                 log.info("warmup: %d programs in %.1fs",
                          len(self._warmup_report), time.monotonic() - t0)
                 if self.config.warmup_sessions:
@@ -377,14 +419,33 @@ class ReconstructionService:
                     # or recovered session must find every per-stop
                     # program already compiled — the fleet failover
                     # window is otherwise dominated by these compiles.
+                    # Runs ONCE PER DISTINCT LANE DEVICE (jit keys
+                    # placement): a session is sticky on its lane, and
+                    # both first placement and failover adoption must
+                    # find that chip's programs warm.
                     from ..stream.warmup import warm_session_programs
 
+                    import contextlib
+
+                    session_lanes = (self.lanes.distinct_devices()
+                                     if self.lanes.multi_device
+                                     else [None])
                     for h, w in self.config.buckets:
-                        self._warmup_report[f"session:{h}x{w}"] = \
-                            warm_session_programs(
-                                self.config.stream, h * w,
-                                col_bits=self.config.proj.col_bits,
-                                row_bits=self.config.proj.row_bits)
+                        for lane in session_lanes:
+                            label = f"session:{h}x{w}" + (
+                                f"@{lane.label}" if lane else "")
+                            if lane is not None:
+                                import jax
+
+                                ctx = jax.default_device(lane.device)
+                            else:
+                                ctx = contextlib.nullcontext()
+                            with ctx:
+                                report = warm_session_programs(
+                                    self.config.stream, h * w,
+                                    col_bits=self.config.proj.col_bits,
+                                    row_bits=self.config.proj.row_bits)
+                            self._warmup_report[label] = report
             if recover_from:
                 self._recover()
         except BaseException:
@@ -638,20 +699,23 @@ class ReconstructionService:
         used (the bucket's B=1 executable) and hand the per-lane arrays
         to the session's ingest — the exact decode path of the original
         submission, so replay is bit-reproducible."""
-        import jax.numpy as jnp
-
         stack = self._validate_stack(stack)
         probe = Job(stack=stack, col_bits=self.config.proj.col_bits,
                     row_bits=self.config.proj.row_bits,
                     decode_cfg=self.config.decode_cfg,
                     tri_cfg=self.config.tri_cfg)
         key = self.batcher.key_for(probe)
-        compiled = self.cache.get(ProgramKey(bucket=key, batch=1))
-        calib = self.cache.calib_provider(key.height, key.width)
+        # Route through the session's sticky lane (serve/lanes.py): the
+        # replay must hit the SAME per-device executable the original
+        # stops ran on — warmed at start, so recovery stays compile-free
+        # and bitwise.
+        pkey = self.lanes.route(key, 1, getattr(entry, "lane", None))
+        compiled = self.cache.get(pkey)
+        calib = self.cache.placed_calib(pkey)
         batch = np.zeros((1, key.frames, key.height, key.width), np.uint8)
         f, h, w = stack.shape
         batch[0, :f, :h, :w] = stack
-        out = compiled(jnp.asarray(batch), calib)
+        out = compiled(self.cache.stage(pkey, batch), calib)
         points = np.asarray(out.points)[0]
         colors = np.asarray(out.colors)[0]
         valid = np.asarray(out.valid)[0]
@@ -860,6 +924,11 @@ class ReconstructionService:
             job.decode_sink = entry.ingest
             job.journal_kind = "stop"
             job.session_id = session_id
+            # Sticky lane affinity (serve/lanes.py): only the worker on
+            # the session's device lane flushes this stop — the
+            # session's fuse/preview programs live (warm) on that chip.
+            if entry.lane is not None:
+                job.lane = entry.lane.index
             job.on_terminal = self._on_terminal
             self.queue.submit(job)
             if self.store is not None:
@@ -930,8 +999,14 @@ class ReconstructionService:
                     f"session {session_id} finalized but its result "
                     "job fell out of the bounded registry — the "
                     "artifact is gone; re-scan")
-            result = entry.session.finalize(
-                mesh=result_format in ("stl", "mesh_ply"))
+            # Finalize on the session's sticky device (no-op context
+            # without a lane): the model buffers already live there,
+            # and the finalize-only programs (full-ring solve, merge)
+            # compile-and-run where the session's data is instead of
+            # pulling it across chips.
+            with entry.device_ctx():
+                result = entry.session.finalize(
+                    mesh=result_format in ("stl", "mesh_ply"))
             if result_format == "stl":
                 from .worker import _stl_bytes
 
@@ -1185,6 +1260,7 @@ class ReconstructionService:
             "draining": self._draining,
             "ready": self.ready,
             "workers_alive": sum(w.alive for w in self.workers),
+            "lanes": self.lanes.stats(),
             "cache": self.cache.stats(),
             "warmup": self._warmup_report,
             "sessions": self.sessions.stats(),

@@ -80,7 +80,7 @@ class ServeSession:
     """One streaming session: lock, lifecycle stamps, lazy preview bytes."""
 
     def __init__(self, session_id: str, session: IncrementalSession,
-                 bucket_pixels: int, preview_shed=None):
+                 bucket_pixels: int, preview_shed=None, lane=None):
         self.session_id = session_id
         self.session = session
         self.bucket_pixels = bucket_pixels
@@ -88,6 +88,12 @@ class ServeSession:
         # True suppresses the progressive preview for that stop (the
         # cheapest sheddable work — the last preview keeps serving).
         self.preview_shed = preview_shed
+        # Sticky device lane (serve/lanes.py): every stop job carries
+        # this lane's affinity AND the session's own jit programs (fuse,
+        # refine, preview) run under the lane device — warmed per lane
+        # at replica start, so placement and failover adoption are both
+        # compile-free.
+        self.lane = lane
         self.lock = threading.Lock()
         self.created_t = time.monotonic()
         self.last_t = self.created_t
@@ -98,14 +104,28 @@ class ServeSession:
 
     # ------------------------------------------------------------------
 
+    def device_ctx(self):
+        """``jax.default_device(lane)`` for sticky-lane sessions (jit
+        keys placement, so the per-lane warmup is what keeps lane
+        compute compile-free), a no-op otherwise."""
+        if self.lane is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self.lane.device)
+
     def ingest(self, points, colors, valid, coverage=None) -> dict:
         """The job's ``decode_sink``: fuse one decoded stop. Runs on the
-        worker thread; the lock serializes against preview/finalize."""
+        worker thread; the lock serializes against preview/finalize —
+        under the session's sticky lane device when one is assigned."""
         shed = bool(self.preview_shed()) if self.preview_shed else False
         with self.lock:
             self.session.suppress_previews = shed
-            res = self.session.add_decoded(points, colors, valid,
-                                           coverage=coverage)
+            with self.device_ctx():
+                res = self.session.add_decoded(points, colors, valid,
+                                               coverage=coverage)
             self.last_t = time.monotonic()
             return {"session_id": self.session_id, **res.to_dict()}
 
@@ -161,6 +181,8 @@ class ServeSession:
                    "stops_submitted": self.stops_submitted,
                    "age_s": round(time.monotonic() - self.created_t, 3),
                    **self.session.status_dict()}
+            if self.lane is not None:
+                out["device_lane"] = self.lane.label
             if self.result_job_id is not None:
                 out["result_job_id"] = self.result_job_id
             return out
@@ -172,7 +194,8 @@ class SessionManager:
     def __init__(self, stream_params: StreamParams, proj,
                  decode_cfg, tri_cfg, max_sessions: int = 8,
                  session_ttl_s: float = 3600.0, store=None,
-                 preview_shed=None, replica_id: str | None = None):
+                 preview_shed=None, replica_id: str | None = None,
+                 lane_pool=None):
         self.stream_params = stream_params
         self.proj = proj
         self.decode_cfg = decode_cfg
@@ -184,6 +207,10 @@ class SessionManager:
         # set. None = durability off.
         self.store = store
         self.preview_shed = preview_shed
+        # Sticky device-lane placement (serve/lanes.py): sessions are
+        # assigned the least-loaded lane at create/restore and release
+        # it when they leave the registry. None = no lane dimension.
+        self.lane_pool = lane_pool
         # Fleet tier: journaled session heads carry the replica id, so
         # handoff-aware recovery can compare the WAL's claim against the
         # shared stream's current owner (serve/store.py).
@@ -239,8 +266,10 @@ class SessionManager:
             col_bits=self.proj.col_bits, row_bits=self.proj.row_bits,
             params=params, decode_cfg=self.decode_cfg,
             tri_cfg=self.tri_cfg, scan_id=scan_id or f"serve-{sid}")
+        lane = (self.lane_pool.assign_session(sid)
+                if self.lane_pool is not None else None)
         entry = ServeSession(sid, session, bucket_pixels=0,
-                             preview_shed=self.preview_shed)
+                             preview_shed=self.preview_shed, lane=lane)
         expired: list[str] = []
         evicted: list[str] = []
         with self._lock:
@@ -255,6 +284,8 @@ class SessionManager:
             live = sum(1 for s in self._sessions.values()
                        if not s.session.finalized)
             if live >= self.max_sessions:
+                if self.lane_pool is not None:  # undo the assignment
+                    self.lane_pool.release_session(sid)
                 raise SessionLimitError(self.max_sessions)
             self._sessions[sid] = entry
             # Evict oldest FINALIZED sessions past the cap (their result
@@ -270,11 +301,15 @@ class SessionManager:
         # session is attributable in a `cli diagnose` bundle instead of
         # silently 404ing.
         for k in expired:
+            if self.lane_pool is not None:
+                self.lane_pool.release_session(k)
             events.record("session_expired", session_id=k,
                           severity="warning", reason="idle_ttl",
                           ttl_s=self.session_ttl_s)
             self._journal_end(k, "idle_ttl")
         for k in evicted:
+            if self.lane_pool is not None:
+                self.lane_pool.release_session(k)
             events.record("session_evicted", session_id=k,
                           severity="warning", reason="finalized_cap",
                           max_sessions=self.max_sessions)
@@ -325,6 +360,8 @@ class SessionManager:
             entry = self._sessions.pop(session_id, None)
         if entry is None:
             raise UnknownSessionError(f"unknown session {session_id!r}")
+        if self.lane_pool is not None:
+            self.lane_pool.release_session(session_id)
         events.record("session_deleted", session_id=session_id,
                       stops_fused=entry.session.stops_fused)
         self._journal_end(session_id, "deleted")

@@ -55,7 +55,16 @@ def _mesh_ply_bytes(mesh) -> bytes:
 
 
 class DeviceWorker:
-    """Thread running the batch → launch → postprocess loop."""
+    """Thread running the batch → launch → postprocess loop.
+
+    With a lane pool (serve/lanes.py) each worker is PINNED to one
+    device lane: batches stage onto that chip, programs come from the
+    lane's per-device cache keys, and `next_batch(lane=…)` restricts the
+    flush to free buckets plus this lane's sticky-session ones. Buckets
+    past the pool's ``shard_min_pixels`` route to the sharded cross-chip
+    program instead (one huge job spans chips rather than serializing on
+    this lane).
+    """
 
     def __init__(self, batcher: BucketBatcher, cache: ProgramCache,
                  gates: QualityGates = QualityGates(),
@@ -63,7 +72,8 @@ class DeviceWorker:
                  registry: "trace.MetricsRegistry | None" = None,
                  tracer: "trace.Tracer | None" = None,
                  name: str = "serve-worker",
-                 governor=None, mesh_representation: str = "poisson"):
+                 governor=None, mesh_representation: str = "poisson",
+                 lane=None, lane_pool=None):
         self.batcher = batcher
         self.cache = cache
         self.gates = gates
@@ -74,6 +84,8 @@ class DeviceWorker:
         # Overload governor (serve/governor.py): fed worker outcomes for
         # the circuit breaker; the watchdog reads the heartbeat below.
         self.governor = governor
+        self.lane = lane                # DeviceLane | None
+        self.lane_pool = lane_pool      # DeviceLanePool | None
         self.name = name
         # Heartbeat: stamped every loop iteration. While the thread is
         # stuck inside a launch it goes stale — the watchdog's wedge
@@ -92,6 +104,23 @@ class DeviceWorker:
         self._padded = self.registry.counter(
             "serve_padded_slots_total",
             "batch slots filled with zero stacks to reach a bucketed size")
+        # Per-lane visibility (docs/SERVING.md § multi-chip): which chip
+        # did the work. Labeled by device so N workers sharing a chip
+        # sum into one series; "default" = no lane pool (historical
+        # single-device service).
+        lane_label = self.lane.label if self.lane is not None else "default"
+        self._lane_jobs = self.registry.counter(
+            "serve_lane_jobs_total", "jobs completed per device lane",
+            device=lane_label)
+        self._lane_batches = self.registry.counter(
+            "serve_lane_batches_total", "batches launched per device lane",
+            device=lane_label)
+        self._lane_occupancy = self.registry.histogram(
+            "serve_lane_occupancy", "real jobs per batch, per device lane",
+            buckets=(1, 2, 4, 8), device=lane_label)
+        self._sharded_batches = self.registry.counter(
+            "serve_sharded_batches_total",
+            "batches dispatched through the cross-chip sharded tier")
 
     # ------------------------------------------------------------------
 
@@ -124,7 +153,9 @@ class DeviceWorker:
                 return
             self.last_beat = time.monotonic()
             draining = self._stop.is_set()
-            batch = self.batcher.next_batch(timeout=0.05, force=draining)
+            batch = self.batcher.next_batch(
+                timeout=0.05, force=draining,
+                lane=self.lane.index if self.lane is not None else None)
             if batch is None:
                 if draining and self.batcher.pending_depth() == 0 \
                         and self.batcher.queue.depth() == 0:
@@ -167,15 +198,21 @@ class DeviceWorker:
         t0 = time.monotonic()
         for job in batch.jobs:
             job.mark_running()
-        key = ProgramKey(bucket=batch.key, batch=batch.size)
+        if self.lane_pool is not None:
+            # Lane routing (serve/lanes.py): the lane's per-device
+            # program, or the sharded cross-chip one for buckets past
+            # the size threshold.
+            key = self.lane_pool.route(batch.key, batch.size, self.lane)
+        else:
+            key = ProgramKey(bucket=batch.key, batch=batch.size)
         contained = False
         with self.tracer.span("serve.batch", program=key.label(),
                               occupancy=batch.occupancy):
             compiled = self.cache.get(key)
-            calib = self.cache.calib_provider(batch.key.height,
-                                              batch.key.width)
+            calib = self.cache.placed_calib(key)
             with self.tracer.span("launch"):  # path: serve.batch.launch
-                out = compiled(jnp.asarray(batch.stacked()), calib)
+                out = compiled(self.cache.stage(key, batch.stacked()),
+                               calib)
                 # Single readback of the dense batch result; everything
                 # after is host-side numpy.
                 points = np.asarray(out.points)
@@ -184,6 +221,11 @@ class DeviceWorker:
             self._batches.inc()
             self._occupancy.observe(batch.occupancy)
             self._padded.inc(batch.size - batch.occupancy)
+            self._lane_batches.inc()
+            self._lane_jobs.inc(batch.occupancy)
+            self._lane_occupancy.observe(batch.occupancy)
+            if key.shards:
+                self._sharded_batches.inc()
             with self.tracer.span("postprocess"):
                 for i, job in enumerate(batch.jobs):
                     contained |= self._finish_job(
@@ -267,10 +309,16 @@ class DeviceWorker:
         # them).
         from ..models import meshing
 
+        # Sharded-bucket jobs carry their heavy Poisson solve across the
+        # same device mesh the decode spanned (serve/lanes.py): the big
+        # programs (splat, CG) shard instead of serializing on one chip.
+        device_mesh = (self.lane_pool.solve_mesh(key)
+                       if self.lane_pool is not None else None)
         mesh = meshing.mesh_from_cloud(
             cloud, mode="watertight", depth=self.mesh_depth,
             quantile_trim=0.0,
-            representation=self.mesh_representation)
+            representation=self.mesh_representation,
+            device_mesh=device_mesh)
         meta.update(vertices=int(len(mesh.vertices)),
                     faces=int(len(mesh.faces)),
                     representation=self.mesh_representation)

@@ -288,3 +288,29 @@ def test_tsdf_headline_line_and_direction(tmp_path, capsys):
     assert rc == 1
     assert doc["rows"][0]["verdict"] == "REGRESSION"
     assert doc["regressions"] == 1
+
+
+def test_multidevice_sweep_headline_direction(tmp_path, capsys):
+    """Bench config [7b] adds ``serve_scans_per_s_8dev`` — throughput
+    with a device-count SUFFIX, so the bare ``endswith("_per_s")`` rule
+    no longer matches: the suffixed family must still be judged
+    higher-is-better (a throughput gain flagged as a latency regression
+    would gate improvements backwards)."""
+    assert bench_compare.higher_is_better("serve_scans_per_s_8dev")
+    assert not bench_compare.higher_is_better("fleet_failover_s")
+    _round(tmp_path, 1, _headline("serve_scans_per_s_8dev", 40.0))
+
+    # 8-device throughput UP: an improvement, strict passes.
+    rc = _run(tmp_path, _fresh(tmp_path, "serve_scans_per_s_8dev", 55.0),
+              "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["rows"][0]["verdict"] == "improved"
+
+    # Throughput DOWN beyond threshold: a regression, strict fails.
+    rc = _run(tmp_path, _fresh(tmp_path, "serve_scans_per_s_8dev", 30.0),
+              "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["rows"][0]["verdict"] == "REGRESSION"
+    assert doc["regressions"] == 1

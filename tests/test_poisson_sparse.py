@@ -430,6 +430,36 @@ def test_preconditioner_convergence_and_chi_parity(rng):
     assert 2 * iters["vcycle"] <= int(it_j), (iters, int(it_j))
 
 
+def test_sparse_warm_start_fewer_iterations(rng):
+    """The sparse half of the PR-10 warm-start contract
+    (`poisson.reconstruct(x0=…)` applied to the band solver): re-solving
+    the same cloud seeded from the previous grid must MEASURABLY cut the
+    fine-CG outer iteration count, and a mismatched grid must skip the
+    warm start cleanly (cold solve, warm_start_blocks=0)."""
+    pts, nrm = _sphere_cloud(rng, 8_000)
+    kw = dict(depth=9, cg_iters=200, max_blocks=16_384,
+              preconditioner="jacobi", with_stats=True)
+    g1, nb1, cold = poisson_sparse.reconstruct_sparse(pts, nrm, **kw)
+    assert cold["warm_start_blocks"] == 0
+    assert cold["cg_iters_used"] > 0
+    g2, nb2, warm = poisson_sparse.reconstruct_sparse(pts, nrm, x0=g1,
+                                                      **kw)
+    assert warm["warm_start_blocks"] > 0
+    assert warm["cg_iters_used"] < cold["cg_iters_used"], (cold, warm)
+    # Same problem, same answer: the warm solve's iso level matches.
+    assert abs(float(g2.iso) - float(g1.iso)) < 1e-3
+    # A grid from another resolution is refused gracefully — cold path
+    # (a NamedTuple _replace fakes the mismatch without a second
+    # depth's worth of compiles).
+    g3, nb3, skip = poisson_sparse.reconstruct_sparse(
+        pts, nrm, x0=g1._replace(resolution=2 ** 10), **kw)
+    assert skip["warm_start_blocks"] == 0
+    assert skip["cg_iters_used"] == cold["cg_iters_used"]  # truly cold
+    # Garbage x0 types fail loudly, before the solve.
+    with pytest.raises(TypeError):
+        poisson_sparse.reconstruct_sparse(pts, nrm, x0=np.zeros(3), **kw)
+
+
 def test_unknown_preconditioner_rejected(rng):
     pts, nrm = _sphere_cloud(rng, 100)
     with pytest.raises(ValueError, match="preconditioner"):

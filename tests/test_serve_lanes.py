@@ -1,0 +1,333 @@
+"""Multi-chip serving: device lanes, sticky sessions, sharded buckets.
+
+Runs on the virtual 8-device CPU mesh (conftest forces
+``--xla_force_host_platform_device_count=8`` — the same trick
+``__graft_entry__.dryrun_multichip`` uses), covering the serve tier's
+device dimension (serve/lanes.py):
+
+* lane pinning — every worker owns one device lane, programs are
+  per-chip ProgramKeys, and the warmed set covers every distinct lane
+  device (the zero-recompile bar, per chip);
+* sticky session placement — sessions land on distinct least-loaded
+  lanes, their stops carry lane affinity through the batcher, and a
+  stop on a warmed lane is COMPILE-FREE (sanitize.no_compile_region);
+* sharded-bucket dispatch — buckets past ``shard_min_pixels`` route to
+  ONE cross-chip program (rows over `parallel/mesh.py`'s space axis)
+  whose decode output matches the unsharded pipeline, and whose STL
+  postprocess solves over the same device mesh;
+* watchdog lane swap — a replaced worker re-pins to the SAME device
+  with the program-cache counters flat (the governor regression).
+
+Shapes are tiny (24x40 / 32x48 cameras, 24-frame protocol) so the whole
+module compiles a handful of sub-second programs per lane.
+"""
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import (
+    ProjectorConfig,
+)
+from structured_light_for_3d_model_replication_tpu.models import synthetic
+from structured_light_for_3d_model_replication_tpu.serve import (
+    DeviceLanePool,
+    ReconstructionService,
+    ServeConfig,
+)
+from structured_light_for_3d_model_replication_tpu.serve.batcher import (
+    BucketKey,
+)
+from structured_light_for_3d_model_replication_tpu.utils import sanitize
+
+PROJ = ProjectorConfig(width=64, height=32)     # 6+5 bits, 24 frames
+H, W = 24, 40                                   # lane-pinned bucket
+HB, WB = 32, 48                                 # sharded bucket
+BATCH_SIZES = (1, 2)
+N_LANES = 2
+
+
+def _bucket(h, w):
+    return BucketKey(height=h, width=w, frames=PROJ.n_frames,
+                     col_bits=PROJ.col_bits, row_bits=PROJ.row_bits)
+
+
+# ---------------------------------------------------------------------------
+# Lane pool (pure routing logic; needs only device enumeration)
+# ---------------------------------------------------------------------------
+
+
+def test_pool_spreads_lanes_round_robin_over_devices():
+    import jax
+
+    n_dev = len(jax.local_devices())
+    assert n_dev >= 8, "conftest must force 8 host devices"
+    pool = DeviceLanePool(n_lanes=4)
+    assert [ln.label for ln in pool.lanes] == \
+        [f"cpu:{i}" for i in range(4)]
+    assert pool.multi_device
+    # More lanes than devices wraps round-robin instead of failing.
+    pool = DeviceLanePool(n_lanes=3, max_devices=2)
+    assert [ln.label for ln in pool.lanes] == ["cpu:0", "cpu:1", "cpu:0"]
+
+
+def test_pool_single_device_routes_historical_keys():
+    """A one-device pool must produce the PRE-lane program keys
+    (device=None): existing single-worker services stay bit-identical,
+    warmed-set included."""
+    pool = DeviceLanePool(n_lanes=1)
+    assert not pool.multi_device
+    key = pool.route(_bucket(H, W), 1, pool.lane(0))
+    assert key.device is None and key.shards == 0
+    assert key.label() == f"B1:{H}x{W}x{PROJ.n_frames}"
+
+
+def test_pool_shard_threshold_and_divisibility():
+    pool = DeviceLanePool(n_lanes=2, shard_min_pixels=HB * WB,
+                          shard_devices=4)
+    # Below threshold: lane-pinned per-device program.
+    small = pool.route(_bucket(H, W), 2, pool.lane(1))
+    assert small.device == "cpu:1" and small.shards == 0
+    # At threshold: one cross-chip program, no device pin.
+    big = pool.route(_bucket(HB, WB), 2, pool.lane(1))
+    assert big.shards == 4 and big.device is None
+    assert big.label().endswith("@mesh4")
+    # Rows not divisible by the shard count: refuse the sharded tier
+    # (GSPMD padding would blur the dispatch decision) — lane-pinned.
+    odd = pool.route(_bucket(33, 64), 1, pool.lane(0))
+    assert odd.shards == 0 and odd.device == "cpu:0"
+    # Disabled tier: never sharded.
+    off = DeviceLanePool(n_lanes=2)
+    assert off.shards_for(_bucket(HB, WB)) == 0
+
+
+def test_pool_sticky_session_assignment_least_loaded():
+    pool = DeviceLanePool(n_lanes=2)
+    a = pool.assign_session("s-a")
+    b = pool.assign_session("s-b")
+    assert {a.index, b.index} == {0, 1}
+    assert pool.assign_session("s-a") is a      # idempotent
+    pool.release_session("s-a")
+    c = pool.assign_session("s-c")              # freed slot reused
+    assert c.index == a.index
+
+
+# ---------------------------------------------------------------------------
+# Integrated multi-lane service
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lane_stack():
+    cam = synthetic.default_calibration(H, W, PROJ)
+    stack, _ = synthetic.render_scan(synthetic.Scene(), *cam, H, W, PROJ)
+    return stack
+
+
+@pytest.fixture(scope="module")
+def big_stack():
+    cam = synthetic.default_calibration(HB, WB, PROJ)
+    stack, _ = synthetic.render_scan(synthetic.Scene(), *cam, HB, WB,
+                                     PROJ)
+    return stack
+
+
+@pytest.fixture(scope="module")
+def service():
+    from structured_light_for_3d_model_replication_tpu.stream import (
+        StreamParams,
+    )
+
+    # preview_depth 5: the per-lane session warmup executes the preview
+    # chain 3× per lane — the smallest dense grid keeps the module's
+    # startup cost bounded without changing any lane semantics.
+    cfg = ServeConfig(proj=PROJ, buckets=((H, W), (HB, WB)),
+                      batch_sizes=BATCH_SIZES, linger_ms=5.0,
+                      queue_depth=32, workers=N_LANES, mesh_depth=6,
+                      shard_min_pixels=HB * WB, shard_devices=2,
+                      stream=StreamParams(preview_depth=5))
+    svc = ReconstructionService(cfg).start()
+    yield svc
+    svc.drain(timeout=15.0)
+
+
+def _lane_counts(svc):
+    fam = svc.registry.snapshot().get("serve_lane_jobs_total", {})
+    return {k: v for k, v in fam.items()}
+
+
+def test_warmup_covers_every_lane_and_the_sharded_program(service):
+    labels = set(service._warmup_report)
+    frames = PROJ.n_frames
+    # Small bucket: one program per (batch, distinct lane device).
+    for b in BATCH_SIZES:
+        for d in range(N_LANES):
+            assert f"B{b}:{H}x{W}x{frames}@cpu:{d}" in labels
+    # Big bucket: the cross-chip sharded program only (never lane-pinned
+    # — warming per-device copies of a bucket that always dispatches
+    # sharded would be dead compiles).
+    for b in BATCH_SIZES:
+        assert f"B{b}:{HB}x{WB}x{frames}@mesh2" in labels
+        for d in range(N_LANES):
+            assert f"B{b}:{HB}x{WB}x{frames}@cpu:{d}" not in labels
+    # Session-lane warmup ran once per distinct lane device.
+    for d in range(N_LANES):
+        assert f"session:{H}x{W}@cpu:{d}" in labels
+
+
+def test_jobs_complete_across_lanes_with_zero_recompiles(service,
+                                                         lane_stack):
+    before = service.cache.stats()
+    jobs = [service.submit_array(lane_stack + np.uint8(1 + i))
+            for i in range(12)]
+    for j in jobs:
+        assert j.wait(60.0), j.status_dict()
+        assert j.status == "done", j.status_dict()
+    after = service.cache.stats()
+    assert after["misses"] == before["misses"], (before, after)
+    # Per-lane accounting: every completed job landed on SOME lane.
+    counts = _lane_counts(service)
+    assert sum(counts.values()) >= 12, counts
+    assert all("device=" in k for k in counts)
+
+
+def test_sticky_sessions_land_on_distinct_lanes(service, lane_stack):
+    s1 = service.create_session({"covis": False})
+    s2 = service.create_session({"covis": False})
+    e1 = service.sessions.get(s1["session_id"])
+    e2 = service.sessions.get(s2["session_id"])
+    assert e1.lane is not None and e2.lane is not None
+    assert e1.lane.index != e2.lane.index       # least-loaded spread
+    job = service.submit_session_stop(s1["session_id"], lane_stack)
+    assert job.lane == e1.lane.index            # stop carries affinity
+    assert job.wait(60.0) and job.status == "done", job.status_dict()
+    assert job.result_meta.get("fused") is not None \
+        or "stop" in job.result_meta, job.result_meta
+    assert e1.status_dict()["device_lane"] == e1.lane.label
+    # Second session's stop rides ITS lane.
+    job2 = service.submit_session_stop(s2["session_id"], lane_stack)
+    assert job2.lane == e2.lane.index
+    assert job2.wait(60.0) and job2.status == "done", job2.status_dict()
+
+
+def test_session_stop_is_compile_free_on_warm_lane(service, lane_stack):
+    """The per-lane session warmup contract: a stop on a sticky lane —
+    including the second stop's registration chain — compiles NOTHING
+    (this is exactly the failover-adoption window the fleet gate
+    measures, now per device lane)."""
+    sid = service.create_session({"covis": False})["session_id"]
+    entry = service.sessions.get(sid)
+    assert entry.lane is not None
+    before = service.cache.stats()
+    with sanitize.no_compile_region("serve-lane-session-stop"):
+        for i in (3, 9):
+            job = service.submit_session_stop(
+                sid, lane_stack + np.uint8(i))
+            assert job.wait(60.0) and job.status == "done", \
+                job.status_dict()
+    after = service.cache.stats()
+    assert after["misses"] == before["misses"], (before, after)
+
+
+def test_sharded_bucket_dispatch_and_decode_parity(service, big_stack):
+    """A big-bucket job rides the cross-chip program — and its decoded
+    cloud matches the single-device pipeline on the same stack."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.io.ply import (
+        read_ply,
+    )
+    from structured_light_for_3d_model_replication_tpu.models import (
+        pipeline,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.service \
+        import synthetic_calib_provider
+
+    sharded_before = service.registry.counter(
+        "serve_sharded_batches_total").value
+    job = service.submit_array(big_stack)
+    assert job.wait(120.0) and job.status == "done", job.status_dict()
+    assert service.registry.counter(
+        "serve_sharded_batches_total").value > sharded_before
+    import io
+
+    got = read_ply(io.BytesIO(job.result_bytes))
+
+    calib = synthetic_calib_provider(PROJ)(HB, WB)
+    out = pipeline.reconstruct(jnp.asarray(big_stack), calib,
+                               PROJ.col_bits, PROJ.row_bits)
+    keep = np.asarray(out.valid).astype(bool)
+    want = np.asarray(out.points)[keep]
+    assert got.points.shape == want.shape
+    np.testing.assert_allclose(got.points, want, atol=1e-3)
+
+
+@pytest.mark.slow
+def test_sharded_bucket_stl_solves_over_the_device_mesh(service,
+                                                        big_stack):
+    """STL postprocess of a sharded-bucket job: the Poisson solve runs
+    with the cloud sharded over the same device mesh
+    (`mesh_from_cloud(device_mesh=...)`) and still yields a watertight
+    mesh."""
+    job = service.submit_array(big_stack, result_format="stl")
+    assert job.wait(180.0) and job.status == "done", job.status_dict()
+    assert job.result_meta["faces"] > 0, job.result_meta
+
+
+@pytest.mark.slow
+def test_sharded_solve_pads_non_divisible_clouds():
+    """Point counts are valid-mask compactions — almost never an even
+    multiple of the shard count. The sharded solve must pad with
+    valid=False rows instead of crashing in device_put (regression:
+    the uneven split raised ValueError)."""
+    from structured_light_for_3d_model_replication_tpu.io.ply import (
+        PointCloud,
+    )
+    from structured_light_for_3d_model_replication_tpu.models import (
+        meshing,
+    )
+    from structured_light_for_3d_model_replication_tpu.parallel import (
+        mesh as pmesh,
+    )
+
+    rng = np.random.default_rng(0)
+    n = 4001                                   # 4001 % 2 == 1
+    v = rng.normal(size=(n, 3))
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    pts = (v * 50.0 + np.asarray([0.0, 0.0, 500.0])).astype(np.float32)
+    cloud = PointCloud(points=pts,
+                       colors=np.full((n, 3), 128, np.uint8))
+    mesh = meshing.mesh_from_cloud(
+        cloud, depth=5, quantile_trim=0.0,
+        device_mesh=pmesh.serve_space_mesh(2))
+    plain = meshing.mesh_from_cloud(cloud, depth=5, quantile_trim=0.0)
+    assert len(mesh.faces) > 500
+    # The padded rows are valid=False: they change NOTHING about the
+    # solve (normalization and splat are valid-masked).
+    assert abs(len(mesh.faces) - len(plain.faces)) \
+        <= 0.02 * len(plain.faces)
+
+
+def test_watchdog_lane_swap_keeps_device_and_cache_counters(service,
+                                                            lane_stack):
+    """Governor regression (the wedged-worker path): the replacement
+    worker re-pins to the SAME device lane, the swap itself touches no
+    program-cache counters, and the next job is a cache HIT on the
+    lane's existing executables."""
+    wedged = service.workers[1]
+    lane_before = wedged.lane
+    before = service.cache.stats()
+    repl = service._restart_worker(wedged)
+    assert repl is service.workers[1]
+    assert repl.lane is lane_before              # same device identity
+    assert repl.lane.label == lane_before.label
+    assert repl.alive
+    mid = service.cache.stats()
+    assert mid["misses"] == before["misses"]     # swap compiled nothing
+    assert mid["hits"] == before["hits"]
+    jobs = [service.submit_array(lane_stack + np.uint8(40 + i))
+            for i in range(4)]
+    for j in jobs:
+        assert j.wait(60.0) and j.status == "done", j.status_dict()
+    after = service.cache.stats()
+    assert after["misses"] == mid["misses"], (mid, after)
+    assert after["hits"] > mid["hits"]

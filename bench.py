@@ -89,6 +89,13 @@ The remaining BASELINE configs are measured too and written to
     per-stop preview seconds; vs_baseline = Poisson preview median /
     TSDF median, > 1 means TSDF is faster), with stops 5-24 asserted
     compile-free.
+12. splat appearance tier (`splat/`, docs/RENDERING.md): stops 0-22 of
+    the same ring stream through a ``representation="splat"`` session
+    (scan → TSDF fuse → splat seed + appearance fit), then a 20-view
+    novel-view orbit sweep — emits ``render_view_s`` (median seconds
+    per rendered view, compile-free steady state asserted) and
+    ``render_psnr_db`` (render from HELD-OUT stop 23's predicted
+    camera vs its captured RGB, gated ≥ 20 dB).
 
 ``SL_BENCH_ONLY=name1,name2`` (config names as recorded in
 BENCH_DETAILS) restricts a run to just those configs — the nightly
@@ -767,6 +774,129 @@ def main():
 
     if "stacks_np" in state and "params" in state:
         guarded("tsdf_stream_preview", config11)
+
+    # ------------------------------------------------------------------
+    # Config 12: the splat appearance tier end-to-end (splat/,
+    # docs/RENDERING.md) on the same 24-stop ring: stops 0-22 stream
+    # through a representation="splat" session (decode → register →
+    # TSDF fuse → RGB frame buffer), the scene is seeded on the fused
+    # shell and fitted against the captured frames, then a 20-view
+    # novel-view orbit sweep renders through ONE compiled program
+    # (steady state asserted compile-free). Headlines: `render_view_s`
+    # (median seconds per novel view) and `render_psnr_db` — PSNR of
+    # the render from HELD-OUT stop 23's predicted camera against that
+    # stop's actually-captured (decode-valid) RGB, gated ≥ 20 dB. The
+    # held-out stop never entered the fit: this measures novel-view
+    # appearance quality, not training-frame memorization.
+    # ------------------------------------------------------------------
+    def config12():
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            splat_render as sr_mod,
+        )
+        from structured_light_for_3d_model_replication_tpu.splat import (
+            fit as splat_fit,
+        )
+        from structured_light_for_3d_model_replication_tpu.stream import (
+            IncrementalSession,
+            StreamParams,
+        )
+        from structured_light_for_3d_model_replication_tpu.utils import (
+            sanitize,
+        )
+
+        stacks_np = state["stacks_np"]
+        base = state["params"]
+        sp = StreamParams(
+            merge=base.merge, method="sequential",
+            view_cap=base.view_cap, model_cap=131_072,
+            preview_every=0,     # this config times renders, not meshes
+            final_depth=10, expected_stops=24,
+            representation="splat", tsdf_grid_depth=8,
+            tsdf_max_bricks=16_384, splat_cap=16_384,
+            splat_fit_iters=200, splat_max_frames=8)
+        SWEEP = 20
+
+        def run_session(tag, shift):
+            sess = IncrementalSession(
+                calib, proj.col_bits, proj.row_bits, params=sp,
+                key=jax.random.PRNGKey(12), scan_id=f"bench12-{tag}")
+            for k in range(23):          # stop 23 is HELD OUT
+                sess.add_stop(stacks_np[k] + np.uint8(shift))
+            return sess
+
+        def heldout_psnr(sess, scene, shift):
+            mesher = sess._mesher
+            pts, cols, vals = scan360.decode_stop(
+                stacks_np[23] + np.uint8(shift), calib, proj.col_bits,
+                proj.row_bits)
+            h, w = stacks_np.shape[2], stacks_np.shape[3]
+            target, mask = splat_fit.frame_target(
+                np.asarray(cols), np.asarray(vals), h, w, mesher.stride)
+            fx, fy, cx, cy = mesher.intrinsics
+            s = float(mesher.stride)
+            cam = sr_mod.stop_camera(sess._predict_pose(23), fx / s,
+                                     fy / s, cx / s, cy / s)
+            cfg_fit = sr_mod.RenderConfig(width=target.shape[1],
+                                          height=target.shape[0])
+            img, _ = scene.render_camera(cam, cfg_fit)
+            return splat_fit.psnr(np.asarray(img), target, mask)
+
+        _log("[12] warming the splat session + render programs "
+             "(untimed pass)...")
+        warm = run_session("warm", 0)
+        warm_scene = warm._mesher.ensure_scene()
+        warm._mesher.render_image(0.0, 20.0)
+        heldout_psnr(warm, warm_scene, 0)
+
+        sess = run_session("timed", 3)
+        mesher = sess._mesher
+        t_fit = time.perf_counter()
+        scene = mesher.ensure_scene()      # seed + appearance fit
+        fit_s = time.perf_counter() - t_fit
+        mesher.render_image(0.0, 20.0)     # warm placement
+        per_view = []
+        with sanitize.no_compile_region("bench12-render-sweep"):
+            for i in range(SWEEP):
+                ts = time.perf_counter()
+                img = mesher.render_image(360.0 * i / SWEEP, 20.0)
+                per_view.append(time.perf_counter() - ts)
+        assert img is not None and img.shape[2] == 3
+        render_view_s = statistics.median(per_view)
+        psnr_db = heldout_psnr(sess, scene, 3)
+        assert psnr_db >= 20.0, (
+            f"held-out render PSNR {psnr_db:.1f} dB below the 20 dB "
+            "quality gate")
+        print(json.dumps({
+            "metric": "render_view_s",
+            "value": round(render_view_s, 4), "unit": "s",
+            "vs_baseline": None,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "render_psnr_db",
+            "value": round(psnr_db, 2), "unit": "dB",
+            "vs_baseline": None,
+        }), flush=True)
+        details["splat_render_view"] = {
+            "value_s": round(render_view_s, 4),
+            "render_view_s_per_view": [round(t, 4) for t in per_view],
+            "render_size": list(sp.splat_render_sizes[0]),
+            "render_psnr_db": round(psnr_db, 2),
+            "heldout_stop": 23,
+            "fit_plus_seed_s": round(fit_s, 3),
+            "fit_stats": dict(scene.fit_stats),
+            "splats": scene.n_splats,
+            "volume_stats": mesher.volume.stats(),
+            "steady_state_compiles": 0,  # asserted by no_compile_region
+        }
+        _log(f"[12] splat tier: {scene.n_splats} splats, fit+seed "
+             f"{fit_s:.1f} s, render {render_view_s * 1e3:.0f} ms/view "
+             f"({sp.splat_render_sizes[0][0]}x"
+             f"{sp.splat_render_sizes[0][1]}), held-out PSNR "
+             f"{psnr_db:.1f} dB")
+        flush_details()
+
+    if "stacks_np" in state and "params" in state:
+        guarded("splat_render_view", config12)
     state.pop("stacks_np", None)  # free host memory before configs 3-5
     state.pop("params", None)
 

@@ -39,6 +39,7 @@ _DETAILS_ALIASES = {
     "full_360_scan_to_mesh": "full_360_scan_to_mesh_s",
     "full_360_24x46_1080p": "full_360_scan_24x46_1080p_s",
     "tsdf_stream_preview": "tsdf_preview_s",
+    "splat_render_view": "render_view_s",
 }
 
 
@@ -46,11 +47,15 @@ def higher_is_better(metric: str) -> bool:
     """Most headline metrics are seconds (lower wins); throughput lines
     (config [9]'s ``soak_scans_per_s``, config [10]'s
     ``fleet_scans_per_s``, and the suffixed device-sweep family like
-    config [7b]'s ``serve_scans_per_s_8dev``) invert — going UP is the
-    improvement, going down the regression. Latency-shaped fleet lines
-    (``fleet_failover_s``) and config [11]'s per-stop preview latency
-    (``tsdf_preview_s``) keep the lower-wins default."""
-    return metric.endswith("_per_s") or "_per_s_" in metric
+    config [7b]'s ``serve_scans_per_s_8dev``) and QUALITY lines
+    (config [12]'s ``render_psnr_db`` — decibels of rendered fidelity)
+    invert — going UP is the improvement, going down the regression.
+    Latency-shaped fleet lines (``fleet_failover_s``), config [11]'s
+    per-stop preview latency (``tsdf_preview_s``) and config [12]'s
+    per-view render latency (``render_view_s``) keep the lower-wins
+    default."""
+    return (metric.endswith("_per_s") or "_per_s_" in metric
+            or metric.endswith("_psnr_db"))
 
 
 def _headline_metrics(text: str) -> dict[str, float]:

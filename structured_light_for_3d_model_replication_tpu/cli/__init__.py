@@ -17,6 +17,10 @@ host:
 ``view``           render a .ply/.stl to PNG — the headless stand-in for the
                    reference's Open3D viewer moments (`Old/New360.py:72`,
                    `Old/StatisticalOutlierRemoval.py:66-71`)
+``render``         novel-view PNGs from a splat scene (.npz from
+                   ``GET /session/<id>/splats``) or a colored cloud —
+                   the offline half of the rendered-result surface
+                   (docs/RENDERING.md)
 ``serve``          continuous-batching reconstruction service: HTTP
                    submit/status/result over the batched pipeline
                    (docs/SERVING.md)
@@ -39,6 +43,7 @@ _TOOLS = {
     "lint": "lint",
     "process-cloud": "process_cloud",
     "read-calib": "read_calib",
+    "render": "render",
     "merge-360": "merge_360",
     "scan-360": "scan_360",
     "mesh": "mesh",

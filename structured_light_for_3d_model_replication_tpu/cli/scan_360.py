@@ -58,13 +58,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="coarse Poisson depth of the per-stop previews")
     s.add_argument("--preview-every", type=int, default=1,
                    help="emit a preview every N fused stops (0 = off)")
-    s.add_argument("--representation", choices=("poisson", "tsdf"),
+    s.add_argument("--representation",
+                   choices=("poisson", "tsdf", "splat"),
                    default="poisson",
                    help="scene representation (docs/STREAMING.md, batch "
                         "and --stream): 'tsdf' fuses into a brick volume "
                         "(fusion/) — streaming stops integrate instead of "
                         "re-solving, and the final mesh carries vertex "
-                        "color when --stl names a .ply (STL drops color)")
+                        "color when --stl names a .ply (STL drops color); "
+                        "'splat' adds the Gaussian appearance tier "
+                        "(docs/RENDERING.md) — rendered previews "
+                        "(--preview-render) and a saveable scene "
+                        "(--save-scene). Streaming-only; the batch path "
+                        "treats it as 'tsdf'")
+    s.add_argument("--preview-render", action="store_true",
+                   help="with --stream --representation splat: also "
+                        "rewrite a rendered novel-view PNG "
+                        "(<output>.preview.png) after every fused stop")
+    s.add_argument("--save-scene", default=None, metavar="PATH",
+                   help="with --stream --representation splat: save the "
+                        "fitted splat scene (.npz) at the end — `cli "
+                        "render` reproduces the renders offline")
     g = p.add_argument_group("quality gates (docs/ROBUSTNESS.md)")
     g.add_argument("--no-gates", action="store_true",
                    help="disable the quality gates (abort-on-anything "
@@ -176,6 +190,10 @@ def main(argv=None) -> int:
     if args.stl:
         from ..models import meshing
 
+        # The batch path has no per-stop frames to fit appearance from —
+        # 'splat' degrades to its geometry half (the colored TSDF mesh).
+        if args.representation == "splat":
+            args.representation = "tsdf"
         if args.representation == "tsdf" \
                 and not args.stl.lower().endswith(".ply"):
             print("note: --representation tsdf meshes carry vertex color "
@@ -254,6 +272,11 @@ def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
     sess = IncrementalSession(cal, col_bits, row_bits, params=params,
                               health=health)
     preview_path = args.preview_out or (args.output + ".preview.stl")
+    render_path = args.output + ".preview.png"
+    want_render = args.preview_render and args.representation == "splat"
+    if args.preview_render and not want_render:
+        print("--preview-render needs --representation splat; ignored",
+              file=sys.stderr)
     t0 = time.monotonic()
     first_preview_s = None
     for k, d in enumerate(stop_dirs):
@@ -265,6 +288,16 @@ def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
                    else "")
                 + f", {res.seconds:.1f}s)")
         print(line, file=sys.stderr)
+        if want_render and res.fused:
+            # Rendered novel-view preview (splat/, docs/RENDERING.md) —
+            # rebuilt lazily from the volume + frame buffer after EVERY
+            # fused stop (independent of the mesh-preview cadence, as
+            # the flag promises).
+            img = sess._mesher.render_image(30.0, 20.0)
+            if img is not None:
+                from ..io.png import write_png
+
+                write_png(render_path, img)
         if res.preview and sess.preview is not None:
             if preview_path.lower().endswith(".ply"):
                 ply_io.write_ply_mesh(preview_path, sess.preview)
@@ -274,7 +307,9 @@ def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
                 first_preview_s = time.monotonic() - t0
                 print(f"first preview {first_preview_s:.1f}s after stop "
                       f"{labels[k]} -> {preview_path} "
-                      f"({len(sess.preview.faces)} faces)",
+                      f"({len(sess.preview.faces)} faces)"
+                      + (f" + render -> {render_path}" if want_render
+                         else ""),
                       file=sys.stderr)
     from ..health import ScanFault
 
@@ -297,6 +332,18 @@ def _run_stream(args, stop_dirs, step_deg, stop_labels, gates,
     print(f"{sess.stops_fused} fused / {sess.stops_skipped} skipped "
           f"stops -> {args.output} ({len(fin.cloud)} points)",
           file=sys.stderr)
+    if args.save_scene:
+        if args.representation == "splat":
+            data = sess._mesher.scene_bytes()
+            if data is not None:
+                with open(args.save_scene, "wb") as f:
+                    f.write(data)
+                print(f"splat scene -> {args.save_scene} "
+                      f"({len(data)} B; render offline with "
+                      f"`cli render`)", file=sys.stderr)
+        else:
+            print("--save-scene needs --representation splat; ignored",
+                  file=sys.stderr)
     if args.stl and fin.mesh is not None:
         colored = getattr(fin.mesh, "vertex_colors", None) is not None
         if args.stl.lower().endswith(".ply"):

@@ -81,12 +81,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.stream.preview_depth,
                    help="coarse Poisson depth of per-stop session "
                         "previews (finalize uses the full depth)")
-    p.add_argument("--representation", choices=("poisson", "tsdf"),
+    p.add_argument("--representation",
+                   choices=("poisson", "tsdf", "splat"),
                    default=d.stream.representation,
                    help="default session scene representation "
                         "(docs/STREAMING.md): 'tsdf' previews integrate "
                         "incrementally (fusion/) and finalize meshes "
-                        "carry vertex color; per-session override via "
+                        "carry vertex color; 'splat' adds rendered "
+                        "novel views (GET /session/<id>/render, "
+                        "docs/RENDERING.md); per-session override via "
                         "the POST /session body")
     p.add_argument("--mesh-representation", choices=("poisson", "tsdf"),
                    default=d.mesh_representation,

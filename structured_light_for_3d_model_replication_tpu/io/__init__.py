@@ -18,4 +18,5 @@ from .images import (  # noqa: F401
 from .layout import SessionLayout, frame_name, list_clouds  # noqa: F401
 from .matcal import load_calibration_mat, save_calibration_mat  # noqa: F401
 from .ply import PointCloud, read_ply, write_ply  # noqa: F401
+from .png import decode_png, png_bytes, write_png  # noqa: F401
 from .stl import TriangleMesh, read_stl, write_stl  # noqa: F401

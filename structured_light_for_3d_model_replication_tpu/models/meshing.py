@@ -89,6 +89,7 @@ def mesh_from_cloud(
     tsdf_max_bricks: int = 8192,
     cg_x0=None,
     device_mesh=None,
+    solve_stats: dict | None = None,
 ) -> TriangleMesh:
     """Poisson-mesh a cloud (the body of `reconstruct_stl` / `mesh_360`).
 
@@ -120,8 +121,16 @@ def mesh_from_cloud(
     is ``2^depth`` voxels per axis) and ``quantile_trim`` trims the
     lowest-weight triangle fraction; ``tsdf_max_bricks`` bounds the
     brick pool (overflow degrades to holes, logged). ``cg_x0``
-    warm-starts the DENSE Poisson CG from a previous solve's χ grid
-    (streaming finalize; ignored by the sparse and TSDF paths).
+    warm-starts the Poisson solve: on the dense (≤ 8) path a χ ARRAY at
+    the solve resolution seeds the CG directly; on the sparse (> 8)
+    path a dense ``poisson.PoissonGrid`` (the streaming previewer's
+    last grid) warm-starts the internal coarse solve and a
+    ``SparsePoissonGrid`` reseeds the band — see
+    ``poisson_sparse.reconstruct_sparse``. The TSDF path ignores it.
+    ``solve_stats`` (a caller-supplied dict) is filled with the sparse
+    solver's ``with_stats`` output (``cg_iters_used``,
+    ``coarse_iters_used``, ``warm_start_blocks``) — the streaming
+    finalize's warm-start assertion reads it.
 
     ``device_mesh`` (a ``parallel/mesh.py`` Mesh, docs/MESHING.md §
     sharded solve) stages the cloud sharded over the mesh's space axis
@@ -206,11 +215,17 @@ def mesh_from_cloud(
         # Block-budget overflow (→ dropped blocks → holes) is detected and
         # handled INSIDE reconstruct_sparse before the solve runs.
         kw = {} if max_blocks is None else {"max_blocks": int(max_blocks)}
+        if cg_x0 is not None and isinstance(
+                cg_x0, (poisson.PoissonGrid,
+                        poisson_sparse.SparsePoissonGrid)):
+            kw["x0"] = cg_x0
         # NOT solve_pts: the sparse solver keeps single placement (see
         # the device_mesh docstring note).
-        grid, n_blocks = poisson_sparse.reconstruct_sparse(
+        grid, n_blocks, stats = poisson_sparse.reconstruct_sparse(
             pts, normals, depth=int(depth), cg_iters=cg_iters,
-            preconditioner=preconditioner, **kw)
+            preconditioner=preconditioner, with_stats=True, **kw)
+        if solve_stats is not None:
+            solve_stats.update(stats)
         log.info("sparse Poisson depth=%d: %d active blocks", int(depth),
                  int(n_blocks))
         mesh = marching.extract_sparse(grid, quantile_trim=trim,

@@ -1020,6 +1020,75 @@ def _iso_sparse(chi, density, flat, w, cfound, valid):
     return jnp.sum(chi_pts * den_pts) / jnp.maximum(jnp.sum(den_pts), 1e-12)
 
 
+# donate_argnames=() is a DECISION: prev_chi belongs to the caller's
+# preview grid (a finalize may re-mesh at a new trim and warm-start
+# again) and points/valid feed the setup + coarse solve after this.
+# in_shardings=None leaves placement to propagation, like every solver
+# jit here (docs/JAXLINT.md sharding-readiness).
+@functools.partial(jax.jit, static_argnames=("rc",),
+                   donate_argnames=(),
+                   in_shardings=None, out_shardings=None)
+def _resample_chi_to_coarse(prev_chi, prev_origin, prev_scale, points,
+                            valid, rc: int):
+    """Trilinearly resample a DENSE preview χ grid onto this solve's
+    internal coarse frame (the dense→sparse half of the warm-start
+    contract): the coarse dense solve then starts from the preview's
+    converged field instead of zeros, so its residual stop fires after
+    measurably fewer iterations (streaming finalize — the previews
+    watched the SAME model the finalize merges). World-aligned through
+    each grid's own (origin, scale), so the preview's normalization
+    never has to match. Outside the preview's domain the seed is the
+    cold zero. Slab-mapped (``lax.map`` over x-planes) so the 256³
+    coarse case never materializes the full gather tensor."""
+    rp = prev_chi.shape[0]
+    _, origin_c, scale_c = dense_poisson.normalize_points(points, valid,
+                                                          rc)
+    v = jnp.arange(rc, dtype=jnp.float32)
+
+    def slab(xi):
+        Y, Z = jnp.meshgrid(v, v, indexing="ij")
+        world = origin_c[None, None, :] + jnp.stack(
+            [jnp.full((rc, rc), xi, jnp.float32), Y, Z],
+            axis=-1) * scale_c
+        q = (world - prev_origin[None, None, :]) / prev_scale
+        inside = jnp.all((q >= 0.0) & (q <= rp - 1.0), axis=-1)
+        qc = jnp.clip(jnp.floor(q).astype(jnp.int32), 0, rp - 2)
+        f = jnp.clip(q - qc.astype(jnp.float32), 0.0, 1.0)
+
+        def g(dx, dy, dz):
+            return prev_chi[qc[..., 0] + dx, qc[..., 1] + dy,
+                            qc[..., 2] + dz]
+
+        fx, fy, fz = f[..., 0], f[..., 1], f[..., 2]
+        c00 = g(0, 0, 0) * (1 - fz) + g(0, 0, 1) * fz
+        c01 = g(0, 1, 0) * (1 - fz) + g(0, 1, 1) * fz
+        c10 = g(1, 0, 0) * (1 - fz) + g(1, 0, 1) * fz
+        c11 = g(1, 1, 0) * (1 - fz) + g(1, 1, 1) * fz
+        c0 = c00 * (1 - fy) + c01 * fy
+        c1 = c10 * (1 - fy) + c11 * fy
+        return jnp.where(inside, c0 * (1 - fx) + c1 * fx, 0.0)
+
+    return jax.lax.map(slab, v)
+
+
+def _dense_cover_blocks(block_coords, block_valid, origin, scale,
+                        prev) -> int:
+    """How many ACTIVE band blocks the dense preview grid covers — the
+    ``warm_start_blocks`` bookkeeping of the dense-x0 path (the blocks
+    whose coarse seed the preview field informed)."""
+    bv = _np.asarray(block_valid)
+    bc = _np.asarray(block_coords)[bv]
+    if bc.shape[0] == 0:
+        return 0
+    center = (bc.astype(_np.float64) * BS + 0.5 * BS)
+    world = _np.asarray(origin, _np.float64) + center * float(scale)
+    q = (world - _np.asarray(prev.origin, _np.float64)) \
+        / float(prev.scale)
+    rp = prev.chi.shape[0]
+    inside = _np.all((q >= 0.0) & (q <= rp - 1.0), axis=-1)
+    return int(inside.sum())
+
+
 def _warm_start_seed(seed, prev: SparsePoissonGrid, block_coords,
                      block_valid, origin, scale, resolution: int):
     """Overlay a previous solve's χ onto the new band's CG seed.
@@ -1120,21 +1189,26 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
     keywords is an error — silent precedence between the two was a
     depth-10-instead-of-15 footgun.
 
-    ``x0`` WARM-STARTS the fine-band CG from a previous solve's grid
-    (the sparse half of the PR-10 dense-preview contract,
-    ``poisson.reconstruct(x0=…)``): blocks present in both bands seed
-    from the previous converged χ instead of the coarse prolongation,
-    so a repeated solve of a barely-changed cloud (streaming finalize
-    after previews, re-mesh at new trim) spends measurably fewer outer
-    iterations. Accepted only when resolution AND grid normalization
-    (origin/scale) match — otherwise it is skipped with a log line and
-    the solve is exactly the cold one.
+    ``x0`` WARM-STARTS the solve from a previous grid. A
+    :class:`SparsePoissonGrid` (a previous ``reconstruct_sparse``)
+    seeds the FINE band directly: blocks present in both bands start
+    from the previous converged χ instead of the coarse prolongation —
+    accepted only when resolution AND grid normalization (origin/scale)
+    match, otherwise skipped with a log line. A DENSE
+    ``poisson.PoissonGrid`` (a streaming preview's last solve) instead
+    warm-starts the INTERNAL COARSE dense solve, world-aligned through
+    each grid's own normalization (the preview watched the same model
+    the finalize merges, so the coarse residual stop fires after
+    measurably fewer iterations); overlaying a coarser preview onto the
+    fine band directly would only degrade the prolongation it replaces.
 
     ``with_stats`` appends a third return value, a dict with
     ``cg_iters_used`` (fine-band iterations the residual stop actually
-    spent), ``preconditioner`` and ``warm_start_blocks`` (matched
-    blocks seeded from ``x0``; 0 = cold) — the bench's ≤ 30-iteration
-    gate and the convergence tests read it instead of scraping logs.
+    spent), ``coarse_iters_used`` (the internal coarse solve's count —
+    the dense-x0 warm start's measurable win), ``preconditioner`` and
+    ``warm_start_blocks`` (band blocks seeded/covered by ``x0``; 0 =
+    cold) — the bench's ≤ 30-iteration gate and the convergence tests
+    read it instead of scraping logs.
     """
     given = {k: v for k, v in dict(
         depth=depth, cg_iters=cg_iters, screen=screen,
@@ -1232,23 +1306,48 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
     # rtol forwards: the coarse chi becomes the fine band's Dirichlet
     # halo, so coarse accuracy bounds what the caller's rtol can buy.
     rc = 2 ** min(coarse_depth, depth)
-    # warm=False: the cold-start zeros grid allocates INSIDE the jitted
-    # solve (hoisting it pinned an extra non-donated rc³ operand for the
-    # whole coarse phase — see dense_poisson._solve).
-    coarse, _ = dense_poisson._solve(points, normals, valid,
-                                     jnp.zeros((), jnp.float32),
-                                     rc, coarse_iters,
-                                     jnp.float32(screen), rtol=rtol,
-                                     warm=False)
+    dense_x0 = None
+    if x0 is not None and isinstance(x0, dense_poisson.PoissonGrid):
+        # Dense preview grid (streaming finalize): it warm-starts the
+        # INTERNAL COARSE solve — the band seed then prolongs from a
+        # coarse field that converged in fewer iterations; overlaying
+        # a coarser preview onto the fine band directly would only
+        # degrade the prolongation it replaces.
+        dense_x0, x0 = x0, None
+    if dense_x0 is not None:
+        x0c = _resample_chi_to_coarse(
+            jnp.asarray(dense_x0.chi, jnp.float32),
+            jnp.asarray(dense_x0.origin, jnp.float32),
+            jnp.asarray(dense_x0.scale, jnp.float32), points, valid, rc)
+        coarse, coarse_used = dense_poisson._solve(
+            points, normals, valid, x0c, rc, coarse_iters,
+            jnp.float32(screen), rtol=rtol, warm=True)
+    else:
+        # warm=False: the cold-start zeros grid allocates INSIDE the
+        # jitted solve (hoisting it pinned an extra non-donated rc³
+        # operand for the whole coarse phase — see dense_poisson.
+        # _solve).
+        coarse, coarse_used = dense_poisson._solve(
+            points, normals, valid, jnp.zeros((), jnp.float32),
+            rc, coarse_iters, jnp.float32(screen), rtol=rtol,
+            warm=False)
     b, seed = _prolong_band(coarse.chi, rhs, nbr, block_valid,
                             block_coords, 2 ** depth,
                             2 ** min(coarse_depth, depth))
     warm_blocks = 0
+    if dense_x0 is not None:
+        warm_blocks = _dense_cover_blocks(block_coords, block_valid,
+                                          origin, scale, dense_x0)
+        log.info("sparse Poisson depth=%d: dense preview grid warm-"
+                 "started the %d^3 coarse solve (%d/%d iterations, "
+                 "%d band blocks covered)", depth, rc, int(coarse_used),
+                 coarse_iters, warm_blocks)
     if x0 is not None:
         if not isinstance(x0, SparsePoissonGrid):
             raise TypeError(
                 f"x0 must be a SparsePoissonGrid from a previous "
-                f"reconstruct_sparse call, got {type(x0).__name__}")
+                f"reconstruct_sparse call (or a dense poisson."
+                f"PoissonGrid preview), got {type(x0).__name__}")
         seed, warm_blocks = _warm_start_seed(
             seed, x0, block_coords, block_valid, origin, scale,
             2 ** depth)
@@ -1283,6 +1382,7 @@ def reconstruct_sparse(points, normals, valid=None, depth: int | None = None,
                              iso, origin, scale, 2 ** depth, nbr=nbr)
     if with_stats:
         return grid, n_blocks, {"cg_iters_used": int(cg_used),
+                                "coarse_iters_used": int(coarse_used),
                                 "preconditioner": preconditioner,
                                 "warm_start_blocks": warm_blocks}
     return grid, n_blocks

@@ -1,0 +1,313 @@
+"""Tile-binned Gaussian-splat rasterizer (XLA form = the CPU oracle).
+
+The appearance tier's renderer (`splat/`, docs/RENDERING.md): anisotropic
+3D Gaussians anchored on the TSDF shell are projected to screen-space
+conics (the EWA recipe), binned into fixed-size image tiles, depth-sorted
+front-to-back per tile and alpha-composited — the Gaussian-Plus-SDF /
+3DGS rendering model restated under this repo's static-shape discipline:
+
+* every shape is fixed by ``(splat capacity, RenderConfig)`` — the splat
+  count, the camera pose and the view angles are all TRACED, so a render
+  sweep over arbitrary azimuth/elevation reuses ONE compiled program per
+  resolution (the serve render endpoint's zero-steady-state-recompile
+  bar);
+* tile binning is a dense (tiles, splats) overlap mask + ``lax.top_k``
+  by depth — the prefix-sum-compaction spirit of `ops/marching_jax.py`
+  (bounded static capacities, never a host hash), with the K nearest
+  splats per tile kept and the far tail truncated (K is generous:
+  ``RenderConfig.max_per_tile``);
+* the per-tile composite exists twice with one numerical contract: the
+  vectorized XLA form below (differentiable — the fit loop in
+  `splat/fit.py` rides its gradients) and the fused Pallas kernel
+  (:mod:`.splat_render_pallas`) behind ``_backend.tpu_backend()``,
+  pinned against each other in tests/test_splat.py.
+
+Camera model: pinhole ``u = fx·x/z + cx`` after the world→camera rigid
+map ``x = R_wc (p − eye)`` — :func:`orbit_camera` reproduces the `viz`
+orbit conventions (y-up turntable, image +v down) so rendered previews
+and ``cli view`` agree on framing, and :func:`stop_camera` turns a
+session stop pose into the same tuple for fitting against captured RGB.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import _backend
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+#: Background matches viz.BACKGROUND so mixed mesh/splat previews read
+#: as one family.
+BG_DEFAULT = (18, 20, 26)
+
+
+class RenderConfig(NamedTuple):
+    """Static (program-keying) half of a render: one compiled program
+    per distinct config — resolution changes recompile, angles never.
+
+    ``tile``/``max_per_tile`` trade memory for depth capacity: each
+    tile composites its ``max_per_tile`` NEAREST splats (truncating the
+    far tail), so a tile must be small enough that K covers the front
+    surface across the tile's whole AREA — a coarse tile over a dense
+    cloud keeps K splats clustered at its closest corner and leaves the
+    rest showing background (the failure mode the 8-px default
+    avoids)."""
+
+    width: int = 384
+    height: int = 288
+    tile: int = 8              # square pixel tiles
+    max_per_tile: int = 128    # K nearest splats composited per tile
+    bg: tuple = BG_DEFAULT     # RGB 0-255
+
+    @property
+    def tiles_x(self) -> int:
+        return -(-self.width // self.tile)
+
+    @property
+    def tiles_y(self) -> int:
+        return -(-self.height // self.tile)
+
+
+# ---------------------------------------------------------------------------
+# Cameras (host-side helpers; outputs are plain arrays, traced by render)
+# ---------------------------------------------------------------------------
+
+
+def orbit_camera(lo, hi, azim_deg: float, elev_deg: float,
+                 width: int, height: int, zoom: float = 2.1,
+                 fov_scale: float = 1.15):
+    """Orbit pinhole around the bbox ``[lo, hi]`` — the `viz`
+    ``_orbit_camera`` conventions (y-up axis, image +v down) expressed
+    as the ``(R_wc, eye, fx, fy, cx, cy)`` tuple :func:`render` takes.
+    Angles are plain floats: they land in TRACED operands, so a sweep
+    never recompiles."""
+    lo = _np.asarray(lo, _np.float64)
+    hi = _np.asarray(hi, _np.float64)
+    center = 0.5 * (lo + hi)
+    radius = max(float(_np.linalg.norm(hi - lo)) * 0.5, 1e-6)
+    dist = zoom * radius
+    az = _np.deg2rad(azim_deg)
+    el = _np.deg2rad(elev_deg)
+    off = _np.array([_np.sin(az) * _np.cos(el), _np.sin(el),
+                     -_np.cos(az) * _np.cos(el)])
+    eye = center + dist * off
+    fwd = center - eye
+    fwd /= _np.linalg.norm(fwd)
+    up = _np.array([0.0, -1.0, 0.0])
+    right = _np.cross(fwd, up)
+    nr = _np.linalg.norm(right)
+    right = _np.array([1.0, 0.0, 0.0]) if nr < 1e-9 else right / nr
+    dn = _np.cross(fwd, right)
+    R = _np.stack([right, -dn, fwd])
+    f = fov_scale * min(width, height) * 0.5
+    return (R.astype(_np.float32), eye.astype(_np.float32),
+            _np.float32(f), _np.float32(f),
+            _np.float32((width - 1) * 0.5), _np.float32((height - 1) * 0.5))
+
+
+def stop_camera(pose, fx, fy, cx, cy):
+    """A session stop's camera as a render tuple: ``pose`` is the stop's
+    camera→model 4×4 (the decode frame has the camera at the origin), so
+    world→camera is its inverse rigid map."""
+    pose = _np.asarray(pose, _np.float64)
+    R = pose[:3, :3].T
+    eye = pose[:3, 3]
+    return (R.astype(_np.float32), eye.astype(_np.float32),
+            _np.float32(fx), _np.float32(fy), _np.float32(cx),
+            _np.float32(cy))
+
+
+# ---------------------------------------------------------------------------
+# Projection + binning + composite (one jitted program per (S, cfg, path))
+# ---------------------------------------------------------------------------
+
+
+def _project(means, normals, log_scales, colors_sh, opacity, valid,
+             R_wc, eye, fx, fy, cx, cy, cfg: RenderConfig):
+    """World splats → screen records: (u, v, z, conic(a,b,c), color,
+    alpha₀, visible). All (S,)-shaped; EWA projection of the anisotropic
+    covariance built on the splat's normal frame."""
+    n = normals / jnp.maximum(
+        jnp.linalg.norm(normals, axis=-1, keepdims=True), 1e-9)
+    helper = jnp.where(jnp.abs(n[:, 2:3]) < 0.9,
+                       jnp.asarray([0.0, 0.0, 1.0], jnp.float32),
+                       jnp.asarray([1.0, 0.0, 0.0], jnp.float32))
+    t1 = jnp.cross(n, helper)
+    t1 = t1 / jnp.maximum(jnp.linalg.norm(t1, axis=-1, keepdims=True),
+                          1e-9)
+    t2 = jnp.cross(n, t1)
+    basis = jnp.stack([t1, t2, n], axis=-1)            # (S, 3, 3) columns
+    s = jnp.exp(log_scales)                            # (S, 3)
+
+    x = (means - eye[None, :]) @ R_wc.T                # (S, 3) camera
+    z = x[:, 2]
+    in_front = z > 1e-6
+    zs = jnp.where(in_front, z, 1.0)
+    u = fx * x[:, 0] / zs + cx
+    v = fy * x[:, 1] / zs + cy
+
+    # EWA: Σ2d = J (R B) diag(s²) (R B)ᵀ Jᵀ, J the projective Jacobian.
+    A = (R_wc @ basis) * s[:, None, :]                 # (S, 3, 3)
+    j00 = fx / zs
+    j11 = fy / zs
+    j02 = -fx * x[:, 0] / (zs * zs)
+    j12 = -fy * x[:, 1] / (zs * zs)
+    # Rows of J @ A: (S, 3) each.
+    r0 = j00[:, None] * A[:, 0, :] + j02[:, None] * A[:, 2, :]
+    r1 = j11[:, None] * A[:, 1, :] + j12[:, None] * A[:, 2, :]
+    c00 = jnp.sum(r0 * r0, axis=-1) + 0.3              # 0.3 px low-pass
+    c11 = jnp.sum(r1 * r1, axis=-1) + 0.3
+    c01 = jnp.sum(r0 * r1, axis=-1)
+    det = c00 * c11 - c01 * c01
+    inv_det = 1.0 / jnp.maximum(det, 1e-12)
+    conic_a = c11 * inv_det
+    conic_b = -c01 * inv_det
+    conic_c = c00 * inv_det
+    mid = 0.5 * (c00 + c11)
+    lam = mid + jnp.sqrt(jnp.maximum(mid * mid - det, 0.1))
+    radius = jnp.ceil(3.0 * jnp.sqrt(lam))
+
+    # Degree-1 SH on the per-splat viewing direction (the 3DGS recipe).
+    d = means - eye[None, :]
+    d = d / jnp.maximum(jnp.linalg.norm(d, axis=-1, keepdims=True), 1e-9)
+    color = colors_sh[:, 0, :] + jnp.einsum(
+        "skc,sk->sc", colors_sh[:, 1:4, :], d)          # (S, 3)
+    alpha0 = jax.nn.sigmoid(opacity)
+
+    W, H = cfg.width, cfg.height
+    visible = (valid & in_front & (det > 1e-12)
+               & (u + radius > 0) & (u - radius < W)
+               & (v + radius > 0) & (v - radius < H))
+    return u, v, z, radius, conic_a, conic_b, conic_c, color, alpha0, \
+        visible
+
+
+def _bin_tiles(u, v, z, radius, visible, cfg: RenderConfig):
+    """(tiles, K) nearest-first splat indices + membership mask: a dense
+    tile×splat overlap test, then ``top_k`` on −depth — static shapes
+    throughout (the bounded-capacity rule)."""
+    T = cfg.tile
+    tx = jnp.arange(cfg.tiles_x, dtype=jnp.float32) * T
+    ty = jnp.arange(cfg.tiles_y, dtype=jnp.float32) * T
+    x0 = jnp.tile(tx, cfg.tiles_y)                     # (NT,)
+    y0 = jnp.repeat(ty, cfg.tiles_x)
+    member = (visible[None, :]
+              & (u[None, :] + radius[None, :] >= x0[:, None])
+              & (u[None, :] - radius[None, :] < x0[:, None] + T)
+              & (v[None, :] + radius[None, :] >= y0[:, None])
+              & (v[None, :] - radius[None, :] < y0[:, None] + T))
+    key = jnp.where(member, z[None, :], jnp.inf)
+    k = min(cfg.max_per_tile, key.shape[1])  # tiny scenes: K ≤ S
+    neg, idx = jax.lax.top_k(-key, k)                  # nearest K first
+    ok = jnp.isfinite(neg)
+    return idx, ok, x0, y0
+
+
+def _composite_xla(u, v, ca, cb, cc, cr, cg, cbl, opa, ok, x0, y0,
+                   cfg: RenderConfig):
+    """Front-to-back alpha composite of the per-tile records — the
+    differentiable oracle the Pallas kernel is pinned against.
+
+    All record arrays are (NT, K); returns (NT, T², 3) premultiplied
+    color and (NT, T²) alpha."""
+    T = cfg.tile
+    px = jnp.tile(jnp.arange(T, dtype=jnp.float32), T)       # (T²,)
+    py = jnp.repeat(jnp.arange(T, dtype=jnp.float32), T)
+    gx = x0[:, None] + px[None, :]                           # (NT, T²)
+    gy = y0[:, None] + py[None, :]
+    dx = gx[:, :, None] - u[:, None, :]                      # (NT, T², K)
+    dy = gy[:, :, None] - v[:, None, :]
+    power = -0.5 * (ca[:, None, :] * dx * dx
+                    + cc[:, None, :] * dy * dy) \
+        - cb[:, None, :] * dx * dy
+    g = jnp.exp(jnp.minimum(power, 0.0))
+    alpha = jnp.clip(opa[:, None, :] * g, 0.0, 0.995) \
+        * ok[:, None, :].astype(jnp.float32)
+    # Exclusive cumulative transmittance along the (sorted) K axis.
+    trans = jnp.cumprod(1.0 - alpha, axis=-1)
+    trans = jnp.concatenate(
+        [jnp.ones_like(trans[..., :1]), trans[..., :-1]], axis=-1)
+    w = trans * alpha                                        # (NT, T², K)
+    rgb = jnp.stack([jnp.sum(w * c[:, None, :], axis=-1)
+                     for c in (cr, cg, cbl)], axis=-1)
+    a_out = 1.0 - jnp.prod(1.0 - alpha, axis=-1)
+    return rgb, a_out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "use_pallas", "interpret"))
+def _render_fn(means, normals, log_scales, colors_sh, opacity, valid,
+               R_wc, eye, fx, fy, cx, cy, cfg: RenderConfig,
+               use_pallas: bool = False, interpret: bool = False):
+    """Full render at static (S, cfg): returns ((H, W, 3) float 0–1,
+    (H, W) alpha). One program per config — see module docstring."""
+    (u, v, z, radius, ca, cb, cc, color, alpha0,
+     visible) = _project(means, normals, log_scales, colors_sh, opacity,
+                         valid, R_wc, eye, fx, fy, cx, cy, cfg)
+    idx, ok, x0, y0 = _bin_tiles(u, v, z, radius, visible, cfg)
+
+    def take(a):
+        # Sanitize unselected slots to zeros at the gather: a masked-out
+        # splat may carry arbitrary (even non-finite) values, and
+        # ``0 · NaN`` downstream would poison the whole tile.
+        return jnp.where(ok, jnp.take(a, idx, axis=0), 0.0)   # (NT, K)
+
+    recs = (take(u), take(v), take(ca), take(cb), take(cc),
+            take(jnp.clip(color[:, 0], 0.0, 1.0)),
+            take(jnp.clip(color[:, 1], 0.0, 1.0)),
+            take(jnp.clip(color[:, 2], 0.0, 1.0)), take(alpha0), ok)
+    if use_pallas:
+        from . import splat_render_pallas
+
+        rgb, a_out = splat_render_pallas.composite_pallas(
+            *recs, x0, y0, cfg, interpret=interpret)
+    else:
+        rgb, a_out = _composite_xla(*recs, x0, y0, cfg)
+
+    # Tile sheet → image crop + background blend.
+    TY, TX, T = cfg.tiles_y, cfg.tiles_x, cfg.tile
+    sheet = rgb.reshape(TY, TX, T, T, 3).transpose(0, 2, 1, 3, 4)
+    img = sheet.reshape(TY * T, TX * T, 3)[:cfg.height, :cfg.width]
+    a_sheet = a_out.reshape(TY, TX, T, T).transpose(0, 2, 1, 3)
+    a_img = a_sheet.reshape(TY * T, TX * T)[:cfg.height, :cfg.width]
+    bg = jnp.asarray(cfg.bg, jnp.float32) / 255.0
+    img = img + (1.0 - a_img)[..., None] * bg[None, None, :]
+    return img, a_img
+
+
+def render(means, normals, log_scales, colors_sh, opacity, valid,
+           camera, cfg: RenderConfig = RenderConfig(),
+           use_pallas: bool | None = None):
+    """Render one view; ``camera`` is an ``(R_wc, eye, fx, fy, cx, cy)``
+    tuple (:func:`orbit_camera` / :func:`stop_camera`). Returns
+    ``((H, W, 3) float32 0–1, (H, W) float32 alpha)`` device arrays.
+
+    ``use_pallas=None`` auto-dispatches the fused tile-composite kernel
+    on TPU backends; the XLA form is the CPU path AND the gradient path
+    (`splat/fit.py` always fits through it)."""
+    if use_pallas is None:
+        use_pallas = _backend.tpu_backend()
+    R_wc, eye, fx, fy, cx, cy = camera
+    return _render_fn(
+        jnp.asarray(means, jnp.float32), jnp.asarray(normals, jnp.float32),
+        jnp.asarray(log_scales, jnp.float32),
+        jnp.asarray(colors_sh, jnp.float32),
+        jnp.asarray(opacity, jnp.float32), jnp.asarray(valid, bool),
+        jnp.asarray(R_wc, jnp.float32), jnp.asarray(eye, jnp.float32),
+        jnp.asarray(fx, jnp.float32), jnp.asarray(fy, jnp.float32),
+        jnp.asarray(cx, jnp.float32), jnp.asarray(cy, jnp.float32),
+        cfg, bool(use_pallas))
+
+
+def to_uint8(img) -> _np.ndarray:
+    """(H, W, 3) float 0–1 → host uint8 image (the PNG writer's input)."""
+    return _np.clip(_np.round(_np.asarray(img) * 255.0), 0,
+                    255).astype(_np.uint8)

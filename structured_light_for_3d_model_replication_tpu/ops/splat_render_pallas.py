@@ -1,0 +1,98 @@
+"""Pallas TPU kernel for the per-tile splat composite.
+
+The XLA composite (`splat_render._composite_xla`) materializes the
+(NT, T², K) Gaussian-weight tensor and its cumulative-transmittance
+sibling in HBM — ~57 MB per intermediate for a 384×288 frame at the
+default tile=8/K=128 (1728 tiles × 64 px × 128 records, float32), and
+several such intermediates live through the composite. This kernel
+runs the classic front-to-back loop instead: one grid step per image
+tile, the tile's K gathered splat records resident in VMEM, a
+``fori_loop`` over the (already depth-sorted) splats accumulating a
+``(1, T²)`` transmittance row and three color rows — every
+intermediate stays on chip and each record is read exactly once.
+
+Record layout mirrors `ops/tsdf_pallas.py`'s flat-plane rule: every
+operand is a (NT, K) float32 plane (colors as three planes, the
+membership mask pre-cast to float), so all inputs share one tile
+shape; the pixel axis (T² — 64 lanes at the default 8-px tile, padded
+to the 128-lane minimum by Mosaic; 16-px tiles fill the lanes but see
+`RenderConfig`'s depth-capacity caveat) is the minor dimension of
+every in-kernel tensor. The tile's pixel origin rides a (NT, 1)
+operand rather than a program_id reconstruction, keeping the kernel
+shape-agnostic in the tile grid.
+
+Numerical contract pinned against the XLA form (interpret mode on CPU,
+compiled on TPU) in tests/test_splat.py. Gradients are NOT defined for
+this path — the fit loop always differentiates the XLA form
+(`splat/fit.py`); this kernel only serves reads (novel-view renders).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import _backend
+
+
+def available() -> bool:
+    return _backend.tpu_backend()
+
+
+def _kernel(u_ref, v_ref, ca_ref, cb_ref, cc_ref, cr_ref, cg_ref,
+            cb2_ref, opa_ref, ok_ref, x0_ref, y0_ref,
+            r_out, g_out, b_out, a_out, *, tile: int, k: int):
+    t2 = tile * tile
+    px = jax.lax.broadcasted_iota(jnp.float32, (1, t2), 1)
+    gx = x0_ref[0, 0] + px % float(tile)
+    gy = y0_ref[0, 0] + px // float(tile)
+
+    def body(i, carry):
+        trans, r, g, b = carry
+        dx = gx - u_ref[0, i]
+        dy = gy - v_ref[0, i]
+        power = (-0.5 * (ca_ref[0, i] * dx * dx + cc_ref[0, i] * dy * dy)
+                 - cb_ref[0, i] * dx * dy)
+        gauss = jnp.exp(jnp.minimum(power, 0.0))
+        alpha = jnp.clip(opa_ref[0, i] * gauss, 0.0, 0.995) * ok_ref[0, i]
+        w = trans * alpha
+        return (trans * (1.0 - alpha), r + w * cr_ref[0, i],
+                g + w * cg_ref[0, i], b + w * cb2_ref[0, i])
+
+    ones = jnp.ones((1, t2), jnp.float32)
+    zero = jnp.zeros((1, t2), jnp.float32)
+    trans, r, g, b = jax.lax.fori_loop(0, k, body,
+                                       (ones, zero, zero, zero))
+    r_out[...] = r
+    g_out[...] = g
+    b_out[...] = b
+    a_out[...] = 1.0 - trans
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
+def composite_pallas(u, v, ca, cb, cc, cr, cg, cbl, opa, ok, x0, y0,
+                     cfg, interpret: bool = False):
+    """Same contract as ``splat_render._composite_xla``: (NT, K) record
+    planes + (NT,) tile origins → ((NT, T², 3) premultiplied color,
+    (NT, T²) alpha). ``px % tile`` in-kernel recovers pixel coords, so
+    the grid is one step per tile with no host-side pixel tables."""
+    nt, k = u.shape
+    t2 = cfg.tile * cfg.tile
+    okf = ok.astype(jnp.float32)
+    x0c = x0.reshape(nt, 1)
+    y0c = y0.reshape(nt, 1)
+    rec = pl.BlockSpec((1, k), lambda c: (c, 0))
+    org = pl.BlockSpec((1, 1), lambda c: (c, 0))
+    out = pl.BlockSpec((1, t2), lambda c: (c, 0))
+    r, g, b, a = pl.pallas_call(
+        functools.partial(_kernel, tile=cfg.tile, k=k),
+        grid=(nt,),
+        in_specs=[rec] * 10 + [org, org],
+        out_specs=[out] * 4,
+        out_shape=[jax.ShapeDtypeStruct((nt, t2), jnp.float32)] * 4,
+        interpret=interpret,
+    )(u, v, ca, cb, cc, cr, cg, cbl, opa, okf, x0c, y0c)
+    return jnp.stack([r, g, b], axis=-1), a

@@ -70,6 +70,19 @@ class TSDFParams(NamedTuple):
     splat_radius: int = 1        # update window = (2r+1)³ voxels per point
     trunc_voxels: float = 3.0    # truncation distance in voxels
     max_weight: float = 64.0     # running-average weight clamp
+    # Free-space carving (off by default — docs/MESHING.md): each valid
+    # point also marches ``carve_steps`` one-voxel samples from the
+    # truncation-band edge TOWARD the camera (observed-empty space;
+    # the reach in voxels beyond the band) and DECAYS those voxels'
+    # weight multiplicatively (×exp(−carve_weight) per sample — scale-
+    # free in the accumulated weight), so a moving sensor erases stale
+    # surface instead of ghosting it. Samples only touch ALREADY-
+    # allocated bricks (carving never allocates), and a voxel decayed
+    # under 1e-3 weight resets to unobserved. With the default 0 the
+    # integrate program is the historical one, bit for bit (the carve
+    # branch is trace-time gated).
+    carve_steps: int = 0
+    carve_weight: float = 0.25
 
     @property
     def resolution(self) -> int:
@@ -149,6 +162,8 @@ def _integrate_fn(params: TSDFParams, use_pallas: bool):
     offs = jnp.asarray(_window_offsets(radius), jnp.int32)
     trunc = jnp.float32(params.trunc_voxels)
     wmax = jnp.float32(params.max_weight)
+    carve_steps = int(params.carve_steps)
+    cw = jnp.float32(params.carve_weight)
 
     def run(dir_map, tsdf, weight, rgb, coords, n_bricks,
             points, colors, valid, dirs, origin, voxel):
@@ -199,6 +214,40 @@ def _integrate_fn(params: TSDFParams, use_pallas: bool):
 
         tsdf, weight, rgb = _combine(tsdf, weight, rgb, num, den, rgbnum,
                                      wmax, use_pallas)
+
+        if carve_steps:
+            # Free-space carving: voxel samples marching from one voxel
+            # past the truncation band toward the camera are observed
+            # EMPTY — decrement their weight so stale surface a moving
+            # sensor no longer sees fades out. Grid coords are voxel
+            # units, so stepping t voxels along the (unit, world) inward
+            # direction is ``g − d̂·t``. Only already-allocated bricks
+            # are touched (absent slots drop), and a fully-carved voxel
+            # resets to the unobserved sentinel.
+            qs = trunc + jnp.arange(1, carve_steps + 1,
+                                    dtype=jnp.float32)
+            samp = g[:, None, :] - dirs[:, None, :] * qs[None, :, None]
+            cvox = jnp.floor(samp).astype(jnp.int32)
+            cinb = jnp.all((cvox >= 0) & (cvox < r_vox), axis=-1)
+            cok = valid[:, None] & cinb
+            cbc = cvox >> 3
+            ccell = (cbc[..., 0] * nb + cbc[..., 1]) * nb + cbc[..., 2]
+            cslot = dir_map[jnp.where(cok, ccell, 0)]
+            cintra = ((cvox[..., 0] & 7) * BS + (cvox[..., 1] & 7)) * BS \
+                + (cvox[..., 2] & 7)
+            cflat = jnp.where(cok & (cslot >= 0), cslot * V + cintra,
+                              cap * V).reshape(-1)
+            hits = jnp.zeros((cap * V,), jnp.float32).at[cflat].add(
+                jnp.ones(cflat.shape, jnp.float32),
+                mode="drop").reshape(cap, V)
+            # Multiplicative decay — scale-free in the accumulated
+            # weight, so stale surface fades at the same rate however
+            # confidently it was once observed.
+            new_w = weight * jnp.exp(-cw * hits)
+            erased = (hits > 0.0) & (new_w < 1e-3)
+            tsdf = jnp.where(erased, -1.0, tsdf)
+            weight = jnp.where(erased, 0.0, new_w)
+
         return (dir_map, tsdf, weight, rgb, coords,
                 jnp.minimum(n_wanted, cap), n_wanted)
 
@@ -325,6 +374,22 @@ def integrate_oracle(dense, points, colors, valid, dirs, origin,
                     (rgb * weight[..., None] + rgbnum) / safe[..., None],
                     rgb)
     weight = _np.minimum(wsum, _np.float32(params.max_weight))
+
+    if params.carve_steps:
+        hits = _np.zeros_like(weight)
+        cw = _np.float32(params.carve_weight)
+        for q in range(1, int(params.carve_steps) + 1):
+            samp = g - dr * _np.float32(trunc + q)
+            cvox = _np.floor(samp).astype(_np.int64)
+            cok = val & _np.all((cvox >= 0) & (cvox < r_vox), axis=-1)
+            ix, iy, iz = (cvox[cok, i] for i in range(3))
+            _np.add.at(hits, (ix, iy, iz), _np.float32(1.0))
+        new_w = weight * _np.exp(-cw * hits, dtype=_np.float32)
+        erased = (hits > 0.0) & (new_w < 1e-3)
+        tsdf = _np.where(erased, _np.float32(-1.0), tsdf)
+        weight = _np.where(erased, _np.float32(0.0),
+                           new_w).astype(_np.float32)
+
     return tsdf.astype(_np.float32), weight.astype(_np.float32), \
         rgb.astype(_np.float32)
 

@@ -345,6 +345,39 @@ class ServeClient:
                 for k, v in hdrs.items() if k.startswith("X-")}
         return body, meta
 
+    def render(self, session_id: str, azim: float = 30.0,
+               elev: float = 20.0,
+               size: tuple | None = None) -> tuple[bytes, dict] | None:
+        """GET /session/<id>/render → (PNG bytes, meta) novel view of
+        the session's splat scene (``representation="splat"``), or None
+        before the first fused stop (HTTP 409). ``size`` must be one of
+        the server's configured (W, H) render sizes."""
+        q = f"?az={float(azim)}&el={float(elev)}"
+        if size is not None:
+            q += f"&w={int(size[0])}&h={int(size[1])}"
+        status, hdrs, body = self._request(urllib.request.Request(
+            f"{self.base_url}/session/{session_id}/render{q}"))
+        if status == 409:
+            return None
+        if status != 200:
+            raise ServeClientError(
+                f"render failed ({status})", self._payload(body))
+        meta = {k[2:].lower().replace("-", "_"): v
+                for k, v in hdrs.items() if k.startswith("X-")}
+        return body, meta
+
+    def splats(self, session_id: str) -> bytes | None:
+        """GET /session/<id>/splats → the scene .npz (``cli render``
+        re-renders it offline), or None before the first stop."""
+        status, _, body = self._request(urllib.request.Request(
+            f"{self.base_url}/session/{session_id}/splats"))
+        if status == 409:
+            return None
+        if status != 200:
+            raise ServeClientError(
+                f"splats failed ({status})", self._payload(body))
+        return body
+
     def finalize_session(self, session_id: str,
                          result_format: str = "stl") -> dict:
         """POST finalize; returns {"job_id", "status", "result"} — fetch

@@ -71,7 +71,12 @@ _PRIORITY_NAMES = {"high": 0, "normal": 1, "low": 2}
 _CONTENT_TYPES = {"ply": "application/x-ply",
                   "stl": "model/stl",
                   "mesh_ply": "application/x-ply",  # vertex-colored mesh
+                  "render_png": "image/png",  # splat novel-view render
                   "json": "application/json"}  # session-stop payloads
+#: What a ONE-SHOT submit may ask for — the worker's postprocess menu.
+#: ``json`` is the session-stop payload shape and ``render_png`` needs a
+#: session's fitted splat scene; neither is a worker artifact.
+_SUBMIT_FORMATS = ("ply", "stl", "mesh_ply")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -444,7 +449,8 @@ class ReconstructionService:
                                 report = warm_session_programs(
                                     self.config.stream, h * w,
                                     col_bits=self.config.proj.col_bits,
-                                    row_bits=self.config.proj.row_bits)
+                                    row_bits=self.config.proj.row_bits,
+                                    frame_shape=(h, w))
                             self._warmup_report[label] = report
             if recover_from:
                 self._recover()
@@ -720,7 +726,8 @@ class ReconstructionService:
         colors = np.asarray(out.colors)[0]
         valid = np.asarray(out.valid)[0]
         vgrid = valid.reshape(key.height, key.width)[:h, :w]
-        entry.ingest(points, colors, valid, coverage=float(vgrid.mean()))
+        entry.ingest(points, colors, valid, coverage=float(vgrid.mean()),
+                     frame_shape=(key.height, key.width))
 
     # -- submission --------------------------------------------------------
 
@@ -747,10 +754,10 @@ class ReconstructionService:
         cfg = self.config
         try:
             stack = self._validate_stack(stack)
-            if result_format not in _CONTENT_TYPES:
+            if result_format not in _SUBMIT_FORMATS:
                 raise StackFormatError(
                     f"result_format must be one of "
-                    f"{sorted(_CONTENT_TYPES)}, got {result_format!r}")
+                    f"{sorted(_SUBMIT_FORMATS)}, got {result_format!r}")
             if isinstance(priority, str):
                 if priority not in _PRIORITY_NAMES:
                     raise StackFormatError(
@@ -970,17 +977,85 @@ class ReconstructionService:
         meta, or None before the first preview."""
         return self.sessions.get(session_id).preview_bytes()
 
+    def _session_splat_mesher(self, entry):
+        """The session's splat previewer, or a 400 when the session
+        was not created with ``representation="splat"`` — the render
+        surface exists only on that lane (docs/RENDERING.md)."""
+        mesher = getattr(entry.session, "_mesher", None)
+        if not hasattr(mesher, "render_png"):
+            raise StackFormatError(
+                "session has no render lane — create it with "
+                '{"representation": "splat"} to get novel-view renders')
+        return mesher
+
+    def render_session(self, session_id: str, azim: float, elev: float,
+                       width: int | None = None,
+                       height: int | None = None):
+        """``GET /session/<id>/render?az=..&el=..[&w=..&h=..]``: render
+        the session's CURRENT splat scene from a novel orbit view —
+        PNG bytes + meta, or None before the first fused stop (the
+        endpoint's 409). Angles are traced operands of one compiled
+        program per resolution; ``w``/``h`` must name a configured
+        render size (each size is its own program — an open set would
+        mint compiles on demand, which the zero-steady-state-recompile
+        bar forbids), else 400. Runs under the session lock on the
+        session's sticky lane device (the scene/fit/render programs
+        were warmed per lane at start). A render that follows new
+        stops REBUILDS the scene here (seed + ``splat_fit_iters`` fit
+        steps) while holding the lock — concurrent stop ingest waits
+        for it, so live-polling clients should render at a coarser
+        cadence than they submit (docs/RENDERING.md)."""
+        entry = self.sessions.get(session_id)
+        mesher = self._session_splat_mesher(entry)
+        if (width is None) != (height is None):
+            raise StackFormatError("pass both w and h, or neither")
+        if width is not None \
+                and not mesher.render_size_ok(width, height):
+            raise StackFormatError(
+                f"render size {width}x{height} is not served; "
+                f"configured sizes: "
+                f"{['%dx%d' % s for s in mesher.render_sizes]}")
+        if not (-360.0 <= float(azim) <= 360.0) \
+                or not (-90.0 <= float(elev) <= 90.0):
+            raise StackFormatError(
+                f"render angles out of range (az {azim}, el {elev}): "
+                "az in [-360, 360], el in [-90, 90]")
+        with entry.lock:
+            with entry.device_ctx():
+                out = mesher.render_png(float(azim), float(elev),
+                                        width, height)
+            entry.last_t = time.monotonic()
+        if out is not None:
+            events.record("session_rendered", session_id=session_id,
+                          **{k: out[1][k] for k in ("azim", "elev",
+                                                    "render_s")})
+        return out
+
+    def session_splats(self, session_id: str) -> bytes | None:
+        """``GET /session/<id>/splats``: the current splat scene as an
+        .npz archive — ``cli render`` reproduces the endpoint's pixels
+        from it offline (the serve↔CLI parity contract), or None
+        before the first fused stop."""
+        entry = self.sessions.get(session_id)
+        mesher = self._session_splat_mesher(entry)
+        with entry.lock:
+            with entry.device_ctx():
+                return mesher.scene_bytes()
+
     def finalize_session(self, session_id: str,
                          result_format: str = "stl") -> Job:
         """``POST /session/<id>/finalize``: close the ring, build the
         final artifact, and land it as a terminal job in the ordinary
         registry — the existing ``GET /result`` path serves it. Runs on
         the calling thread (one full pose solve + merge + mesh)."""
-        if result_format not in ("ply", "stl", "mesh_ply"):
+        if result_format not in ("ply", "stl", "mesh_ply", "render_png"):
             raise StackFormatError(
-                f"result_format must be 'ply', 'stl' or 'mesh_ply', "
-                f"got {result_format!r}")
+                f"result_format must be 'ply', 'stl', 'mesh_ply' or "
+                f"'render_png', got {result_format!r}")
         entry = self.sessions.get(session_id)
+        if result_format == "render_png":
+            # Lane check BEFORE finalize — a 400 must not close the ring.
+            self._session_splat_mesher(entry)
         cfg = self.config
         # Settle in-flight stops FIRST (without the session lock — their
         # sinks need it): a stop the client already got a 200 for must
@@ -1022,6 +1097,22 @@ class ReconstructionService:
                 meta = {"vertices": int(len(result.mesh.vertices)),
                         "faces": int(len(result.mesh.faces)),
                         "colored": result.mesh.vertex_colors is not None}
+            elif result_format == "render_png":
+                # The splat lane's rendered artifact: the fitted scene's
+                # default orbit view (docs/RENDERING.md; live-angle
+                # renders ride GET /session/<id>/render). Under the
+                # sticky lane device like every other session device
+                # path — the lazy scene rebuild must land where the
+                # per-lane warmup compiled.
+                with entry.device_ctx():
+                    out = self._session_splat_mesher(entry).render_png(
+                        30.0, 20.0)
+                if out is None:
+                    raise RuntimeError(
+                        "no splat scene to render (no stops fused)")
+                payload, rmeta = out
+                meta = {k: rmeta[k] for k in ("azim", "elev", "width",
+                                              "height", "splats")}
             else:
                 from .worker import _ply_bytes
 
@@ -1589,17 +1680,30 @@ class _ServeHandler(BaseHTTPRequestHandler):
         elif url.path == "/result":
             self._result((parse_qs(url.query).get("id") or [""])[0])
         elif url.path.startswith("/session/"):
-            self._get_session([p for p in url.path.split("/") if p])
+            self._get_session([p for p in url.path.split("/") if p],
+                              parse_qs(url.query))
         else:
             self._json({"error": "not found"}, 404)
 
-    def _get_session(self, parts: list[str]) -> None:
+    def _get_session(self, parts: list[str], query=None) -> None:
         """GET /session/<id> (status) | /session/<id>/preview (latest
-        progressive STL)."""
+        progressive STL) | /session/<id>/render?az=..&el=.. (splat
+        novel view PNG) | /session/<id>/splats (scene .npz)."""
+        query = query or {}
         try:
             if len(parts) == 2:
                 self._json(self.service.sessions.get(
                     parts[1]).status_dict())
+            elif len(parts) == 3 and parts[2] == "render":
+                self._session_render(parts[1], query)
+            elif len(parts) == 3 and parts[2] == "splats":
+                data = self.service.session_splats(parts[1])
+                if data is None:
+                    self._json({"session_id": parts[1],
+                                "error": "no splat scene yet (submit a "
+                                         "stop first)"}, 409)
+                    return
+                self._bytes(data, "application/octet-stream")
             elif len(parts) == 3 and parts[2] == "preview":
                 out = self.service.session_preview(parts[1])
                 if out is None:
@@ -1623,6 +1727,65 @@ class _ServeHandler(BaseHTTPRequestHandler):
         except UnknownSessionError as e:
             self._json({"error": {"type": type(e).__name__,
                                   "message": str(e)}}, 404)
+        except JobRejected as e:
+            # Render-surface refusals (no splat lane, off-menu size,
+            # out-of-range angles) — client errors, not conflicts.
+            self._reject(e)
+
+    def _session_render(self, session_id: str, query: dict) -> None:
+        """GET /session/<id>/render: az/el floats (defaults 30/20), an
+        optional configured w×h. 400 on malformed/out-of-range values,
+        409 before the first fused stop."""
+        def num(name, default):
+            raw = (query.get(name) or [None])[0]
+            if raw is None:
+                return default
+            try:
+                val = float(raw)
+            except ValueError:
+                raise StackFormatError(
+                    f"query param {name!r} must be a number, "
+                    f"got {raw!r}")
+            if not np.isfinite(val):
+                # 'nan'/'inf' PARSE as floats but int() on them raises
+                # past the 400 mapping — reject them as the client
+                # errors they are.
+                raise StackFormatError(
+                    f"query param {name!r} must be finite, got {raw!r}")
+            return val
+
+        def whole(name):
+            val = num(name, None)
+            if val is not None and val != int(val):
+                # Truncating 384.9 → 384 would 200 at a size the
+                # client did not ask for — the endpoint's strict-400
+                # posture applies to fractional sizes too.
+                raise StackFormatError(
+                    f"query param {name!r} must be an integer, "
+                    f"got {val!r}")
+            return val
+
+        azim = num("az", 30.0)
+        elev = num("el", 20.0)
+        w = whole("w")
+        h = whole("h")
+        out = self.service.render_session(
+            session_id, azim, elev,
+            None if w is None else int(w),
+            None if h is None else int(h))
+        if out is None:
+            self._json({"session_id": session_id,
+                        "error": "no splat scene yet (submit a stop "
+                                 "first)"}, 409)
+            return
+        data, meta = out
+        self.send_response(200)
+        self.send_header("Content-Type", _CONTENT_TYPES["render_png"])
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("X-Render-Splats", str(meta.get("splats")))
+        self.send_header("X-Render-Seconds", str(meta.get("render_s")))
+        self.end_headers()
+        self.wfile.write(data)
 
     def do_DELETE(self):
         parts = [p for p in urlparse(self.path).path.split("/") if p]

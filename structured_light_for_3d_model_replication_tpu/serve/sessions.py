@@ -47,9 +47,12 @@ log = get_logger(__name__)
 #: merge/registration surface stays server-side (it keys compiled
 #: programs; per-session drift would mint fresh compiles — exactly what
 #: the warmed steady state forbids). ``representation`` picks the
-#: preview/final scene representation ("poisson" | "tsdf" — the fusion/
-#: dispatch, docs/STREAMING.md; a non-default choice compiles its
-#: programs on first use unless the replica warmed that lane too).
+#: preview/final scene representation ("poisson" | "tsdf" | "splat" —
+#: the fusion/splat dispatch, docs/STREAMING.md + docs/RENDERING.md;
+#: a non-default choice compiles its programs on first use unless the
+#: replica warmed that lane too; "splat" adds the GET
+#: /session/<id>/render + /splats surface and result_format
+#: "render_png").
 SESSION_OPTION_KEYS = ("preview_every", "preview_depth", "final_depth",
                        "expected_stops", "method", "covis",
                        "representation")
@@ -116,16 +119,20 @@ class ServeSession:
 
         return jax.default_device(self.lane.device)
 
-    def ingest(self, points, colors, valid, coverage=None) -> dict:
+    def ingest(self, points, colors, valid, coverage=None,
+               frame_shape=None) -> dict:
         """The job's ``decode_sink``: fuse one decoded stop. Runs on the
         worker thread; the lock serializes against preview/finalize —
-        under the session's sticky lane device when one is assigned."""
+        under the session's sticky lane device when one is assigned.
+        ``frame_shape`` is the decoded bucket's (H, W) — the splat
+        appearance lane's RGB supervision needs the pixel layout."""
         shed = bool(self.preview_shed()) if self.preview_shed else False
         with self.lock:
             self.session.suppress_previews = shed
             with self.device_ctx():
                 res = self.session.add_decoded(points, colors, valid,
-                                               coverage=coverage)
+                                               coverage=coverage,
+                                               frame_shape=frame_shape)
             self.last_t = time.monotonic()
             return {"session_id": self.session_id, **res.to_dict()}
 
@@ -238,10 +245,11 @@ class SessionManager:
                 f"method must be 'sequential' or 'posegraph', got "
                 f"{overrides['method']!r}")
         if "representation" in overrides \
-                and overrides["representation"] not in ("poisson", "tsdf"):
+                and overrides["representation"] not in ("poisson", "tsdf",
+                                                        "splat"):
             raise StackFormatError(
-                f"representation must be 'poisson' or 'tsdf', got "
-                f"{overrides['representation']!r}")
+                f"representation must be 'poisson', 'tsdf' or 'splat', "
+                f"got {overrides['representation']!r}")
         for k in ("preview_every", "preview_depth", "final_depth",
                   "expected_stops"):
             if k in overrides:

@@ -281,7 +281,8 @@ class DeviceWorker:
             _, h, w = job.stack.shape
             vgrid = valid.reshape(key.height, key.width)[:h, :w]
             meta = job.decode_sink(points, colors, valid,
-                                   coverage=float(vgrid.mean()))
+                                   coverage=float(vgrid.mean()),
+                                   frame_shape=(key.height, key.width))
             return _json.dumps(meta).encode(), meta
         _, h, w = job.stack.shape
         vgrid = valid.reshape(key.height, key.width)[:h, :w]

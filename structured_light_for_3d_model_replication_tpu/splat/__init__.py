@@ -1,0 +1,45 @@
+"""splat/ — the Gaussian appearance tier on the fused TSDF shell.
+
+The third result type next to clouds and meshes (ROADMAP: "rendered,
+not just extracted, previews"): anisotropic Gaussians SEEDED on the
+TSDF iso-shell `fusion/` maintains (one compaction pass over active
+bricks — Splatonic's sparse-processing argument applied to appearance
+state), FITTED against the per-stop RGB the capture already produced
+(view-dependent color as low-order SH + opacity + scale, a jitted
+donated fixed-shape SGD loop — no new capture), and RENDERED from any
+novel view by the tile-binned sorted-alpha-composite rasterizer
+(`ops/splat_render.py`, Pallas tile kernel on TPU backends).
+
+Per Gaussian-Plus-SDF SLAM (PAPERS.md) the splats stay ANCHORED on the
+SDF: positions and normals come from the volume and are never optimized
+— geometry lives in one place (the TSDF), appearance in another (the
+splats), so the fit is small, convex-ish and deterministic, and a
+re-seed after further integration never fights a drifted splat cloud.
+
+Entry points: :func:`splat_scene_from_cloud` (the `mesh_from_cloud`-
+style one-shot), :func:`seed_from_volume` (streaming / fusion path),
+:class:`SplatScene` (render / save / load), `splat/preview.py`'s
+:class:`SplatPreviewMesher` (the streaming previewer lane), serve's
+``GET /session/<id>/render`` + ``result_format="render_png"``, and
+``cli render``. docs/RENDERING.md covers the architecture.
+"""
+
+from .fit import fit_appearance, fit_pinhole, psnr
+from .model import (
+    SplatParams,
+    SplatScene,
+    seed_from_volume,
+    splat_scene_from_cloud,
+)
+from .preview import SplatPreviewMesher
+
+__all__ = [
+    "SplatParams",
+    "SplatPreviewMesher",
+    "SplatScene",
+    "fit_appearance",
+    "fit_pinhole",
+    "psnr",
+    "seed_from_volume",
+    "splat_scene_from_cloud",
+]

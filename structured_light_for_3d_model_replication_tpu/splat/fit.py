@@ -1,0 +1,205 @@
+"""View-dependent appearance fitting against the captured per-stop RGB.
+
+No new capture: every structured-light stop already shipped a dense RGB
+frame (the white-reference texture decode carries per pixel) and a pose
+(the session's ring solve). This module re-uses them as a supervision
+set: render the splat scene from a stop's camera, compare to that
+stop's (valid-masked, downsampled) colors, descend. Per the
+Gaussian-Plus-SDF split, GEOMETRY is frozen — means/normals stay
+anchored on the TSDF shell — and only appearance moves: per-splat SH
+color (degree 1: DC + 3 linear bands per channel), opacity logit and
+log-scales.
+
+Static-shape discipline: the whole optimization is ONE jitted Adam step
+donated in/out (params and optimizer state alias across iterations —
+the `stream/session._fuse_fn` pattern applied to an optimizer), with
+the frame index a TRACED scalar into the stacked (F, h, w, …) frame
+buffer — F, the fit resolution and the splat capacity key the program,
+the iteration count never does. Gradients flow through the XLA
+composite (`ops/splat_render._composite_xla`); the Pallas kernel is a
+read-only fast path and is never differentiated.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import splat_render as sr
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+_BETA1, _BETA2, _EPS = 0.9, 0.999, 1e-8
+
+
+def psnr(img, ref, mask=None) -> float:
+    """PSNR in dB between images in 0–1 scale; ``mask`` restricts the
+    mean to covered pixels (a captured stop's decode-valid region)."""
+    a = np.asarray(img, np.float64)
+    b = np.asarray(ref, np.float64)
+    if mask is not None:
+        m = np.asarray(mask, bool)
+        if not m.any():
+            return 0.0
+        a = a[m]
+        b = b[m]
+    mse = float(np.mean((a - b) ** 2))
+    return float(10.0 * np.log10(1.0 / max(mse, 1e-12)))
+
+
+def fit_pinhole(points, valid, height: int, width: int):
+    """Recover ``(fx, fy, cx, cy)`` from ONE decoded stop's camera-frame
+    points — two tiny least squares (u = fx·x/z + cx over the pixel
+    grid), so sessions need no calibration plumbing to fit appearance.
+    Returns None when the stop has too few usable pixels."""
+    pts = np.asarray(points, np.float64).reshape(height, width, 3)
+    val = np.asarray(valid, bool).reshape(height, width)
+    z = pts[..., 2]
+    ok = val & (z > 1e-6)
+    if int(ok.sum()) < 64:
+        return None
+    jj, ii = np.meshgrid(np.arange(width, dtype=np.float64),
+                         np.arange(height, dtype=np.float64))
+    xz = (pts[..., 0] / np.where(ok, z, 1.0))[ok]
+    yz = (pts[..., 1] / np.where(ok, z, 1.0))[ok]
+    one = np.ones_like(xz)
+    (fx, cx), *_ = np.linalg.lstsq(np.stack([xz, one], 1), jj[ok],
+                                   rcond=None)
+    (fy, cy), *_ = np.linalg.lstsq(np.stack([yz, one], 1), ii[ok],
+                                   rcond=None)
+    if not (np.isfinite([fx, fy, cx, cy]).all() and fx > 0 and fy > 0):
+        return None
+    return float(fx), float(fy), float(cx), float(cy)
+
+
+def frame_target(colors, valid, height: int, width: int, stride: int):
+    """One dense stop frame → the fit-resolution target: strided
+    subsample of the (H, W) pixel grid. ``colors`` is a DECODE frame —
+    0–255 scale (uint8 or float, `models/pipeline` colors), always
+    divided by 255 (a value-range heuristic here would misread a dark
+    float frame as already normalized). Returns ``(target (h, w, 3)
+    f32 0–1, mask (h, w) bool)`` host arrays.
+
+    The mask is the decode-valid region ERODED by one fit-resolution
+    pixel: silhouette pixels mix foreground and background at the
+    capture AND sit at the shell's observation fringe, so both the fit
+    loss and the PSNR gate measure interior appearance (the render
+    still has to cover the interior wall-to-wall — background showing
+    through any interior pixel is fully penalized)."""
+    img = np.asarray(colors).reshape(height, width, 3)
+    msk = np.asarray(valid, bool).reshape(height, width)
+    t = img[::stride, ::stride].astype(np.float32) / 255.0
+    m = msk[::stride, ::stride]
+    er = m.copy()
+    er[1:] &= m[:-1]
+    er[:-1] &= m[1:]
+    er[:, 1:] &= m[:, :-1]
+    er[:, :-1] &= m[:, 1:]
+    return np.clip(t, 0.0, 1.0), er
+
+
+@functools.lru_cache(maxsize=None)
+def _fit_step_fn(cfg: sr.RenderConfig, lr_color: float, lr_opacity: float,
+                 lr_scale: float, band_decay: float):
+    """One Adam step over (colors_sh, opacity, log_scales); params and
+    moments donated in/out. Program keyed by (render cfg, lrs, splat
+    capacity & frame-buffer shapes) — the frame INDEX is traced.
+
+    ``band_decay`` multiplicatively shrinks the linear SH bands each
+    step: with a handful of supervision views the bands can absorb
+    per-view residual (coverage gaps, pose jitter) as fake view
+    dependence that extrapolates badly to held-out views — the decay
+    keeps only view dependence the data keeps re-earning."""
+
+    def loss_fn(fit_params, frozen, frame, mask, cam):
+        colors_sh, opacity, log_scales = fit_params
+        means, normals, valid = frozen
+        img, _ = sr._render_fn(means, normals, log_scales, colors_sh,
+                               opacity, valid, *cam, cfg,
+                               use_pallas=False)
+        m = mask.astype(jnp.float32)[..., None]
+        return jnp.sum(m * (img - frame) ** 2) \
+            / jnp.maximum(jnp.sum(m) * 3.0, 1.0)
+
+    lrs = (lr_color, lr_opacity, lr_scale)
+
+    def step(fit_params, m1, m2, t, frozen, frames, masks, cams, i):
+        frame = frames[i]
+        mask = masks[i]
+        cam = tuple(c[i] for c in cams)
+        loss, grads = jax.value_and_grad(loss_fn)(fit_params, frozen,
+                                                  frame, mask, cam)
+        t = t + 1.0
+        bc1 = 1.0 - _BETA1 ** t
+        bc2 = 1.0 - _BETA2 ** t
+        new_p, new_m1, new_m2 = [], [], []
+        for p, g, a, b, lr in zip(fit_params, grads, m1, m2, lrs):
+            a = _BETA1 * a + (1.0 - _BETA1) * g
+            b = _BETA2 * b + (1.0 - _BETA2) * g * g
+            upd = lr * (a / bc1) / (jnp.sqrt(b / bc2) + _EPS)
+            new_p.append(p - upd)
+            new_m1.append(a)
+            new_m2.append(b)
+        sh = new_p[0]
+        new_p[0] = sh.at[:, 1:, :].multiply(jnp.float32(band_decay))
+        return tuple(new_p), tuple(new_m1), tuple(new_m2), t, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+
+def fit_appearance(scene, frames, masks, cameras,
+                   fit_cfg: sr.RenderConfig | None = None,
+                   iters: int = 60, lr_color: float = 0.08,
+                   lr_opacity: float = 0.05, lr_scale: float = 0.01,
+                   band_decay: float = 0.997):
+    """Fit the scene's appearance against captured views, in place.
+
+    ``frames`` (F, h, w, 3) float 0–1, ``masks`` (F, h, w) bool,
+    ``cameras`` a list of F render camera tuples (``stop_camera`` at
+    fit-resolution intrinsics). ``fit_cfg`` defaults to the frame shape.
+    Frames are visited round-robin (traced index — one compiled step).
+    Returns the scene with ``fit_stats`` filled (loss trajectory ends,
+    seconds, iterations)."""
+    frames = jnp.asarray(frames, jnp.float32)
+    masks = jnp.asarray(masks, bool)
+    F, h, w = frames.shape[:3]
+    if fit_cfg is None:
+        fit_cfg = sr.RenderConfig(width=w, height=h)
+    cams = tuple(
+        jnp.stack([jnp.asarray(c[k], jnp.float32) for c in cameras])
+        for k in range(6))
+    step = _fit_step_fn(fit_cfg, float(lr_color), float(lr_opacity),
+                        float(lr_scale), float(band_decay))
+    fit_params = (scene.colors_sh, scene.opacity, scene.log_scales)
+    m1 = tuple(jnp.zeros_like(p) for p in fit_params)
+    m2 = tuple(jnp.zeros_like(p) for p in fit_params)
+    t = jnp.zeros((), jnp.float32)
+    frozen = (scene.means, scene.normals, scene.valid)
+    t0 = time.monotonic()
+    loss0 = loss = None
+    for it in range(int(iters)):
+        fit_params, m1, m2, t, loss = step(
+            fit_params, m1, m2, t, frozen, frames, masks, cams,
+            jnp.int32(it % F))
+        if it == 0:
+            loss0 = loss  # device value — no per-iteration host sync
+    first = float(loss0) if loss0 is not None else None
+    last = float(loss) if loss is not None else None
+    scene.colors_sh, scene.opacity, scene.log_scales = fit_params
+    scene.fit_stats = {
+        "fit_iters": int(iters),
+        "fit_frames": int(F),
+        "fit_loss_first": round(first, 6) if first is not None else None,
+        "fit_loss_last": round(last, 6) if last is not None else None,
+        "fit_seconds": round(time.monotonic() - t0, 3),
+    }
+    log.debug("appearance fit: %d iters over %d frames, loss %.5f -> "
+              "%.5f in %.2fs", iters, F, first or 0.0, last or 0.0,
+              scene.fit_stats["fit_seconds"])
+    return scene

@@ -1,0 +1,320 @@
+"""SplatScene: seed Gaussians on the TSDF iso-shell, render, save/load.
+
+Seeding is ONE jitted compaction pass over the volume's active bricks
+(`ops/tsdf.py` layout): voxels inside the truncation band
+(|tsdf| < ``iso_band``, observed) are the shell candidates; a halo
+central-difference over face-neighbor bricks gives each its SDF
+gradient; the stratified compaction (`ops/pointcloud.stratified_
+indices` — the same machinery the streaming model buffer uses) picks
+``capacity`` of them at static shape. Each splat lands ON the
+iso-surface (voxel center − sdf·∇̂, the projective snap), its disc
+frame comes from the gradient (outward normal = −∇̂), its DC color from
+the volume's fused RGB — so a scene is renderable the moment it is
+seeded, before any appearance fitting.
+
+Every seeded array has ``capacity`` rows + a valid mask; the splat
+count never appears in a shape (the `stream/` static-shape rule), so
+one seed program serves a growing volume and one render program per
+resolution serves every view.
+"""
+
+from __future__ import annotations
+
+import functools
+import io as _io
+import zlib
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..fusion.volume import TSDFVolume
+from ..ops import pointcloud
+from ..ops import splat_render as sr
+from ..ops import tsdf as tsdf_ops
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+#: npz schema stamp for save/load (bump on layout change).
+_SCENE_VERSION = 1
+
+
+class SplatParams(NamedTuple):
+    """Static seeding/appearance knobs (hashable — they key the seed
+    program exactly like ``TSDFParams`` keys integration)."""
+
+    capacity: int = 8192        # splat slots (static; valid mask inside)
+    iso_band: float = 0.5       # |tsdf| (trunc units) seeding band
+    scale_mult: float = 1.6     # tangent σ = mult × survivor spacing
+    normal_scale: float = 0.35  # normal-axis σ / tangent σ (disc shape)
+    opacity_init: float = 2.5   # opacity logit at seed time (α ≈ 0.92)
+    min_weight: float = 0.0     # observation mask threshold
+
+
+@functools.lru_cache(maxsize=None)
+def _seed_fn(tparams: tsdf_ops.TSDFParams, sparams: SplatParams):
+    """Volume state → splat arrays, one launch, shapes fixed by
+    (brick cap, splat capacity)."""
+    cap_b = int(tparams.max_bricks)
+    scap = sparams.capacity
+    trunc = jnp.float32(tparams.trunc_voxels)
+
+    def halo_grad(t3, nbr):
+        """Central-difference gradient with face-neighbor halos; absent
+        neighbors replicate the own edge (zero gradient across the
+        boundary — never an invented crossing)."""
+        pad = jnp.pad(t3, ((0, 0), (1, 1), (1, 1), (1, 1)), mode="edge")
+        ext = jnp.concatenate([t3, jnp.zeros((1, 8, 8, 8), t3.dtype)])
+        have = nbr < cap_b
+        idx = jnp.minimum(nbr, cap_b)
+        # dirs6 order of tsdf._neighbor_fn: +x −x +y −y +z −z.
+        planes = (
+            (0, ext[idx[:, 0], 0, :, :], (slice(None), 9,
+                                          slice(1, 9), slice(1, 9))),
+            (1, ext[idx[:, 1], 7, :, :], (slice(None), 0,
+                                          slice(1, 9), slice(1, 9))),
+            (2, ext[idx[:, 2], :, 0, :], (slice(None), slice(1, 9), 9,
+                                          slice(1, 9))),
+            (3, ext[idx[:, 3], :, 7, :], (slice(None), slice(1, 9), 0,
+                                          slice(1, 9))),
+            (4, ext[idx[:, 4], :, :, 0], (slice(None), slice(1, 9),
+                                          slice(1, 9), 9)),
+            (5, ext[idx[:, 5], :, :, 7], (slice(None), slice(1, 9),
+                                          slice(1, 9), 0)),
+        )
+        for d, plane, sl in planes:
+            pad = pad.at[sl].set(jnp.where(have[:, d][:, None, None],
+                                           plane, pad[sl]))
+        gx = 0.5 * (pad[:, 2:, 1:-1, 1:-1] - pad[:, :-2, 1:-1, 1:-1])
+        gy = 0.5 * (pad[:, 1:-1, 2:, 1:-1] - pad[:, 1:-1, :-2, 1:-1])
+        gz = 0.5 * (pad[:, 1:-1, 1:-1, 2:] - pad[:, 1:-1, 1:-1, :-2])
+        return gx, gy, gz
+
+    def run(tsdf, weight, rgb, coords, nbr, block_valid, origin, voxel):
+        t3 = tsdf.reshape(cap_b, 8, 8, 8)
+        gx, gy, gz = halo_grad(t3, nbr)
+        grad = jnp.stack([gx, gy, gz], axis=-1).reshape(cap_b, 512, 3)
+        gnorm = jnp.linalg.norm(grad, axis=-1)
+        observed = weight > sparams.min_weight
+        near = (jnp.abs(tsdf) < sparams.iso_band) & observed \
+            & (gnorm > 1e-6) & block_valid[:, None]
+
+        flat_mask = near.reshape(-1)
+        n_near = jnp.sum(flat_mask.astype(jnp.int32))
+        idx, v = pointcloud.stratified_indices(flat_mask, scap)
+        bk = idx // 512
+        intra = idx % 512
+        vox = (coords[bk] * 8
+               + jnp.stack([intra // 64, (intra // 8) % 8, intra % 8],
+                           axis=-1))
+        center = (vox.astype(jnp.float32) + 0.5) * voxel + origin[None, :]
+        g = grad.reshape(-1, 3)[idx]
+        ghat = g / jnp.maximum(jnp.linalg.norm(g, axis=-1, keepdims=True),
+                               1e-9)
+        sdf_w = tsdf.reshape(-1)[idx] * trunc * voxel
+        means = center - sdf_w[:, None] * ghat       # snap onto the shell
+        normals = -ghat                              # outward (+ inside)
+        # Tangent σ from the survivor spacing: stratified thinning keeps
+        # every band voxel until capacity, then spreads them — area per
+        # splat grows by the thinning ratio, σ by its square root.
+        thin = jnp.sqrt(jnp.maximum(
+            n_near.astype(jnp.float32) / float(scap), 1.0))
+        s_t = jnp.log(sparams.scale_mult * voxel * thin)
+        s_n = jnp.log(sparams.scale_mult * sparams.normal_scale * voxel
+                      * thin)
+        log_scales = jnp.broadcast_to(
+            jnp.stack([s_t, s_t, s_n]), (scap, 3)).astype(jnp.float32)
+        sh = jnp.zeros((scap, 4, 3), jnp.float32)
+        sh = sh.at[:, 0, :].set(rgb.reshape(-1, 3)[idx] / 255.0)
+        opacity = jnp.full((scap,), sparams.opacity_init, jnp.float32)
+        means = jnp.where(v[:, None], means, 0.0)
+        normals = jnp.where(v[:, None], normals,
+                            jnp.asarray([0.0, 0.0, 1.0], jnp.float32))
+        return means, normals, log_scales, sh, opacity, v, n_near
+
+    return jax.jit(run)
+
+
+class SplatScene:
+    """One renderable splat set: device arrays + world framing.
+
+    ``means``/``normals`` are anchored (geometry belongs to the TSDF);
+    ``colors_sh``/``opacity``/``log_scales`` are the appearance state
+    `splat/fit.py` optimizes. ``bbox`` frames the orbit camera so a
+    scene renders without its source volume."""
+
+    def __init__(self, params: SplatParams, means, normals, log_scales,
+                 colors_sh, opacity, valid, bbox=None, voxel_size=0.0):
+        self.params = params
+        self.means = jnp.asarray(means, jnp.float32)
+        self.normals = jnp.asarray(normals, jnp.float32)
+        self.log_scales = jnp.asarray(log_scales, jnp.float32)
+        self.colors_sh = jnp.asarray(colors_sh, jnp.float32)
+        self.opacity = jnp.asarray(opacity, jnp.float32)
+        self.valid = jnp.asarray(valid, bool)
+        self.voxel_size = float(voxel_size)
+        if bbox is None:
+            v = np.asarray(self.valid)
+            pts = np.asarray(self.means)[v]
+            bbox = (pts.min(axis=0), pts.max(axis=0)) if pts.shape[0] \
+                else (np.zeros(3, np.float32), np.ones(3, np.float32))
+        self.bbox = (np.asarray(bbox[0], np.float32),
+                     np.asarray(bbox[1], np.float32))
+        self.fit_stats: dict = {}
+
+    @property
+    def n_splats(self) -> int:
+        return int(jnp.sum(self.valid.astype(jnp.int32)))
+
+    @property
+    def capacity(self) -> int:
+        return int(self.means.shape[0])
+
+    # -- rendering ---------------------------------------------------------
+
+    def camera(self, azim: float, elev: float, width: int, height: int,
+               zoom: float = 2.1):
+        return sr.orbit_camera(self.bbox[0], self.bbox[1], azim, elev,
+                               width, height, zoom=zoom)
+
+    def render_camera(self, camera, cfg: sr.RenderConfig,
+                      use_pallas: bool | None = None):
+        """((H, W, 3) float 0–1, alpha) from an explicit camera tuple."""
+        return sr.render(self.means, self.normals, self.log_scales,
+                         self.colors_sh, self.opacity, self.valid,
+                         camera, cfg, use_pallas=use_pallas)
+
+    def render(self, azim: float = 30.0, elev: float = 20.0,
+               width: int = 384, height: int = 288, zoom: float = 2.1,
+               use_pallas: bool | None = None) -> np.ndarray:
+        """Novel orbit view → host (H, W, 3) uint8. Angles/zoom are
+        traced operands: a sweep reuses one program per (width,
+        height)."""
+        cfg = sr.RenderConfig(width=int(width), height=int(height))
+        img, _ = self.render_camera(
+            self.camera(azim, elev, cfg.width, cfg.height, zoom), cfg,
+            use_pallas=use_pallas)
+        return sr.to_uint8(img)
+
+    # -- persistence -------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        """The scene as .npz bytes (the ``GET /session/<id>/splats``
+        payload and ``cli render`` input)."""
+        buf = _io.BytesIO()
+        np.savez_compressed(
+            buf, version=np.int32(_SCENE_VERSION),
+            params=np.asarray(tuple(self.params), np.float64),
+            means=np.asarray(self.means), normals=np.asarray(self.normals),
+            log_scales=np.asarray(self.log_scales),
+            colors_sh=np.asarray(self.colors_sh),
+            opacity=np.asarray(self.opacity),
+            valid=np.asarray(self.valid),
+            bbox_lo=self.bbox[0], bbox_hi=self.bbox[1],
+            voxel_size=np.float64(self.voxel_size))
+        return buf.getvalue()
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.to_bytes())
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SplatScene":
+        try:
+            z = np.load(_io.BytesIO(data), allow_pickle=False)
+        except (ValueError, OSError, zlib.error) as e:
+            raise ValueError(f"not a splat scene archive: {e}")
+        if "version" not in z or int(z["version"]) != _SCENE_VERSION:
+            raise ValueError(
+                f"splat scene version {z.get('version')} unsupported "
+                f"(this build reads v{_SCENE_VERSION})")
+        p = z["params"]
+        params = SplatParams(capacity=int(p[0]), iso_band=float(p[1]),
+                             scale_mult=float(p[2]),
+                             normal_scale=float(p[3]),
+                             opacity_init=float(p[4]),
+                             min_weight=float(p[5]))
+        return cls(params, z["means"], z["normals"], z["log_scales"],
+                   z["colors_sh"], z["opacity"], z["valid"],
+                   bbox=(z["bbox_lo"], z["bbox_hi"]),
+                   voxel_size=float(z["voxel_size"]))
+
+    @classmethod
+    def load(cls, path: str) -> "SplatScene":
+        with open(path, "rb") as f:
+            return cls.from_bytes(f.read())
+
+    def stats(self) -> dict:
+        return {
+            "splats": self.n_splats,
+            "capacity": self.capacity,
+            "voxel_size": round(self.voxel_size, 6),
+            **{k: v for k, v in self.fit_stats.items()},
+        }
+
+
+def seed_from_volume(volume: TSDFVolume,
+                     params: SplatParams = SplatParams()) -> SplatScene:
+    """TSDF volume → :class:`SplatScene` (module docstring). Pure read:
+    the volume state is NOT donated — previews keep integrating into it
+    and re-seeding after more stops is the intended refresh."""
+    state = volume._state
+    nbr, block_valid = tsdf_ops.neighbor_table(state, volume.params)
+    out = _seed_fn(volume.params, params)(
+        state.tsdf, state.weight, state.rgb, state.brick_coords, nbr,
+        block_valid, jnp.asarray(volume.origin, jnp.float32),
+        jnp.float32(volume.voxel_size))
+    means, normals, log_scales, sh, opacity, valid, n_near = out
+    scene = SplatScene(params, means, normals, log_scales, sh, opacity,
+                       valid, voxel_size=volume.voxel_size)
+    n = scene.n_splats
+    if n == 0:
+        log.warning("splat seeding found no shell voxels (empty or "
+                    "unobserved volume)")
+    else:
+        log.debug("seeded %d/%d splats from %d shell voxels (voxel %.4f)",
+                  n, params.capacity, int(n_near), volume.voxel_size)
+    return scene
+
+
+def splat_scene_from_cloud(cloud, params: SplatParams = SplatParams(),
+                           depth: int = 7, max_bricks: int = 8192,
+                           orientation_mode: str = "radial") -> SplatScene:
+    """Oriented/colored cloud → fused TSDF → seeded scene — the
+    `mesh_from_cloud`-style one-shot entry (``cli render`` over a .ply).
+    Sign from the oriented normals, colors from ``cloud.colors`` (gray
+    when absent); appearance starts at the fused DC colors — pass the
+    scene through `splat/fit.py` with captured views to add view
+    dependence."""
+    from ..models import meshing
+    from ..ops.marching_jax import _bucket
+
+    pts = np.asarray(cloud.points, np.float32)
+    if pts.shape[0] < 16:
+        raise ValueError(f"too few points to splat ({pts.shape[0]})")
+    normals = meshing.ensure_oriented_normals(cloud, orientation_mode)
+    grid_depth = min(max(int(depth), 5), 9)
+    tparams = tsdf_ops.TSDFParams(grid_depth=grid_depth,
+                                  max_bricks=int(max_bricks))
+    n = pts.shape[0]
+    cap = _bucket(n)
+    pad = cap - n
+    has_colors = cloud.colors is not None and len(cloud.colors) == n
+    cols = np.asarray(cloud.colors, np.float32) if has_colors \
+        else np.full((n, 3), 180.0, np.float32)
+    vol = TSDFVolume.from_bounds(tparams, pts.min(axis=0),
+                                 pts.max(axis=0))
+    vol.integrate_oriented(
+        np.concatenate([pts, np.zeros((pad, 3), np.float32)]),
+        np.concatenate([cols, np.zeros((pad, 3), np.float32)]),
+        np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]),
+        np.concatenate([normals.astype(np.float32),
+                        np.tile(np.asarray([[0.0, 0.0, 1.0]], np.float32),
+                                (pad, 1))]))
+    scene = seed_from_volume(vol, params)
+    log.info("splat scene from %d points: %d splats (depth=%d, "
+             "colored=%s)", n, scene.n_splats, grid_depth, has_colors)
+    return scene

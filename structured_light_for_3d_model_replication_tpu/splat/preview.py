@@ -1,0 +1,205 @@
+"""SplatPreviewMesher: the rendered-preview lane for streaming sessions.
+
+Extends the TSDF previewer (`fusion/preview.py` — geometry previews
+stay the extracted colored mesh, so the STL preview endpoint keeps
+working unchanged) with the appearance tier:
+
+* each fused stop's DENSE frame (the decode's per-pixel colors + valid
+  mask, camera-frame points for the one-time pinhole fit) is observed
+  into a bounded round-robin frame buffer at a fixed fit resolution —
+  work per stop is one strided host subsample, no device programs;
+* the splat scene is LAZY: seeded from the volume and fitted against
+  the buffered frames only when a render is actually requested (the
+  serve render endpoint, ``--preview-render``, finalize's
+  ``render_png``) and only when stops arrived since the last build —
+  the INGEST path never runs seed/fit work itself. A render that
+  follows new stops pays the rebuild at request time, and in serve it
+  does so under the session lock (every session operation serializes
+  there), so a client polling renders between stops delays the next
+  stop's ingest by the rebuild — bound it with ``fit_iters``, or poll
+  the cheap mesh preview for progress and render at a coarser cadence
+  (an async snapshot build is the ROADMAP follow-on);
+* re-builds are from-scratch (re-seed + fixed-iteration fit), so a
+  render is a deterministic function of the volume + frame buffer —
+  no incremental optimizer drift, and the serve/CLI parity contract
+  (same scene bytes ⇒ same pixels) holds.
+
+Static shapes: the seed program is keyed by (TSDFParams, SplatParams),
+the fit step by the fit resolution, the render by (capacity, render
+size) — a 20-frame novel-view sweep after warmup compiles NOTHING
+(asserted in tests/test_splat.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..fusion.preview import TSDFPreviewMesher
+from ..io.png import png_bytes
+from ..ops import splat_render as sr
+from ..ops.tsdf import TSDFParams
+from ..utils.log import get_logger
+from .fit import fit_appearance, fit_pinhole, frame_target
+from .model import SplatParams, SplatScene, seed_from_volume
+
+log = get_logger(__name__)
+
+
+class SplatPreviewMesher(TSDFPreviewMesher):
+    """Drop-in previewer (`stream/preview.make_previewer` lane
+    ``representation="splat"``): TSDF mesh previews + rendered novel
+    views."""
+
+    def __init__(self, voxel_size_hint: float,
+                 params: TSDFParams = TSDFParams(max_bricks=4096),
+                 splat_params: SplatParams = SplatParams(),
+                 fit_iters: int = 40, max_frames: int = 8,
+                 fit_pixels: int = 12288,
+                 render_sizes: tuple = ((384, 288),), **kw):
+        super().__init__(voxel_size_hint, params=params, **kw)
+        self.splat_params = splat_params
+        self.fit_iters = int(fit_iters)
+        self.max_frames = max(1, int(max_frames))
+        self.fit_pixels = int(fit_pixels)
+        self.render_sizes = tuple((int(w), int(h))
+                                  for w, h in render_sizes)
+        self.intrinsics: tuple | None = None   # (fx, fy, cx, cy) full-res
+        self.frame_shape: tuple | None = None
+        self.stride: int = 1
+        self._frames: list = []        # (target, mask) host arrays
+        self._cams: list = []          # render camera tuples at fit res
+        self._frames_seen = 0
+        self._scene: SplatScene | None = None
+        self._scene_stops = -1         # stops_integrated at last build
+        self.last_render_meta: dict = {}
+
+    # -- frame observation (per fused stop, host-side) ---------------------
+
+    def observe_frame(self, points, colors, valid, pose,
+                      frame_shape) -> bool:
+        """Buffer one stop's dense frame for the appearance fit.
+
+        ``points``/``colors``/``valid`` are the stop's dense decode
+        arrays (camera frame, (H·W, …)); ``pose`` the stop's camera→
+        model 4×4. Returns False when the frame is unusable (pinhole
+        fit failed) — rendering still works from the volume's DC
+        colors."""
+        h, w = int(frame_shape[0]), int(frame_shape[1])
+        if self.frame_shape is None:
+            self.frame_shape = (h, w)
+            stride = 1
+            while (h // stride) * (w // stride) > self.fit_pixels:
+                stride += 1
+            self.stride = stride
+        elif (h, w) != self.frame_shape:
+            log.warning("splat frame shape changed %s -> %s; frame "
+                        "dropped", self.frame_shape, (h, w))
+            return False
+        if self.intrinsics is None:
+            fit = fit_pinhole(np.asarray(points), np.asarray(valid), h, w)
+            if fit is None:
+                log.debug("splat pinhole fit abstained (stop too sparse)")
+                return False
+            self.intrinsics = fit
+        target, mask = frame_target(colors, valid, h, w, self.stride)
+        fx, fy, cx, cy = self.intrinsics
+        s = float(self.stride)
+        cam = sr.stop_camera(np.asarray(pose, np.float64),
+                             fx / s, fy / s, cx / s, cy / s)
+        if len(self._frames) < self.max_frames:
+            self._frames.append((target, mask))
+            self._cams.append(cam)
+        else:
+            slot = self._frames_seen % self.max_frames
+            self._frames[slot] = (target, mask)
+            self._cams[slot] = cam
+        self._frames_seen += 1
+        return True
+
+    # -- lazy scene build --------------------------------------------------
+
+    @property
+    def scene_stale(self) -> bool:
+        return (self._scene is None or self.volume is None
+                or self._scene_stops != self.volume.stops_integrated)
+
+    def ensure_scene(self) -> SplatScene | None:
+        """Seed (+ fit, when frames exist) the scene if stops arrived
+        since the last build; None before the first integrated stop."""
+        if self.volume is None:
+            return None
+        if not self.scene_stale:
+            return self._scene
+        t0 = time.monotonic()
+        scene = seed_from_volume(self.volume, self.splat_params)
+        if self._frames and scene.n_splats:
+            # Pad the buffer to the FIXED max_frames slot count by
+            # cycling what exists (duplicate supervision ≈ extra epochs
+            # on fewer frames — harmless and deterministic): the fit
+            # step's program is keyed by the frame-buffer length, so a
+            # growing buffer would otherwise recompile it at every size
+            # 1..max_frames — including inside the first render
+            # requests of a session the replica warmup claimed warm.
+            idx = [i % len(self._frames) for i in range(self.max_frames)]
+            frames = np.stack([self._frames[i][0] for i in idx])
+            masks = np.stack([self._frames[i][1] for i in idx])
+            fit_appearance(scene, frames, masks,
+                           [self._cams[i] for i in idx],
+                           iters=self.fit_iters)
+        scene.fit_stats["build_seconds"] = round(
+            time.monotonic() - t0, 3)
+        self._scene = scene
+        self._scene_stops = self.volume.stops_integrated
+        return scene
+
+    # -- rendering ---------------------------------------------------------
+
+    def render_size_ok(self, width: int, height: int) -> bool:
+        return (int(width), int(height)) in self.render_sizes
+
+    def render_image(self, azim: float, elev: float,
+                     width: int | None = None,
+                     height: int | None = None) -> np.ndarray | None:
+        """(H, W, 3) uint8 novel view, or None before the first stop."""
+        scene = self.ensure_scene()
+        if scene is None:
+            return None
+        w, h = self.render_sizes[0]
+        if width is not None and height is not None:
+            w, h = int(width), int(height)
+        t0 = time.monotonic()
+        img = scene.render(azim=float(azim), elev=float(elev),
+                           width=w, height=h)
+        self.last_render_meta = {
+            "azim": round(float(azim), 3), "elev": round(float(elev), 3),
+            "width": w, "height": h, "splats": scene.n_splats,
+            "render_s": round(time.monotonic() - t0, 4),
+            "fit_frames": len(self._frames),
+        }
+        return img
+
+    def render_png(self, azim: float, elev: float,
+                   width: int | None = None,
+                   height: int | None = None
+                   ) -> tuple[bytes, dict] | None:
+        img = self.render_image(azim, elev, width, height)
+        if img is None:
+            return None
+        return png_bytes(img), dict(self.last_render_meta)
+
+    def scene_bytes(self) -> bytes | None:
+        """The current scene as .npz bytes (the ``/session/<id>/splats``
+        payload; ``cli render`` re-renders it bit-identically)."""
+        scene = self.ensure_scene()
+        return None if scene is None else scene.to_bytes()
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(fit_frames=len(self._frames),
+                   frames_seen=self._frames_seen,
+                   scene_stale=self.scene_stale)
+        if self._scene is not None:
+            out.update(self._scene.stats())
+        return out

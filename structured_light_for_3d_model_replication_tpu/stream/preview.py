@@ -86,6 +86,7 @@ class PreviewMesher:
         self.cg_iters = int(cg_iters)
         self.last_cg_iters: int | None = None
         self._last_chi = None
+        self._last_grid = None
 
     def __call__(self, model_pts, model_valid) -> TriangleMesh:
         p, normals, v = _sample_normals_fn(self.points, self.normals_k)(
@@ -95,6 +96,7 @@ class PreviewMesher:
             cg_iters=self.cg_iters, x0=self._last_chi, return_iters=True)
         self.last_cg_iters = iters
         self._last_chi = grid.chi
+        self._last_grid = grid
         mesh = marching.extract(grid, quantile_trim=self.quantile_trim)
         log.debug("preview: %d sample slots -> %d faces (depth %d, "
                   "%d CG iters)", self.points, len(mesh.faces),
@@ -107,6 +109,14 @@ class PreviewMesher:
         final solve runs at the SAME dense depth (stream/session.py)."""
         return self._last_chi
 
+    @property
+    def last_grid(self):
+        """Latest preview grid WITH its world normalization — the
+        sparse finalize (final_depth > 8) threads it into
+        ``reconstruct_sparse(x0=…)``, which world-aligns it onto its
+        internal coarse solve (docs/MESHING.md § warm starts)."""
+        return self._last_grid
+
     @staticmethod
     def empty() -> TriangleMesh:
         return TriangleMesh(vertices=np.zeros((0, 3), np.float32),
@@ -115,18 +125,34 @@ class PreviewMesher:
 
 def make_previewer(params):
     """StreamParams → the session's previewer: the coarse-Poisson
-    re-solver (default) or the incremental TSDF mesher
-    (``representation="tsdf"``, `fusion/preview.py`; both share the
-    ``__call__(model_pts, model_valid) -> TriangleMesh`` contract)."""
-    if params.representation == "tsdf":
-        from ..fusion.preview import TSDFPreviewMesher
+    re-solver (default), the incremental TSDF mesher
+    (``representation="tsdf"``, `fusion/preview.py`) or the splat
+    appearance lane (``"splat"``, `splat/preview.py` — the TSDF mesher
+    plus rendered novel views). All share the ``__call__(model_pts,
+    model_valid) -> TriangleMesh`` contract."""
+    if params.representation in ("tsdf", "splat"):
         from ..ops.tsdf import TSDFParams
 
+        tparams = TSDFParams(grid_depth=params.tsdf_grid_depth,
+                             max_bricks=params.tsdf_max_bricks,
+                             carve_steps=params.tsdf_carve_steps)
+        hint = params.tsdf_voxel_scale * params.merge.voxel_size
+        if params.representation == "splat":
+            from ..splat.model import SplatParams
+            from ..splat.preview import SplatPreviewMesher
+
+            return SplatPreviewMesher(
+                voxel_size_hint=hint, params=tparams,
+                splat_params=SplatParams(capacity=params.splat_cap),
+                fit_iters=params.splat_fit_iters,
+                max_frames=params.splat_max_frames,
+                fit_pixels=params.splat_fit_pixels,
+                render_sizes=params.splat_render_sizes,
+                quantile_trim=params.preview_trim)
+        from ..fusion.preview import TSDFPreviewMesher
+
         return TSDFPreviewMesher(
-            voxel_size_hint=params.tsdf_voxel_scale
-            * params.merge.voxel_size,
-            params=TSDFParams(grid_depth=params.tsdf_grid_depth,
-                              max_bricks=params.tsdf_max_bricks),
+            voxel_size_hint=hint, params=tparams,
             quantile_trim=params.preview_trim)
     return PreviewMesher(points=params.preview_points,
                          depth=params.preview_depth,

@@ -107,11 +107,28 @@ class StreamParams:
     # (docs/MESHING.md): "poisson" = coarse re-solve previews + the
     # watertight print path; "tsdf" = incremental fused-volume previews
     # (fusion/, per-stop integration instead of a re-solve) and a
-    # vertex-COLORED final mesh.
+    # vertex-COLORED final mesh; "splat" = the TSDF lane PLUS the
+    # Gaussian appearance tier (splat/, docs/RENDERING.md) — rendered
+    # novel-view previews next to the mesh ones, fitted from the
+    # per-stop RGB the session already decodes.
     representation: str = "poisson"
     tsdf_voxel_scale: float = 2.0       # TSDF voxel = scale × merge voxel
     tsdf_grid_depth: int = 8
     tsdf_max_bricks: int = 4096
+    # Free-space carving (ops/tsdf.py TSDFParams.carve_steps): 0 = the
+    # historical bit-identical integrate; > 0 marches observed-empty
+    # samples toward the camera so moving-sensor captures erase stale
+    # surface (docs/MESHING.md).
+    tsdf_carve_steps: int = 0
+    # -- splat appearance tier (representation="splat") -------------------
+    splat_cap: int = 8192               # splat slots on the TSDF shell
+    splat_fit_iters: int = 40           # Adam steps per lazy scene build
+    splat_max_frames: int = 8           # RGB frames kept for the fit
+    splat_fit_pixels: int = 12288       # fit-resolution pixel budget
+    # Allowed render resolutions (W, H): first is the default; the serve
+    # render endpoint 400s anything else (each size is one compiled
+    # program — an open set would mint compiles on demand).
+    splat_render_sizes: tuple = ((384, 288),)
     # -- finalize ---------------------------------------------------------
     final_depth: int = 8
     final_trim: float = 0.0
@@ -273,9 +290,9 @@ class IncrementalSession:
         if params.method not in ("sequential", "posegraph"):
             raise ValueError(f"method must be 'sequential' or 'posegraph',"
                              f" got {params.method!r}")
-        if params.representation not in ("poisson", "tsdf"):
-            raise ValueError(f"representation must be 'poisson' or "
-                             f"'tsdf', got {params.representation!r}")
+        if params.representation not in ("poisson", "tsdf", "splat"):
+            raise ValueError(f"representation must be 'poisson', 'tsdf' "
+                             f"or 'splat', got {params.representation!r}")
         self.calib = calib
         self.col_bits = col_bits
         self.row_bits = row_bits
@@ -361,11 +378,13 @@ class IncrementalSession:
         pts, cols, vals = scan360_mod.decode_stop(
             stack, self.calib, self.col_bits, self.row_bits,
             decode_cfg=self.decode_cfg, tri_cfg=self.tri_cfg)
-        return self.add_decoded(pts, cols, vals, stop=stop)
+        return self.add_decoded(pts, cols, vals, stop=stop,
+                                frame_shape=stack.shape[1:3])
 
     def add_decoded(self, points, colors, valid,
                     stop: int | None = None,
-                    coverage: float | None = None) -> StopResult:
+                    coverage: float | None = None,
+                    frame_shape: tuple | None = None) -> StopResult:
         """Fuse one stop's decoded dense arrays (device or host):
         ``points`` (P, 3) f32, ``colors`` (P, 3), ``valid`` (P,) bool.
         ``stop`` is the PHYSICAL stop label (strictly increasing;
@@ -374,7 +393,11 @@ class IncrementalSession:
         like the batch degraded-ring path. ``coverage`` overrides the
         plain ``mean(valid)`` statistic — serve workers pass the
         pre-padding region's coverage so bucket padding never dilutes
-        the gate."""
+        the gate. ``frame_shape`` is the dense arrays' (H, W) pixel
+        layout — the splat appearance tier needs it to treat the stop
+        as an RGB supervision frame (``add_stop`` and the serve worker
+        pass it; without it the splat lane renders from fused DC colors
+        only)."""
         if self._finalized:
             raise health_mod.StopQualityError(
                 f"session {self.scan_id} is finalized")
@@ -386,12 +409,14 @@ class IncrementalSession:
         self._next_label = label + 1
         t0 = time.monotonic()
         with events.context(scan_id=self.scan_id, stop=label):
-            res = self._ingest(label, points, colors, valid, coverage)
+            res = self._ingest(label, points, colors, valid, coverage,
+                               frame_shape)
         res.seconds = time.monotonic() - t0
         return res
 
     def _ingest(self, label: int, points, colors, valid,
-                coverage: float | None = None) -> StopResult:
+                coverage: float | None = None,
+                frame_shape: tuple | None = None) -> StopResult:
         p = self.params
         mp = p.merge
         points = jnp.asarray(points)
@@ -471,6 +496,16 @@ class IncrementalSession:
 
         # -- fuse into the running model ----------------------------------
         moved = self._fuse(sub_pts, sub_col, sub_val)
+        if p.representation == "splat" and frame_shape is not None:
+            # Appearance supervision (splat/preview.py): the stop's
+            # DENSE RGB + valid mask and its registered pose join the
+            # fit buffer — one strided host subsample, no device work
+            # on the ingest path (the fit itself is lazy, at render
+            # time). The stored pose is the stop's at-ingest estimate;
+            # later window refinements shift it by less than the fit's
+            # pixel tolerance.
+            self._mesher.observe_frame(points, colors, valid,
+                                       self._poses[-1], frame_shape)
         if p.covis:
             cam_keys = _voxel_keys(reg_np, self._covis_voxel())
             self._prev_cam_voxels = cam_keys
@@ -622,7 +657,7 @@ class IncrementalSession:
                         "subset", p.model_cap, n_model)
         self._model_points = min(n_model, p.model_cap)
         moved_np = np.asarray(moved)
-        if p.representation == "tsdf":
+        if p.representation in ("tsdf", "splat"):
             # Incremental TSDF integration (fusion/preview.py): the
             # stop's pose-transformed view fuses into the persistent
             # volume here, so the preview is a pure extraction — no
@@ -797,20 +832,33 @@ class IncrementalSession:
             all_poses[lab] = predicted.astype(np.float32)
 
         final_mesh = None
+        solve_stats: dict = {}
         if want_mesh:
             from ..models import meshing
 
-            # Dense-path CG warm start: when finalize solves at the SAME
-            # dense depth the previews ran, the last preview χ is a
-            # near-solution (the model the previews watched IS the
-            # final model, coarser sampled) — thread it through.
-            x0 = getattr(self._mesher, "last_chi", None) \
-                if p.final_depth == p.preview_depth else None
+            # Poisson warm starts from the previews (docs/MESHING.md):
+            # at the SAME dense depth the last preview χ seeds the CG
+            # directly; at a SPARSE final depth (> 8) the full preview
+            # GRID rides along and warm-starts the sparse solver's
+            # internal coarse solve (world-aligned — the ROADMAP's
+            # "previews → final solve" item).
+            x0 = None
+            if p.representation == "poisson":
+                if p.final_depth == p.preview_depth:
+                    x0 = getattr(self._mesher, "last_chi", None)
+                elif p.final_depth > 8:
+                    x0 = getattr(self._mesher, "last_grid", None)
             final_mesh = meshing.mesh_from_cloud(
                 merged, mode="watertight", depth=p.final_depth,
                 quantile_trim=p.final_trim,
-                representation=p.representation,
-                tsdf_max_bricks=p.tsdf_max_bricks, cg_x0=x0)
+                # The splat lane's GEOMETRY is the TSDF volume — its
+                # final mesh is the colored TSDF extraction (the
+                # rendered artifact rides result_format="render_png",
+                # not the mesh path).
+                representation="tsdf" if p.representation == "splat"
+                else p.representation,
+                tsdf_max_bricks=p.tsdf_max_bricks, cg_x0=x0,
+                solve_stats=solve_stats)
         stats = {
             "stops_fused": n,
             "stops_skipped": len(self._skipped),
@@ -821,6 +869,10 @@ class IncrementalSession:
             "min_fitness": round(float(fit.min()), 4) if len(fit) else None,
             "cloud_points": len(merged),
         }
+        if solve_stats:
+            # Sparse-finalize solve telemetry (warm_start_blocks > 0 =
+            # the previews seeded the final solve; tests assert it).
+            stats["final_solve"] = solve_stats
         log.info("stream finalize[%s]: %d fused / %d skipped stops -> "
                  "%d points%s", self.scan_id, n, len(self._skipped),
                  len(merged),

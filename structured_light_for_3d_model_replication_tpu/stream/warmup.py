@@ -69,14 +69,43 @@ def _synthetic_stop(n_pixels: int, m_valid: int, step_deg: float,
     return points, colors, valid
 
 
+def _warm_splat_lane(mesher, frame_shape) -> bool:
+    """Drive the splat previewer's observe → seed → fit → render chain
+    once with a pinhole-consistent synthetic frame (a fronto-parallel
+    textured plane — the sphere stops above already populated the
+    volume; this frame exists so the pinhole fit succeeds and the fit
+    step compiles). The result is discarded; the programs stay."""
+    h, w = int(frame_shape[0]), int(frame_shape[1])
+    f = 0.8 * w
+    cx, cy = (w - 1) * 0.5, (h - 1) * 0.5
+    z = 500.0
+    jj, ii = np.meshgrid(np.arange(w, dtype=np.float32),
+                         np.arange(h, dtype=np.float32))
+    points = np.stack([(jj - cx) * z / f, (ii - cy) * z / f,
+                       np.full((h, w), z, np.float32)],
+                      axis=-1).reshape(-1, 3)
+    colors = np.zeros((h * w, 3), np.uint8)
+    colors[:, 0] = (np.arange(h * w) % 255).astype(np.uint8)
+    valid = np.ones(h * w, bool)
+    if not mesher.observe_frame(points, colors, valid, np.eye(4),
+                                (h, w)):
+        return False
+    return mesher.render_png(30.0, 20.0) is not None
+
+
 def warm_session_programs(params: StreamParams, n_pixels: int,
                           col_bits: int = 8, row_bits: int = 8,
-                          stops: int = 3) -> dict:
+                          stops: int = 3,
+                          frame_shape: tuple | None = None) -> dict:
     """Compile the session-lane programs for ``(params, n_pixels)``.
 
     Returns a small report dict (seconds, stops, representation). Safe
     to call more than once — warm programs make reruns near-free (the
-    jit cache is process-global, exactly why this works)."""
+    jit cache is process-global, exactly why this works).
+    ``frame_shape`` (H, W) warms the splat appearance lane too
+    (``representation="splat"``): seed, fit step and the default-size
+    render compile at replica start instead of inside the first
+    render request."""
     t0 = time.monotonic()
     # Gates and covisibility are host-side (they key no programs);
     # disabling them guarantees every synthetic stop actually FUSES —
@@ -94,11 +123,15 @@ def warm_session_programs(params: StreamParams, n_pixels: int,
         points, colors, valid = _synthetic_stop(
             n_pixels, m_valid, step, k)
         sess.add_decoded(points, colors, valid)
+    rendered = False
+    if wp.representation == "splat" and frame_shape is not None:
+        rendered = _warm_splat_lane(sess._mesher, frame_shape)
     report = {
         "seconds": round(time.monotonic() - t0, 3),
         "stops": sess.stops_fused,
         "pixels": int(n_pixels),
         "representation": wp.representation,
+        "render_warmed": rendered,
     }
     log.info("session-lane warmup: %d synthetic stops @ %d px "
              "(%s previews) in %.1fs", report["stops"], n_pixels,

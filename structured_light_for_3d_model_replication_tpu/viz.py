@@ -17,9 +17,6 @@ path, never on the device hot path.
 
 from __future__ import annotations
 
-import struct
-import zlib
-
 import numpy as np
 
 # Default palette (RGB, 0-255). Matches the reference's viewer conventions:
@@ -36,59 +33,25 @@ BACKGROUND = (18, 20, 26)
 
 
 # ----------------------------------------------------------------------
-# PNG writer (pure stdlib: zlib + struct). Deliberately NOT PIL/cv2: viz
-# is the one module a user may want with zero imaging deps (headless TPU
-# pods), and an RGB8 PNG encoder is 20 lines. ``load_png`` exists for
-# round-trip tests only — it is not a general decoder.
+# PNG I/O: the shared stdlib-only encoder lives in `io/png.py` (the
+# splat render endpoints and cli render need BYTES, not files); these
+# wrappers keep the historical viz surface.
 # ----------------------------------------------------------------------
 
 def save_png(path, image: np.ndarray) -> None:
     """Write an (H, W, 3) uint8 image as an RGB PNG."""
-    img = np.ascontiguousarray(np.asarray(image, np.uint8))
-    if img.ndim != 3 or img.shape[2] != 3:
-        raise ValueError(f"expected (H, W, 3) uint8, got {img.shape}")
-    h, w = img.shape[:2]
-    # Filter type 0 (None) per scanline.
-    raw = np.concatenate(
-        [np.zeros((h, 1), np.uint8), img.reshape(h, w * 3)], axis=1
-    ).tobytes()
+    from .io.png import write_png
 
-    def chunk(tag: bytes, payload: bytes) -> bytes:
-        return (struct.pack(">I", len(payload)) + tag + payload
-                + struct.pack(">I", zlib.crc32(tag + payload)))
-
-    ihdr = struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0)  # 8-bit RGB
-    data = (b"\x89PNG\r\n\x1a\n"
-            + chunk(b"IHDR", ihdr)
-            + chunk(b"IDAT", zlib.compress(raw, 6))
-            + chunk(b"IEND", b""))
-    with open(path, "wb") as f:
-        f.write(data)
+    write_png(path, image)
 
 
 def load_png(path) -> np.ndarray:
     """Read back an RGB PNG written by :func:`save_png` (filter 0 only —
     round-trip/testing helper, not a general decoder)."""
+    from .io.png import decode_png
+
     with open(path, "rb") as f:
-        blob = f.read()
-    if blob[:8] != b"\x89PNG\r\n\x1a\n":
-        raise ValueError("not a PNG")
-    pos, w, h, idat = 8, 0, 0, b""
-    while pos < len(blob):
-        (ln,) = struct.unpack(">I", blob[pos:pos + 4])
-        tag = blob[pos + 4:pos + 8]
-        payload = blob[pos + 8:pos + 8 + ln]
-        if tag == b"IHDR":
-            w, h, depth, ctype = struct.unpack(">IIBB", payload[:10])
-            if depth != 8 or ctype != 2:
-                raise ValueError("only 8-bit RGB supported")
-        elif tag == b"IDAT":
-            idat += payload
-        pos += 12 + ln
-    rows = np.frombuffer(zlib.decompress(idat), np.uint8).reshape(h, 1 + w * 3)
-    if np.any(rows[:, 0]):
-        raise ValueError("only filter 0 supported")
-    return rows[:, 1:].reshape(h, w, 3).copy()
+        return decode_png(f.read())
 
 
 # ----------------------------------------------------------------------

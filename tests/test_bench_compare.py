@@ -314,3 +314,53 @@ def test_multidevice_sweep_headline_direction(tmp_path, capsys):
     assert rc == 1
     assert doc["rows"][0]["verdict"] == "REGRESSION"
     assert doc["regressions"] == 1
+
+
+def test_render_metric_directions(tmp_path, capsys):
+    """Config [12]'s two lines pull in opposite directions:
+    ``render_view_s`` is per-view latency (lower wins, the seconds
+    default) while ``render_psnr_db`` is rendered FIDELITY (higher
+    wins — dropping decibels is the regression). The BENCH_DETAILS
+    alias maps config ``splat_render_view`` onto the latency line."""
+    assert not bench_compare.higher_is_better("render_view_s")
+    assert bench_compare.higher_is_better("render_psnr_db")
+
+    tail = "\n".join([
+        _headline("full_360_scan_to_mesh_s", 5.9),
+        _headline("render_view_s", 0.02),
+        _headline("render_psnr_db", 24.0),
+    ])
+    _round(tmp_path, 1, tail)
+    traj = bench_compare.load_history([str(tmp_path / "BENCH_r01.json")])
+    assert traj["render_view_s"] == [(1, 0.02)]
+    assert traj["render_psnr_db"] == [(1, 24.0)]
+
+    details = tmp_path / "details.json"
+    details.write_text(json.dumps({
+        "splat_render_view": {"value_s": 0.018,
+                              "render_psnr_db": 24.5},
+    }), encoding="utf-8")
+    assert bench_compare.load_fresh(str(details)) == {
+        "render_view_s": 0.018}
+
+    # PSNR UP is an improvement: strict passes.
+    fresh = tmp_path / "fresh_good.log"
+    fresh.write_text("\n".join([_headline("render_view_s", 0.015),
+                                _headline("render_psnr_db", 27.0)]) + "\n",
+                     encoding="utf-8")
+    assert _run(tmp_path, str(fresh), "--strict") == 0
+    out = capsys.readouterr().out
+    assert "regression" not in out
+
+    # PSNR DOWN beyond the threshold is a regression: strict fails.
+    worse = tmp_path / "fresh_bad.log"
+    worse.write_text(_headline("render_psnr_db", 18.0) + "\n",
+                     encoding="utf-8")
+    assert _run(tmp_path, str(worse), "--strict") != 0
+    assert "regression" in capsys.readouterr().out
+
+    # Render latency UP beyond the threshold is a regression too.
+    slow = tmp_path / "fresh_slow.log"
+    slow.write_text(_headline("render_view_s", 0.2) + "\n",
+                    encoding="utf-8")
+    assert _run(tmp_path, str(slow), "--strict") != 0

@@ -12,6 +12,8 @@ import io
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from structured_light_for_3d_model_replication_tpu.fusion import (
     TSDFParams,
     TSDFPreviewMesher,
@@ -294,3 +296,88 @@ class TestPreviewMesher:
         assert len(mesh.faces) > 100
         assert mesh.vertex_colors is not None
         assert pm.stats()["stops_integrated"] == 4
+
+
+class TestFreeSpaceCarving:
+    """TSDFParams.carve_steps (off by default): observed-empty samples
+    marched toward the camera decay stale surface weight — the
+    moving-sensor erasure the ROADMAP names — while the DEFAULT path
+    stays the bit-identical historical integrate."""
+
+    CAM = np.array([0.5, 0.5, 0.95], np.float32)
+
+    def _plane_stop(self, z, n=4096):
+        rng = np.random.default_rng(0)
+        xy = rng.uniform(0.1, 0.9, (n, 2)).astype(np.float32)
+        pts = np.concatenate([xy, np.full((n, 1), z, np.float32)],
+                             axis=1)
+        cols = np.full((n, 3), 128.0, np.float32)
+        dirs = np.asarray(tsdf_ops.camera_dirs(
+            jnp.asarray(pts), jnp.asarray(self.CAM)))
+        return pts, cols, np.ones(n, bool), dirs
+
+    def _integrate(self, params, zs, repeats):
+        state = tsdf_ops.init_state(params)
+        origin = np.zeros(3, np.float32)
+        for z, reps in zip(zs, repeats):
+            p, c, v, d = self._plane_stop(z)
+            for _ in range(reps):
+                state, _ = tsdf_ops.integrate(
+                    state, params, p, c, v, d, origin, 1.0 / 64,
+                    use_pallas=False)
+        return state
+
+    def test_default_path_bit_identical(self):
+        """carve_steps=0 (explicit) and the bare default run the SAME
+        program and produce bitwise-equal state — the parity bar for an
+        off-by-default feature."""
+        a = self._integrate(TSDFParams(grid_depth=6, max_bricks=512),
+                            [0.5], [2])
+        b = self._integrate(
+            TSDFParams(grid_depth=6, max_bricks=512, carve_steps=0),
+            [0.5], [2])
+        for x, y in zip(a, b):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+    def test_carving_erases_stale_surface(self):
+        """A plane observed at z=0.5, then re-observed at z=0.2 (the
+        object moved): rays to the new surface pass through the old one.
+        With carving the stale weight INSIDE the viewing cone collapses;
+        without it the ghost persists."""
+        base = dict(grid_depth=6, max_bricks=512)
+        carved = self._integrate(
+            TSDFParams(**base, carve_steps=24, carve_weight=0.5),
+            [0.5, 0.2], [3, 6])
+        plain = self._integrate(TSDFParams(**base), [0.5, 0.2], [3, 6])
+
+        def cone_stale_weight(state, params):
+            _, w, _ = tsdf_ops.state_to_dense(state, params)
+            return float(w[26:38, 26:38, 30:35].sum())  # z≈0.5, in-cone
+
+        wc = cone_stale_weight(carved, TSDFParams(**base, carve_steps=24,
+                                                  carve_weight=0.5))
+        wp = cone_stale_weight(plain, TSDFParams(**base))
+        assert wp > 1000.0          # the ghost is real without carving
+        assert wc < 0.05 * wp, (wc, wp)
+        # The NEW surface (z≈0.2, voxel ≈ 12) survives carving: samples
+        # start one voxel past the truncation band.
+        _, w, _ = tsdf_ops.state_to_dense(
+            carved, TSDFParams(**base, carve_steps=24, carve_weight=0.5))
+        assert w[26:38, 26:38, 11:15].sum() > 1000.0
+
+    def test_carving_oracle_parity(self):
+        params = TSDFParams(grid_depth=6, max_bricks=512,
+                            carve_steps=24, carve_weight=0.5)
+        origin = np.zeros(3, np.float32)
+        state = tsdf_ops.init_state(params)
+        dense = None
+        for z in (0.5, 0.2):
+            p, c, v, d = self._plane_stop(z)
+            state, _ = tsdf_ops.integrate(state, params, p, c, v, d,
+                                          origin, 1.0 / 64,
+                                          use_pallas=False)
+            dense = integrate_oracle(dense, p, c, v, d, origin,
+                                     1.0 / 64, params)
+        td, wd, _ = tsdf_ops.state_to_dense(state, params)
+        assert np.abs(td - dense[0]).max() < 2e-5
+        assert np.abs(wd - dense[1]).max() < 2e-3

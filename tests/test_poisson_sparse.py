@@ -437,7 +437,10 @@ def test_sparse_warm_start_fewer_iterations(rng):
     fine-CG outer iteration count, and a mismatched grid must skip the
     warm start cleanly (cold solve, warm_start_blocks=0)."""
     pts, nrm = _sphere_cloud(rng, 8_000)
-    kw = dict(depth=9, cg_iters=200, max_blocks=16_384,
+    # coarse_depth pinned at 6: cheaper than the depth-9 default (7),
+    # and the SAME resolution as the dense preview below — a preview
+    # can only warm-start a coarse solve it actually resolves.
+    kw = dict(depth=9, cg_iters=200, coarse_depth=6, max_blocks=16_384,
               preconditioner="jacobi", with_stats=True)
     g1, nb1, cold = poisson_sparse.reconstruct_sparse(pts, nrm, **kw)
     assert cold["warm_start_blocks"] == 0
@@ -458,6 +461,28 @@ def test_sparse_warm_start_fewer_iterations(rng):
     # Garbage x0 types fail loudly, before the solve.
     with pytest.raises(TypeError):
         poisson_sparse.reconstruct_sparse(pts, nrm, x0=np.zeros(3), **kw)
+
+    # DENSE-preview warm start (the streaming-finalize bridge, ROADMAP
+    # leftover from PR 11): a dense PoissonGrid x0 warm-starts the
+    # INTERNAL COARSE solve (world-aligned trilinear resample), so
+    # coarse_iters_used drops measurably, warm_start_blocks counts the
+    # covered band blocks, and the fine band converges no worse than
+    # cold. Shares this test's cold solve — one extra solve, not a
+    # second cold/warm pair.
+    from structured_light_for_3d_model_replication_tpu.ops import poisson
+
+    preview = poisson.reconstruct(pts[::2], nrm[::2], depth=6,
+                                  cg_iters=120)
+    g4, _, dwarm = poisson_sparse.reconstruct_sparse(pts, nrm,
+                                                     x0=preview, **kw)
+    assert dwarm["warm_start_blocks"] > 0
+    assert dwarm["coarse_iters_used"] < cold["coarse_iters_used"], \
+        (cold, dwarm)
+    # The coarse fixed point is rtol-identical either way, so the fine
+    # band pays the same or fewer iterations — never more than a
+    # residual-wiggle worth.
+    assert dwarm["cg_iters_used"] <= cold["cg_iters_used"] + 2
+    assert np.isfinite(float(g4.iso))
 
 
 def test_unknown_preconditioner_rejected(rng):

@@ -405,7 +405,11 @@ def stream_service(serve_ring):
             fpfh_max_nn=24, normals_k=8, max_points=1024,
             posegraph_iterations=10, step_deg=12.0),
         method="posegraph", view_cap=1024, preview_points=1024,
-        preview_depth=4, final_depth=5, model_cap=8192, window=3)
+        preview_depth=4, final_depth=5, model_cap=8192, window=3,
+        # Tiny splat lane so representation="splat" sessions stay
+        # CPU-cheap (the render roundtrip tests below).
+        splat_cap=2048, splat_fit_iters=4, splat_fit_pixels=960,
+        splat_render_sizes=((96, 72),))
     cfg = ServeConfig(proj=PROJ, buckets=((H, W),), batch_sizes=(1, 2),
                       linger_ms=5.0, queue_depth=16, workers=1,
                       stream=sp, max_sessions=2)
@@ -499,6 +503,71 @@ def test_serve_session_tsdf_colored_mesh(stream_service, serve_ring):
     client.delete_session(sid)
 
 
+def test_serve_session_splat_render_roundtrip(stream_service, serve_ring):
+    """The rendered-result surface (docs/RENDERING.md): a
+    representation="splat" session serves novel-view PNGs live
+    (GET /session/<id>/render), exports its scene (GET …/splats) such
+    that `cli render` reproduces the SAME pixels offline, 409s before
+    the first stop, 400s bad angles / off-menu sizes / non-splat
+    sessions, and finalizes as result_format="render_png"."""
+    from structured_light_for_3d_model_replication_tpu.io.png import (
+        decode_png,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.client import (
+        ServeClientError,
+    )
+    from structured_light_for_3d_model_replication_tpu.splat import (
+        SplatScene,
+    )
+
+    _, client = stream_service
+    sid = client.create_session(representation="splat")
+    # 409 before the first fused stop (client maps it to None).
+    assert client.render(sid) is None
+    assert client.splats(sid) is None
+    for stack in serve_ring[:2]:
+        st = client.wait(client.submit_stop(sid, stack), timeout_s=120.0)
+        assert st["status"] == "done", st
+
+    out = client.render(sid, azim=45, elev=10)
+    assert out is not None
+    png, meta = out
+    img = decode_png(png)
+    assert img.shape == (72, 96, 3)
+    assert int(meta["render_splats"]) > 0
+
+    # Bad angles and off-menu sizes are client errors, not conflicts.
+    with pytest.raises(ServeClientError, match="400"):
+        client.render(sid, azim=9999.0)
+    with pytest.raises(ServeClientError, match="400"):
+        client.render(sid, size=(33, 44))
+    # 'nan' PARSES as a float — it must still 400, not drop the
+    # connection on the int() conversion.
+    import urllib.error
+    import urllib.request
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{client.base_url}/session/{sid}/render?w=nan&h=nan")
+    assert ei.value.code == 400
+
+    # Scene export → offline render parity (the cli render contract).
+    scene = SplatScene.from_bytes(client.splats(sid))
+    assert np.array_equal(scene.render(45, 10, 96, 72), img)
+
+    fin = client.finalize_session(sid, result_format="render_png")
+    assert fin["result"]["splats"] > 0
+    body = client.result(fin["job_id"])
+    assert body[:8] == b"\x89PNG\r\n\x1a\n"
+    client.delete_session(sid)
+
+    # A session without the splat lane answers 400, with a hint.
+    sid2 = client.create_session()
+    with pytest.raises(ServeClientError, match="400"):
+        client.render(sid2)
+    client.delete_session(sid2)
+
+
 def test_session_rejects_bad_representation(stream_service):
     from structured_light_for_3d_model_replication_tpu.serve.client import (
         ServeClientError,
@@ -572,6 +641,64 @@ def test_tsdf_streaming_previews(single_stop_session, synth_scan,
     assert r2.fused
     fin = sess.finalize(mesh=True)
     assert fin.mesh.vertex_colors is not None
+    assert len(fin.mesh.faces) > 0
+
+
+def test_splat_streaming_previewer(single_stop_session, synth_scan,
+                                   small_calib):
+    """representation="splat": the TSDF previewer lane plus rendered
+    novel views — frames observed per stop, lazy scene build, PNG out
+    (docs/RENDERING.md)."""
+    del single_stop_session   # ordering: share the decode programs
+    stack, _ = synth_scan
+    sp = dataclasses.replace(TINY_STREAM, representation="splat",
+                             tsdf_grid_depth=6, tsdf_max_bricks=1024,
+                             covis=False, splat_cap=2048,
+                             splat_fit_iters=3, splat_fit_pixels=960,
+                             splat_render_sizes=((96, 72),))
+    sess = IncrementalSession(small_calib, SMALL_PROJ.col_bits,
+                              SMALL_PROJ.row_bits, params=sp,
+                              scan_id="t-stream-splat")
+    r1 = sess.add_stop(stack)
+    assert r1.fused and r1.preview
+    assert len(sess.preview.faces) > 0      # mesh previews still work
+    mesher = sess._mesher
+    assert len(mesher._frames) == 1         # the stop's RGB was observed
+    assert mesher.intrinsics is not None
+    out = mesher.render_png(30.0, 20.0)
+    assert out is not None
+    png, meta = out
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    assert meta["splats"] > 0 and meta["width"] == 96
+    # Stale tracking: a new stop marks the scene for rebuild.
+    assert not mesher.scene_stale
+    sess.add_stop(stack + np.uint8(1))
+    assert mesher.scene_stale
+    # Finalize: the splat lane's mesh path is the colored TSDF extract.
+    fin = sess.finalize(mesh=True)
+    assert fin.mesh.vertex_colors is not None
+
+
+@pytest.mark.slow
+def test_sparse_finalize_warm_started_from_previews(turntable_stacks,
+                                                    small_calib):
+    """final_depth > 8 routes finalize through the band-sparse solver
+    with the last preview grid as x0 — FinalizeResult.stats reports the
+    warm start (the ROADMAP 'previews → final solve' item, measured at
+    the session level; the solver-level iteration assertions live in
+    test_poisson_sparse.py)."""
+    sp = dataclasses.replace(FAST_STREAM, final_depth=9,
+                             preview_depth=6)
+    sess = IncrementalSession(small_calib, SMALL_PROJ.col_bits,
+                              SMALL_PROJ.row_bits, params=sp,
+                              scan_id="t-stream-sparse-warm")
+    for k in range(4):
+        sess.add_stop(turntable_stacks[k])
+    fin = sess.finalize(mesh=True)
+    stats = fin.stats.get("final_solve")
+    assert stats is not None, fin.stats
+    assert stats["warm_start_blocks"] > 0
+    assert stats["coarse_iters_used"] > 0
     assert len(fin.mesh.faces) > 0
 
 

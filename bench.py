@@ -75,13 +75,17 @@ The remaining BASELINE configs are measured too and written to
    content cache's deterministic duplicate-hit ratio — emits the
    ``soak_scans_per_s`` and ``soak_recovery_s`` headline lines.
 10. fleet chaos (`serve/fleet.py` + `serve/router.py`): SL_BENCH_FLEET_S
-    (default 60 s) of offered load through a FleetRouter over 3 REAL
-    replica subprocesses (shared content cache, shared handoff volume);
-    a mid-run SIGKILL of the session's pinned replica measures
-    ``fleet_failover_s`` (kill → next session stop done via survivor
-    adoption) and a same-port ``--recover`` replacement proves acked
-    jobs survive — emits the ``fleet_scans_per_s`` and
-    ``fleet_failover_s`` headline lines.
+    (default 60 s) of offered load through a FleetRouter (proactive
+    failure detector armed) over 3 REAL replica subprocesses (shared
+    content cache, shared handoff volume); a mid-run SIGKILL of the
+    session's pinned replica measures ``fleet_proactive_repin_s``
+    (kill → the detector's BACKGROUND adoption complete) and
+    ``fleet_failover_s`` (the first client session op after failover —
+    adoption pre-completed, so the lazy-handoff rounds' next-op spike
+    is the baseline this drives down), and a same-port ``--recover``
+    replacement proves acked jobs survive — emits the
+    ``fleet_scans_per_s``, ``fleet_failover_s`` and
+    ``fleet_proactive_repin_s`` headline lines.
 11. TSDF streaming previews (`fusion/`): the config-8 24-stop session
     with ``representation="tsdf"`` — per-stop incremental volume
     integration + colored extraction instead of the coarse-Poisson
@@ -1752,18 +1756,27 @@ def main():
     # Config 10: fleet chaos — 3 REAL replica subprocesses (the shared
     # scripts/fleet_smoke.py spawn recipe: tiny rig, per-replica journal
     # volumes under one shared dir, shared handoff volume, peered
-    # content caches) behind an in-process FleetRouter, under offered
-    # load from 2 client threads with duplicates mixed in. Mid-run the
-    # session's pinned replica takes a REAL SIGKILL: the router re-pins
-    # the live session onto a survivor (adoption from the handoff
-    # stream) — `fleet_failover_s` is SIGKILL → next session stop DONE
-    # through the router — and a replacement process on the same port
-    # recovers the dead replica's acked jobs under their original ids.
-    # Asserts: no acked job/session lost, duplicate hits preserved
-    # across replicas, zero steady-state program-cache misses on
-    # survivors, journal-clean drain fleet-wide + empty handoff volume.
+    # content caches) behind an in-process FleetRouter with the
+    # PROACTIVE failure detector armed, under offered load from 2
+    # client threads with duplicates mixed in. Mid-run the session's
+    # pinned replica takes a REAL SIGKILL: the router's readyz-miss
+    # detector declares it dead and adopts the live session onto a
+    # survivor IN THE BACKGROUND — `fleet_proactive_repin_s` is
+    # SIGKILL → background adoption complete (detection hysteresis +
+    # handoff replay), and `fleet_failover_s` is the FIRST CLIENT OP
+    # after failover (next session stop submit → done): with the
+    # adoption pre-completed it no longer contains the handoff replay,
+    # which is exactly the latency-spike removal the proactive tier
+    # exists for (earlier rounds, where the next op paid the adoption
+    # inline, are the lazy baseline in the bench_compare trajectory).
+    # A replacement process on the same port then recovers the dead
+    # replica's acked jobs under their original ids.
+    # Asserts: proactive adoption fired BEFORE any client op needed it,
+    # no acked job/session lost, duplicate hits preserved across
+    # replicas, zero steady-state program-cache misses on survivors,
+    # journal-clean drain fleet-wide + empty handoff volume.
     # Duration: SL_BENCH_FLEET_S (default 60 s). Headline lines:
-    # fleet_scans_per_s, fleet_failover_s.
+    # fleet_scans_per_s, fleet_failover_s, fleet_proactive_repin_s.
     # ------------------------------------------------------------------
     def config10():
         import importlib.util
@@ -1867,6 +1880,7 @@ def main():
                     errors.append(f"submit: {e}")
                     return
                 deadline = time.monotonic() + 420.0
+                unknown_since = None
                 while True:
                     try:
                         st = client.wait(jid, timeout_s=20.0)
@@ -1882,6 +1896,28 @@ def main():
                             st = {"status": "done",
                                   "result": {"content_cache_hit": True}}
                             break
+                        if "unknown job" in str(e):
+                            # A job that went TERMINAL on the victim
+                            # right before the SIGKILL: its journaled
+                            # job_done makes recovery drop the id (the
+                            # PR-8 contract — the ARTIFACT lives in the
+                            # victim's disk content cache). Exercise
+                            # that contract instead of declaring work
+                            # lost: resubmit the same bytes — the
+                            # answer must come back (typically as a
+                            # recovered-cache hit).
+                            now = time.monotonic()
+                            if unknown_since is None:
+                                unknown_since = now
+                            elif now - unknown_since > 30.0:
+                                try:
+                                    jid = client.submit(stack_v)
+                                    unknown_since = None
+                                except Exception as re_e:
+                                    _log(f"[10] reissue of {jid} "
+                                         f"refused ({re_e}); retrying")
+                        else:
+                            unknown_since = None
                         # In flight on the killed replica until the
                         # fresh node recovers it — acked, keep polling.
                         if time.monotonic() > deadline:
@@ -1934,13 +1970,30 @@ def main():
         procs[victim_idx].kill()
         procs[victim_idx].wait(timeout=30.0)
         t_kill = time.monotonic()
+        # Proactive tier: the detector must re-pin the session in the
+        # BACKGROUND — no client op drives it. fleet_proactive_repin_s
+        # = SIGKILL → adoption complete (hysteresis + handoff replay).
+        repin_deadline = time.monotonic() + 120.0
+        while router.session_url(sid) == pin \
+                and time.monotonic() < repin_deadline:
+            time.sleep(0.05)
+        proactive_repin_s = time.monotonic() - t_kill
+        assert router.session_url(sid) != pin, \
+            "proactive re-pin never fired (detector dead?)"
+        repins_before_op = int(router.stats()["proactive_repins"])
+        assert repins_before_op >= 1, router.stats()
+        # First client op AFTER failover: with the adoption already
+        # done, this is an ordinary stop — the next-op latency spike
+        # of the lazy-handoff rounds is gone from it.
+        t_op = time.monotonic()
         st = client.wait(client.submit_stop(sid, ring[2]),
                          timeout_s=300.0)
         assert st["status"] == "done", st
-        failover_s = time.monotonic() - t_kill
+        failover_s = time.monotonic() - t_op
         assert router.session_url(sid) != pin
-        _log(f"[10] SIGKILLed pinned replica r{victim_idx}; session "
-             f"re-pinned in {failover_s:.2f}s")
+        _log(f"[10] SIGKILLed pinned replica r{victim_idx}; proactive "
+             f"re-pin in {proactive_repin_s:.2f}s, first post-failover "
+             f"stop in {failover_s:.2f}s")
 
         # Fresh node on the same port recovers the acked burst.
         repl, _, _ = fleet_smoke.spawn_replica(
@@ -1998,6 +2051,11 @@ def main():
             "value": round(failover_s, 3), "unit": "s",
             "vs_baseline": None,
         }), flush=True)
+        print(json.dumps({
+            "metric": "fleet_proactive_repin_s",
+            "value": round(proactive_repin_s, 3), "unit": "s",
+            "vs_baseline": None,
+        }), flush=True)
         details["serve_fleet_chaos"] = {
             "replicas": 3,
             "load_s": round(load_wall, 1),
@@ -2009,17 +2067,21 @@ def main():
             "dup_hit_ratio": (round(dup_ratio, 3)
                               if dup_ratio is not None else None),
             "failover_s": round(failover_s, 3),
+            "proactive_repin_s": round(proactive_repin_s, 3),
+            "proactive_repins_before_first_op": repins_before_op,
             "recovered_burst_jobs": recovered,
             "burst_finished_pre_kill": gone,
             "survivor_program_cache_misses_delta": {
                 f"r{i}": misses_end[i] - misses0[i]
                 for i in survivor_idxs},
             "router": router.stats(),
+            "signals": router.signals(),
         }
         _log(f"[10] fleet: {counters['done']} jobs in {load_wall:.0f}s "
-             f"({scans_per_s:.2f}/s), failover {failover_s:.2f}s, "
-             f"{counters['hits']} duplicate hits, {recovered} burst "
-             f"job(s) recovered")
+             f"({scans_per_s:.2f}/s), proactive re-pin "
+             f"{proactive_repin_s:.2f}s, post-failover stop "
+             f"{failover_s:.2f}s, {counters['hits']} duplicate hits, "
+             f"{recovered} burst job(s) recovered")
         flush_details()
         # The fleet acceptance bars, asserted:
         for i in survivor_idxs:

@@ -47,15 +47,23 @@ def higher_is_better(metric: str) -> bool:
     """Most headline metrics are seconds (lower wins); throughput lines
     (config [9]'s ``soak_scans_per_s``, config [10]'s
     ``fleet_scans_per_s``, and the suffixed device-sweep family like
-    config [7b]'s ``serve_scans_per_s_8dev``) and QUALITY lines
-    (config [12]'s ``render_psnr_db`` — decibels of rendered fidelity)
-    invert — going UP is the improvement, going down the regression.
-    Latency-shaped fleet lines (``fleet_failover_s``), config [11]'s
-    per-stop preview latency (``tsdf_preview_s``) and config [12]'s
-    per-view render latency (``render_view_s``) keep the lower-wins
-    default."""
+    config [7b]'s ``serve_scans_per_s_8dev``), QUALITY lines
+    (config [12]'s ``render_psnr_db`` — decibels of rendered fidelity),
+    hit-rate-shaped ``*_ratio`` lines (e.g. a fleet duplicate-hit
+    ratio) and capacity-shaped ``*_replicas`` lines (the
+    /fleet/signals family — more ready replicas is healthier) invert —
+    going UP is the improvement, going down the regression.
+    Latency-shaped fleet lines (``fleet_failover_s`` and the proactive
+    tier's ``fleet_proactive_repin_s`` — background adoption must get
+    FASTER), config [11]'s per-stop preview latency
+    (``tsdf_preview_s``), config [12]'s per-view render latency
+    (``render_view_s``), and count-shaped tenant/overload lines
+    (``*_rejected_total``, ``*_shed_total`` — shed work going up is a
+    regression) keep the lower-wins default."""
     return (metric.endswith("_per_s") or "_per_s_" in metric
-            or metric.endswith("_psnr_db"))
+            or metric.endswith("_psnr_db")
+            or metric.endswith("_ratio")
+            or metric.endswith("_replicas"))
 
 
 def _headline_metrics(text: str) -> dict[str, float]:

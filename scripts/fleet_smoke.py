@@ -1,26 +1,35 @@
 #!/usr/bin/env python
-"""CI fleet smoke: 2 real replica processes + a real router process,
-kill one replica mid-session, assert the session hands off.
+"""CI fleet smoke: 2 replicas + 2 HA routers over a FAKE OBJECT STORE
+with seeded blob-store faults, SIGKILL one router AND the pinned
+replica, assert availability end to end.
 
 The tier-1-safe end of the fleet chaos spectrum (the 3-replica chaos
 gate with offered load, peer-network faults and fresh-node recovery is
 ``tests/test_fleet.py::test_fleet_chaos_gate``; the measured version is
 bench config [10]):
 
-1. spawn replicas r0/r1 (`cli serve` on the soak-smoke tiny rig, each
-   with its own ``--store-dir`` under one shared volume plus the shared
-   ``--handoff-dir``, peered at each other) and a `cli serve --router`
-   process fronting both;
-2. via the ROUTER: one-shot job completes; a duplicate submit hits the
-   content cache (consistent-hash placement makes it a local hit); a
-   duplicate pushed directly at the OTHER replica comes back as a PEER
-   hit (the shared-cache path);
-3. open a session via the router, fuse stop 1, then **SIGKILL the
-   pinned replica**. The next stop through the router must succeed —
-   the router re-pins the session onto the survivor, which adopts it
-   from the handoff stream — and finalize must return a mesh;
-4. SIGTERM survivor + router: clean exits, the survivor's journal
-   volume drains clean, and the handoff dir holds no session streams.
+1. start an in-process :class:`serve.blobstore.ObjectStoreServer` —
+   the replicas' ``--handoff-dir`` and the routers' ``--pin-store``
+   both point at it over HTTP, so NOTHING in the fleet shares a POSIX
+   volume — and arm ``SL_BLOB_FAULTS`` (latency + torn writes) in the
+   replica processes: store faults must degrade durability counters,
+   never availability;
+2. spawn replicas r0/r1 (`cli serve` on the soak-smoke tiny rig, each
+   with its own local ``--store-dir``, peered at each other) and TWO
+   `cli serve --router` processes peered at each other, sharing the
+   pin board through the object store;
+3. via router A: one-shot job completes; a duplicate hits the content
+   cache; a duplicate pushed directly at the OTHER replica comes back
+   as a PEER hit (the shared-cache path);
+4. open a session via router A, fuse stop 1, then **SIGKILL router A
+   and the pinned replica**. The client rotates to router B, which
+   re-learns the pin from the shared board and whose failure detector
+   proactively adopts the session onto the survivor — the next stop
+   and finalize must succeed, and every job acked anywhere must reach
+   ``done`` (zero lost acked jobs);
+5. SIGTERM survivor + router B: clean exits, the survivor's journal
+   volume drains clean, and the object store holds no live session
+   streams.
 
 This module is also the SHARED SPAWN RECIPE for the fleet gates:
 ``spawn_fleet`` / ``spawn_router`` are imported by tests/test_fleet.py
@@ -81,15 +90,18 @@ def handoff_dir(shared_dir: str) -> str:
 
 def spawn_replica(shared_dir: str, idx: int, ports: list[int],
                   recover: bool = False, sanitize: bool = True,
-                  env_extra: dict | None = None):
+                  env_extra: dict | None = None,
+                  handoff: str | None = None):
     """One fleet replica on its pre-picked port: own journal volume
-    under the shared dir, the shared handoff volume, peered at every
-    other port. Returns (proc, port, stderr_lines)."""
+    under the shared dir, the shared handoff store (a directory under
+    the shared dir by default, or any blob-store spec — e.g. the fake
+    object service's ``http://...``), peered at every other port.
+    Returns (proc, port, stderr_lines)."""
     peers = ",".join(f"http://127.0.0.1:{p}"
                      for i, p in enumerate(ports) if i != idx)
     extra = ["--port", str(ports[idx]),
              "--replica-id", f"r{idx}",
-             "--handoff-dir", handoff_dir(shared_dir)]
+             "--handoff-dir", handoff or handoff_dir(shared_dir)]
     if peers:
         extra += ["--peers", peers]
     return soak_smoke.spawn_serve(
@@ -98,14 +110,23 @@ def spawn_replica(shared_dir: str, idx: int, ports: list[int],
 
 
 def spawn_router(ports: list[int], sanitize: bool = True,
-                 timeout_s: float = 60.0):
-    """The thin front (`cli serve --router`) over the replica ports;
-    returns (proc, router_port, stderr_lines)."""
+                 timeout_s: float = 60.0, port: int = 0,
+                 router_id: str | None = None, peers=(),
+                 pin_store: str | None = None):
+    """One thin front (`cli serve --router`) over the replica ports;
+    returns (proc, router_port, stderr_lines). ``peers``/``pin_store``
+    arm the HA topology (dual routers sharing the pin board)."""
     replicas = ",".join(f"http://127.0.0.1:{p}" for p in ports)
     cmd = [sys.executable, "-m",
            "structured_light_for_3d_model_replication_tpu.cli", "serve",
-           "--router", "--replicas", replicas, "--port", "0",
+           "--router", "--replicas", replicas, "--port", str(port),
            "--check-interval", "0.25"]
+    if router_id:
+        cmd += ["--router-id", router_id]
+    if peers:
+        cmd += ["--router-peers", ",".join(peers)]
+    if pin_store:
+        cmd += ["--pin-store", pin_store]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     if sanitize:
         env.setdefault("SL_SANITIZE", "1")
@@ -133,13 +154,15 @@ def spawn_router(ports: list[int], sanitize: bool = True,
 
 
 def spawn_fleet(shared_dir: str, n: int = 2, sanitize: bool = True,
-                env_extra: dict | None = None):
+                env_extra: dict | None = None,
+                handoff: str | None = None):
     """n replicas + ports; returns ([(proc, port, lines)], ports)."""
     ports = free_ports(n)
     out = []
     for i in range(n):
         out.append(spawn_replica(shared_dir, i, ports,
-                                 sanitize=sanitize, env_extra=env_extra))
+                                 sanitize=sanitize, env_extra=env_extra,
+                                 handoff=handoff))
     return out, ports
 
 
@@ -154,12 +177,20 @@ def _fail(msg, procs=(), stderr_lines=None):
     sys.exit(1)
 
 
+#: Seeded blob-store faults armed in the REPLICA processes (latency +
+#: torn writes on the shared object store; no hard error rate — torn
+#: heads are retried by the verify-then-kill loop below, hard errors
+#: would only re-test the same containment nondeterministically).
+BLOB_FAULTS = {"seed": 11, "latency_s": 0.03, "latency_rate": 0.25,
+               "torn_write_rate": 0.05}
+
+
 def main() -> int:
     t0 = time.monotonic()
     sys.path.insert(0, REPO)
     import tempfile
 
-    import numpy as np
+    import numpy as np  # noqa: F401  (spawn recipe parity)
 
     from structured_light_for_3d_model_replication_tpu.config import (
         ProjectorConfig,
@@ -170,8 +201,11 @@ def main() -> int:
     from structured_light_for_3d_model_replication_tpu.serve import (
         read_live_state,
     )
+    from structured_light_for_3d_model_replication_tpu.serve.blobstore \
+        import ObjectStoreServer
     from structured_light_for_3d_model_replication_tpu.serve.client import (
         ServeClient,
+        ServeClientError,
     )
     from structured_light_for_3d_model_replication_tpu.serve.store import (
         SessionStreamStore,
@@ -190,28 +224,50 @@ def main() -> int:
         proj_K=cam[1], R=cam[2], T=cam[3], cam_height=CAM_H,
         cam_width=CAM_W, proj=proj)]
 
+    # The fake object store: handoff streams AND the router pin board
+    # live here over HTTP — no process in the fleet shares a POSIX
+    # volume. Replica processes see it through a FaultyBlobStore.
+    ostore = ObjectStoreServer().start()
+    handoff_spec = f"{ostore.url}/handoff"
+    pin_spec = f"{ostore.url}/pins"
     shared = tempfile.mkdtemp(prefix="sl-fleet-smoke-")
     try:
-        members, ports = spawn_fleet(shared, n=2)
+        members, ports = spawn_fleet(
+            shared, n=2, handoff=handoff_spec,
+            env_extra={"SL_BLOB_FAULTS": json.dumps(BLOB_FAULTS)})
     except soak_smoke.SpawnError as e:
         _fail(str(e))
     procs = [m[0] for m in members]
     all_lines = [ln for m in members for ln in m[2]]
-    try:
-        rproc, rport, rlines = spawn_router(ports)
-    except soak_smoke.SpawnError as e:
-        _fail(str(e), procs)
-    procs.append(rproc)
-    client = ServeClient(f"http://127.0.0.1:{rport}", timeout_s=120.0)
-    print(f"fleet up: replicas :{ports[0]}/:{ports[1]}, router :{rport} "
+    rports = free_ports(2)
+    rurls = [f"http://127.0.0.1:{p}" for p in rports]
+    routers = []
+    for i in range(2):
+        try:
+            routers.append(spawn_router(
+                ports, port=rports[i], router_id=f"router-{'ab'[i]}",
+                peers=[rurls[1 - i]], pin_store=pin_spec))
+        except soak_smoke.SpawnError as e:
+            _fail(str(e), procs + [r[0] for r in routers])
+    procs += [r[0] for r in routers]
+    client_a = ServeClient(rurls[0], timeout_s=120.0)
+    # The chaos client knows BOTH routers: when A dies it rotates to B.
+    client = ServeClient(rurls, timeout_s=120.0, retries=8,
+                         retry_backoff_s=0.25, retry_budget_s=120.0)
+    acked: list[str] = []    # every job id a 200 was returned for
+    print(f"fleet up: replicas :{ports[0]}/:{ports[1]}, routers "
+          f":{rports[0]}/:{rports[1]}, object store :{ostore.port} "
           f"({time.monotonic() - t0:.0f}s)")
 
-    # One-shot via the router + local duplicate via consistent hashing.
-    jid = client.submit(stack)
-    st = client.wait(jid, timeout_s=240.0)
+    # One-shot via router A + local duplicate via consistent hashing.
+    jid = client_a.submit(stack)
+    acked.append(jid)
+    st = client_a.wait(jid, timeout_s=240.0)
     if st["status"] != "done":
         _fail(f"routed job failed: {st}", procs, all_lines)
-    st2 = client.wait(client.submit(stack), timeout_s=60.0)
+    jid2 = client_a.submit(stack)
+    acked.append(jid2)
+    st2 = client_a.wait(jid2, timeout_s=60.0)
     if not st2["result"].get("content_cache_hit"):
         _fail(f"routed duplicate missed the cache: {st2}", procs,
               all_lines)
@@ -220,7 +276,8 @@ def main() -> int:
     peer_hit = False
     for p in ports:
         direct = ServeClient(f"http://127.0.0.1:{p}", timeout_s=120.0)
-        std = direct.wait(direct.submit(stack), timeout_s=120.0)
+        djid = direct.submit(stack)
+        std = direct.wait(djid, timeout_s=120.0)
         if std["status"] != "done":
             _fail(f"direct duplicate failed: {std}", procs, all_lines)
         if std["result"].get("cache_source") == "peer":
@@ -231,27 +288,80 @@ def main() -> int:
     print(f"cache: routed dup hit + cross-replica peer hit "
           f"({time.monotonic() - t0:.0f}s)")
 
-    # Session through the router; kill the pinned replica mid-session.
-    sid = client.create_session()
-    stj = client.wait(client.submit_stop(sid, ring[0]), timeout_s=240.0)
-    if stj["status"] != "done":
-        _fail(f"stop 1 failed: {stj}", procs, all_lines)
+    # Session through router A. Torn-write faults can maim the mirrored
+    # stream head (durability degraded, loudly) — verify the stream is
+    # adoptable on the object store BEFORE staging the kill, retrying
+    # with a fresh session if not (the availability contract is about
+    # serving, not about any single faulted write).
+    handoff_reader = SessionStreamStore(handoff_spec)
+    sid = None
+    for attempt in range(6):
+        cand = client_a.create_session()
+        stj = client_a.wait(client_a.submit_stop(cand, ring[0]),
+                            timeout_s=240.0)
+        info = handoff_reader.read_session(cand)
+        blob_ok = False
+        if info is not None and info.stops:
+            try:    # a torn stop blob would only degrade the adoption
+                handoff_reader.load_blob(info.stops[0][1])
+                blob_ok = True
+            except Exception:
+                blob_ok = False
+        if stj["status"] == "done" and blob_ok:
+            sid = cand
+            break
+        print(f"session {cand} stream not adoptable (attempt "
+              f"{attempt + 1}: faulted mirror) — retrying")
+        try:
+            client_a.delete_session(cand)
+        except ServeClientError:
+            pass
+    if sid is None:
+        _fail("no adoptable session stream after 6 attempts", procs,
+              all_lines)
     import urllib.request
 
-    with urllib.request.urlopen(f"http://127.0.0.1:{rport}/fleet",
-                                timeout=10) as r:
+    with urllib.request.urlopen(f"{rurls[0]}/fleet", timeout=10) as r:
         fleet = json.loads(r.read())
     pin = fleet["sessions_pinned"].get(sid)
     if pin is None:
         _fail(f"session not pinned: {fleet}", procs, all_lines)
     victim_idx = ports.index(int(pin.rsplit(":", 1)[1]))
     survivor_idx = 1 - victim_idx
+
+    # SIGKILL router A AND the pinned replica: the client must rotate
+    # to router B, which re-learns the pin from the shared board and
+    # proactively adopts the session onto the survivor.
+    routers[0][0].kill()
+    routers[0][0].wait(timeout=30.0)
     members[victim_idx][0].kill()                 # SIGKILL, no drain
     members[victim_idx][0].wait(timeout=30.0)
-    print(f"killed pinned replica r{victim_idx} "
+    print(f"SIGKILLed router A and pinned replica r{victim_idx} "
           f"({time.monotonic() - t0:.0f}s)")
 
-    stj2 = client.wait(client.submit_stop(sid, ring[1]), timeout_s=240.0)
+    # The surviving router's failure detector must adopt the session
+    # in the BACKGROUND — no client op drives it (the proactive tier).
+    deadline = time.monotonic() + 60.0
+    repinned = False
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"{rurls[1]}/fleet",
+                                        timeout=10) as r:
+                fb = json.loads(r.read())
+            if fb["sessions_pinned"].get(sid) not in (None, pin):
+                repinned = True
+                break
+        except OSError:
+            pass
+        time.sleep(0.25)
+    if not repinned:
+        _fail("router B never proactively re-pinned the session",
+              procs, all_lines)
+    print(f"proactive: router B adopted the session in the background "
+          f"({time.monotonic() - t0:.0f}s)")
+
+    stj2 = client.wait(client.submit_stop(sid, ring[1]),
+                       timeout_s=240.0)
     if stj2["status"] != "done":
         _fail(f"post-kill stop failed (no handoff?): {stj2}", procs,
               all_lines)
@@ -259,30 +369,50 @@ def main() -> int:
     if sst.get("stops_fused") != 2:
         _fail(f"session lost stops across handoff: {sst}", procs,
               all_lines)
+    # Fresh one-shot load through router B must flow (and every job
+    # acked post-kill completes: zero lost acked jobs).
+    for i in range(2):
+        v = stack.copy()
+        v[0, 0, 0] = 200 + i
+        njid = client.submit(v)
+        acked.append(njid)
+        nst = client.wait(njid, timeout_s=240.0)
+        if nst["status"] != "done":
+            _fail(f"post-kill job {njid} not done: {nst}", procs,
+                  all_lines)
     fin = client.finalize_session(sid, result_format="ply")
+    acked.append(fin["job_id"])
     if not client.result(fin["job_id"]).startswith(b"ply"):
         _fail("finalize artifact not a PLY", procs, all_lines)
+    with urllib.request.urlopen(f"{rurls[1]}/fleet", timeout=10) as r:
+        fleet_b = json.loads(r.read())
     print(f"handoff: session re-pinned + finalized on survivor "
-          f"r{survivor_idx} ({time.monotonic() - t0:.0f}s)")
+          f"r{survivor_idx} via router B (proactive_repins="
+          f"{fleet_b.get('proactive_repins')}, {len(acked)} acked "
+          f"jobs all done) ({time.monotonic() - t0:.0f}s)")
 
-    # Clean exits: survivor drains clean, router stops, handoff empty.
-    for proc in (members[survivor_idx][0], rproc):
+    # Clean exits: survivor drains clean, router B stops, no live
+    # session streams left on the object store.
+    for proc in (members[survivor_idx][0], routers[1][0]):
         proc.send_signal(signal.SIGTERM)
     rcs = [members[survivor_idx][0].wait(timeout=120.0),
-           rproc.wait(timeout=60.0)]
+           routers[1][0].wait(timeout=60.0)]
     if any(rc != 0 for rc in rcs):
         _fail(f"non-zero exits: {rcs}", procs, all_lines)
     state = read_live_state(replica_store(shared, survivor_idx))
     if state.jobs or state.sessions:
         _fail(f"survivor journal not clean: {len(state.jobs)} jobs, "
               f"{len(state.sessions)} sessions", procs, all_lines)
-    streams = SessionStreamStore(handoff_dir(shared)).list_sessions()
+    streams = handoff_reader.list_sessions()
     if streams:
         _fail(f"handoff streams left behind: {streams}", procs,
               all_lines)
+    ostore.stop()
     print(f"FLEET SMOKE PASS in {time.monotonic() - t0:.0f}s "
-          "(router + 2 replicas, SIGKILL pinned mid-session, handoff "
-          "to survivor, clean drains, empty handoff volume)")
+          "(2 routers + 2 replicas over the fake object store with "
+          "blob faults, SIGKILL router A + pinned replica, handoff to "
+          "survivor via router B, zero lost acked jobs, clean drains, "
+          "no live streams)")
     return 0
 
 

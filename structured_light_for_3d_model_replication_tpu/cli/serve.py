@@ -136,20 +136,51 @@ def build_parser() -> argparse.ArgumentParser:
                         "content-cache miss consults their "
                         "GET /cache/<key> before computing")
     p.add_argument("--handoff-dir", default=None,
-                   help="shared session-handoff volume (requires "
+                   help="shared session-handoff store (requires "
                         "--store-dir): session ops stream there so a "
                         "survivor replica can adopt this replica's "
-                        "live sessions after a crash")
+                        "live sessions after a crash. A local "
+                        "directory, or an object-store spec "
+                        "http://host:port[/prefix] — replicas then "
+                        "share no filesystem (docs/SERVING.md § fleet)")
+    p.add_argument("--tenant-rate", type=float,
+                   default=d.tenant_rate_per_s,
+                   help="per-tenant admission quota: sustained "
+                        "admissions/s per X-Tenant (0 = off); refusals "
+                        "are retryable 429s with per-tenant "
+                        "serve_tenant_* metrics")
+    p.add_argument("--tenant-burst", type=int, default=d.tenant_burst,
+                   help="per-tenant token-bucket burst headroom")
     p.add_argument("--router", action="store_true",
                    help="run the thin fleet FRONT ROUTER instead of a "
                         "replica: consistent-hash admission, sticky "
                         "sessions with handoff, /readyz-driven "
-                        "failover (requires --replicas)")
+                        "failover + proactive re-pin (requires "
+                        "--replicas)")
     p.add_argument("--replicas", default=None,
                    help="comma-separated replica base URLs the router "
                         "fronts (--router mode only)")
     p.add_argument("--check-interval", type=float, default=1.0,
                    help="router /readyz health-sweep period in seconds")
+    p.add_argument("--router-id", default=None,
+                   help="stable router identity (pin-board records, "
+                        "detector-primary election); default: random "
+                        "per process")
+    p.add_argument("--router-peers", default=None,
+                   help="comma-separated PEER ROUTER base URLs: peers "
+                        "are health-probed, share the pin board, and "
+                        "elect one detector primary (docs/SERVING.md "
+                        "§ fleet, dual-router topology)")
+    p.add_argument("--pin-store", default=None,
+                   help="shared pin-board store for router HA: a local "
+                        "directory or object-store spec "
+                        "http://host:port[/prefix]; session pins are "
+                        "generation-stamped last-writer-wins records "
+                        "every peered router converges on")
+    p.add_argument("--no-proactive-repin", action="store_true",
+                   help="disable the failure detector's background "
+                        "session adoption (failover falls back to the "
+                        "lazy next-op re-pin)")
     return p
 
 
@@ -169,9 +200,15 @@ def _run_router(args) -> int:
         print("error: --router requires --replicas url1,url2,...",
               file=sys.stderr)
         return 2
+    peers = [u.strip() for u in (args.router_peers or "").split(",")
+             if u.strip()]
     router = FleetRouter(replicas,
                          check_interval_s=args.check_interval,
-                         transport=transport_from_env())
+                         transport=transport_from_env(),
+                         router_id=args.router_id,
+                         router_peers=peers,
+                         pin_store=args.pin_store,
+                         proactive_repin=not args.no_proactive_repin)
     http = RouterHTTPServer(router, host=args.host,
                             port=args.port).start()
     # Machine-parseable readiness line (fleet smoke greps it).
@@ -280,6 +317,8 @@ def main(argv=None) -> int:
         store_dir=args.store_dir,
         content_cache=not args.no_content_cache,
         stream=stream,
+        tenant_rate_per_s=args.tenant_rate,
+        tenant_burst=args.tenant_burst,
         replica_id=args.replica_id,
         peers=tuple(u.strip() for u in (args.peers or "").split(",")
                     if u.strip()),

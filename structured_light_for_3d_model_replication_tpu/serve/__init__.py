@@ -43,6 +43,17 @@ architecture and tuning guide.
 """
 
 from .batcher import Batch, BucketBatcher, BucketKey, bucket_for
+from .blobstore import (
+    BlobFaultPlan,
+    BlobStore,
+    FaultyBlobStore,
+    HTTPObjectClient,
+    InMemoryObjectClient,
+    LocalDirStore,
+    ObjectStore,
+    ObjectStoreServer,
+    open_blob_store,
+)
 from .cache import ContentCache, ProgramCache, ProgramKey, content_key
 from .client import ServeClient, TransportError
 from .fleet import (
@@ -64,21 +75,34 @@ from .jobs import (
     StackFormatError,
 )
 from .lanes import DeviceLane, DeviceLanePool
-from .router import FleetRouter, RouterHTTPServer
+from .router import FleetRouter, PinBoard, RouterHTTPServer
 from .service import ReconstructionService, ServeConfig, ServeHTTPServer
 from .sessions import SessionLimitError, SessionManager, UnknownSessionError
 from .store import JournalStore, RecoveredState, SessionStreamStore, \
     read_live_state
+from .tenants import TenantQuotaError, TenantQuotas
 from .worker import DeviceWorker
 
 __all__ = [
     "AdmissionQueue",
     "Batch",
+    "BlobFaultPlan",
+    "BlobStore",
     "BreakerOpenError",
     "BucketBatcher",
     "BucketKey",
     "CircuitBreaker",
     "ContentCache",
+    "FaultyBlobStore",
+    "HTTPObjectClient",
+    "InMemoryObjectClient",
+    "LocalDirStore",
+    "ObjectStore",
+    "ObjectStoreServer",
+    "PinBoard",
+    "TenantQuotaError",
+    "TenantQuotas",
+    "open_blob_store",
     "DeviceLane",
     "DeviceLanePool",
     "DeviceWorker",

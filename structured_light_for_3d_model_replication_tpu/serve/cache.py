@@ -38,6 +38,7 @@ from collections import OrderedDict
 from ..utils import trace
 from ..utils.log import get_logger
 from .batcher import BucketKey
+from .blobstore import BlobStore, open_blob_store
 
 log = get_logger(__name__)
 
@@ -333,15 +334,32 @@ class ContentCache:
     read the payload back lazily. Without a directory it is memory-only
     with the same budget.
 
+    Persistence rides the :class:`~.blobstore.BlobStore` seam: ``dir``
+    opens the historical local layout (byte-for-byte identical), or
+    pass ``store=`` an :class:`~.blobstore.ObjectStore` to persist
+    artifacts in an S3-style service instead of a POSIX volume. Either
+    way every store failure is absorbed here (quarantine + miss) —
+    corruption and outages degrade the cache, never admission.
+
     Failed jobs are never cached (their taxonomy payload is the honest
     answer), and session stops never consult it (a duplicate stop is the
     covisibility gate's decision, not the cache's).
     """
 
     def __init__(self, max_bytes: int = 256 << 20, dir: str | None = None,
-                 registry: "trace.MetricsRegistry | None" = None):
+                 registry: "trace.MetricsRegistry | None" = None,
+                 store: BlobStore | None = None):
         self.max_bytes = int(max_bytes)
         self.dir = dir
+        # allow_faults=False: SL_BLOB_FAULTS targets the SHARED fleet
+        # stores (handoff streams, pin board); silently injecting env
+        # faults into every replica's private artifact cache would skew
+        # the duplicate-hit ratios the fleet gates assert on. Chaos
+        # coverage for this class passes a FaultyBlobStore explicitly.
+        self._blob: BlobStore | None = (
+            store if store is not None
+            else (open_blob_store(dir, allow_faults=False)
+                  if dir is not None else None))
         self.registry = registry if registry is not None else trace.REGISTRY
         self._lock = threading.Lock()
         # key -> {"bytes": int, "format": str, "meta": dict,
@@ -362,29 +380,43 @@ class ContentCache:
             "corrupt/truncated disk blobs quarantined at load or hit")
         self._bytes_gauge = self.registry.gauge(
             "serve_content_cache_bytes", "retained artifact bytes")
-        if dir is not None:
-            os.makedirs(dir, exist_ok=True)
-            os.makedirs(os.path.join(dir, "quarantine"), exist_ok=True)
+        # Cached quarantine-object count: stats() rides every /healthz
+        # scrape (and the router's per-second signal sweep), so it must
+        # never pay a store listing — seeded once at open, bumped per
+        # quarantine move.
+        self._quarantined_objects = 0
+        if self._blob is not None:
+            if dir is not None:
+                os.makedirs(os.path.join(dir, "quarantine"),
+                            exist_ok=True)
+            try:
+                self._quarantined_objects = sum(
+                    1 for k in self._blob.list("quarantine/")
+                    if k.endswith(".bin"))
+            except OSError:
+                pass
             self._load_index()
 
     # ------------------------------------------------------------------
 
-    def _payload_path(self, key: str) -> str:
-        return os.path.join(self.dir, f"{key}.bin")
+    def _payload_key(self, key: str) -> str:
+        return f"{key}.bin"
 
     def _quarantine(self, key: str, reason: str) -> None:
-        """Move a corrupt entry's files aside (never delete evidence —
-        the quarantine dir is what a post-mortem inspects) and count it.
-        The entry is already out of the index when this runs; a
+        """Move a corrupt entry's objects aside (never delete evidence —
+        the quarantine prefix is what a post-mortem inspects) and count
+        it. The entry is already out of the index when this runs; a
         quarantined key simply misses, it NEVER raises into admission."""
         self._corrupt.inc()
         log.warning("content cache entry %s quarantined: %s",
                     key[:12], reason)
-        qdir = os.path.join(self.dir, "quarantine")
         for suffix in (".bin", ".json"):
-            src = os.path.join(self.dir, f"{key}{suffix}")
             try:
-                os.replace(src, os.path.join(qdir, f"{key}{suffix}"))
+                self._blob.rename(f"{key}{suffix}",
+                                  f"quarantine/{key}{suffix}")
+                if suffix == ".bin":
+                    with self._lock:
+                        self._quarantined_objects += 1
             except OSError:
                 log.debug("quarantine move of %s%s failed", key[:12],
                           suffix)
@@ -393,19 +425,28 @@ class ContentCache:
         """Rebuild the index from sidecars, oldest first (so LRU order
         approximates the previous process's write order)."""
         sidecars = []
-        for fname in os.listdir(self.dir):
-            if not fname.endswith(".json"):
+        try:
+            names = self._blob.list("")
+        except OSError as e:
+            log.warning("content cache index unreadable: %s", e)
+            names = []
+        for fname in names:
+            if "/" in fname or not fname.endswith(".json"):
                 continue
-            path = os.path.join(self.dir, fname)
             try:
-                with open(path, encoding="utf-8") as f:
-                    doc = json.load(f)
-            except (OSError, ValueError):
+                raw = self._blob.get(fname)
+                doc = json.loads(raw.decode()) if raw is not None \
+                    else None
+            except (OSError, ValueError, UnicodeDecodeError):
+                continue
+            if doc is None:
                 continue
             key = fname[:-5]
             try:
-                size = os.path.getsize(self._payload_path(key))
+                size = self._blob.size(self._payload_key(key))
             except OSError:
+                size = None
+            if size is None:
                 continue  # no payload: sidecar-only orphan
             if size != int(doc.get("bytes", -1)):
                 # Truncated/grown blob (torn write, disk fault): a miss
@@ -432,14 +473,14 @@ class ContentCache:
             self._evictions.inc()
             for suffix in (".bin", ".json"):
                 try:
-                    os.remove(os.path.join(self.dir, f"{victim}{suffix}"))
+                    self._blob.delete(f"{victim}{suffix}")
                 except OSError:
                     pass
         self._bytes_gauge.set(self._held)
         if self._index:
             log.info("content cache: %d artifacts (%d MB) recovered "
                      "from %s", len(self._index), self._held >> 20,
-                     self.dir)
+                     self.dir or self._blob.stats())
 
     # ------------------------------------------------------------------
 
@@ -472,11 +513,15 @@ class ContentCache:
             return None
         if payload is None:
             try:
-                with open(self._payload_path(key), "rb") as f:
-                    payload = f.read()
+                payload = self._blob.get(self._payload_key(key))
             except OSError as e:
+                payload = None
+                reason = f"unreadable ({e})"
+            else:
+                reason = "payload object missing"
+            if payload is None:
                 self._drop(key)
-                self._quarantine(key, f"unreadable ({e})")
+                self._quarantine(key, reason)
                 if count:
                     self._misses.inc()
                 return None
@@ -512,24 +557,18 @@ class ContentCache:
         if len(payload) > self.max_bytes:
             return  # one artifact over the whole budget: not cacheable
         stored: bytes | None = payload
-        # Digest only for disk-backed caches: memory-held payloads are
+        # Digest only for store-backed caches: memory-held payloads are
         # never re-read, so hashing them would be pure wasted CPU on
         # the job-completion path.
         sha = (hashlib.sha256(payload).hexdigest()
-               if self.dir is not None else None)
-        if self.dir is not None:
-            path = self._payload_path(key)
-            tmp = path + ".tmp"
-            try:
-                with open(tmp, "wb") as f:
-                    f.write(payload)
-                os.replace(tmp, path)
-                side = os.path.join(self.dir, f"{key}.json")
-                with open(side + ".tmp", "w", encoding="utf-8") as f:
-                    json.dump({"format": fmt, "meta": meta,
+               if self._blob is not None else None)
+        if self._blob is not None:
+            side = json.dumps({"format": fmt, "meta": meta,
                                "bytes": len(payload), "sha256": sha,
-                               "t": time.time()}, f)
-                os.replace(side + ".tmp", side)
+                               "t": time.time()}).encode()
+            try:
+                self._blob.put(self._payload_key(key), payload)
+                self._blob.put(f"{key}.json", side)
             except OSError as e:
                 log.warning("content cache write failed: %s", e)
                 return
@@ -550,23 +589,26 @@ class ContentCache:
             self._bytes_gauge.set(self._held)
         for victim in victims:
             self._evictions.inc()
-            if self.dir is not None:
+            if self._blob is not None:
                 for suffix in (".bin", ".json"):
                     try:
-                        os.remove(os.path.join(self.dir,
-                                               f"{victim}{suffix}"))
+                        self._blob.delete(f"{victim}{suffix}")
                     except OSError:
                         pass
 
     def stats(self) -> dict:
         with self._lock:
+            quarantined = self._quarantined_objects
             return {
                 "entries": len(self._index),
                 "bytes": self._held,
                 "max_bytes": self.max_bytes,
-                "persistent": self.dir is not None,
+                "persistent": self._blob is not None,
+                "backend": (self._blob.stats().get("backend")
+                            if self._blob is not None else None),
                 "hits": int(self._hits.value),
                 "misses": int(self._misses.value),
                 "evictions": int(self._evictions.value),
                 "corrupt_quarantined": int(self._corrupt.value),
+                "quarantined_objects": quarantined,
             }

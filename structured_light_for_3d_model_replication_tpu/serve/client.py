@@ -63,7 +63,8 @@ class ServeClient:
     def __init__(self, base_url, timeout_s: float = 30.0,
                  retries: int = 4, retry_backoff_s: float = 0.25,
                  retry_budget_s: float = 30.0,
-                 unknown_grace_s: float = 0.0):
+                 unknown_grace_s: float = 0.0,
+                 tenant: str | None = None):
         # One URL or a list: with a list, connection-level failures
         # rotate to the next replica (failover), while HTTP-level
         # answers (including 429/503) stay on the current one.
@@ -84,9 +85,19 @@ class ServeClient:
         # jobs across failover set this to their recovery budget.
         # Default 0.0 keeps the honest fast 404.
         self.unknown_grace_s = float(unknown_grace_s)
+        # Tenant identity: stamped as X-Tenant on every admission call
+        # (submit / session create / stop) so per-tenant quotas and the
+        # serve_tenant_* metrics attribute this client's load. None =
+        # the server's "anon" bucket.
+        self.tenant = tenant
         # Injectable for deterministic tests.
         self._sleep = time.sleep
         self._rng = random.Random()
+
+    def _tenant_headers(self, headers: dict) -> dict:
+        if self.tenant:
+            headers["X-Tenant"] = self.tenant
+        return headers
 
     @property
     def base_url(self) -> str:
@@ -167,9 +178,10 @@ class ServeClient:
                 "explicitly (e.g. (x * 255).astype(np.uint8))")
         buf = io.BytesIO()
         np.save(buf, stack)
-        headers = {"Content-Type": "application/octet-stream",
-                   "X-Result-Format": result_format,
-                   "X-Priority": priority}
+        headers = self._tenant_headers(
+            {"Content-Type": "application/octet-stream",
+             "X-Result-Format": result_format,
+             "X-Priority": priority})
         if deadline_s is not None:
             headers["X-Deadline-S"] = str(deadline_s)
         data = buf.getvalue()
@@ -275,7 +287,8 @@ class ServeClient:
             req = urllib.request.Request(
                 self.base_url + "/session",
                 data=json.dumps(options).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=self._tenant_headers(
+                    {"Content-Type": "application/json"}),
                 method="POST")
             status, hdrs, body = self._request(req)
             payload = self._payload(body)
@@ -307,7 +320,8 @@ class ServeClient:
             req = urllib.request.Request(
                 f"{self.base_url}/session/{session_id}/stop",
                 data=data,
-                headers={"Content-Type": "application/octet-stream"},
+                headers=self._tenant_headers(
+                    {"Content-Type": "application/octet-stream"}),
                 method="POST")
             status, hdrs, body = self._request(req)
             payload = self._payload(body)

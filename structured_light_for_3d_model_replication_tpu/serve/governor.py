@@ -349,4 +349,9 @@ class OverloadGovernor:
             "breaker_open_s": (round(remaining, 2)
                                if remaining is not None else None),
             "worker_restarts": int(self._restarts.value),
+            # Autoscaler signals (router /fleet/signals aggregates
+            # these across replicas).
+            "memory_pressure": round(self.memory_pressure(), 4),
+            "shed_total": {tier: int(c.value)
+                           for tier, c in self._shed_total.items()},
         }

@@ -26,10 +26,27 @@ a node and doesn't care":
   previews, metrics aggregation's per-replica scrape) forwards to the
   owning replica; job→replica placements are remembered (bounded) so
   polling follows the job wherever admission put it.
+* **router HA** — 2+ routers share the session-pin map through a
+  :class:`~.blobstore.BlobStore` (:class:`PinBoard`: one
+  generation-stamped record per session, last-writer-wins, ties broken
+  by router id) and probe each other (``router_peers``): a client may
+  hit any router, a freshly restarted router RE-LEARNS its pins from
+  the board instead of probing the fleet (and therefore never steals a
+  live session), and concurrent routers converge on one owner because
+  every adoption consults the board for a fresher pin first.
+* **proactive re-pin** — a readyz-miss failure detector with hysteresis
+  (consecutive misses → suspect → dead; consecutive hits to come back)
+  triggers ``adopt_session`` on ring survivors in the BACKGROUND the
+  moment a replica is declared dead, so failover is pre-completed work
+  instead of the next client op's latency spike (bench [10]'s
+  ``fleet_proactive_repin_s``). Among live peered routers, the lowest
+  router id is the detector primary — the rest stand by (adoption is
+  idempotent and board-converged, so an election race is benign, just
+  wasteful).
 
 The router holds NO reconstruction state and never touches a device:
-killing it loses nothing but routing memory (job/session pins are
-re-learned by probing replicas), which is why one thin process is
+killing it loses only routing memory not yet on the pin board (job pins
+are re-learned by probing replicas), which is why thin processes are
 enough in front of the fleet. (Importing it still pulls the serve
 package — and with it jax — so it runs from the same install as a
 replica; it just never initializes a backend.)
@@ -42,12 +59,15 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+import time
+import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..utils import events, trace
 from ..utils.log import get_logger
+from .blobstore import BlobStore, open_blob_store
 from .fleet import HashRing, PeerTransport
 from .service import MAX_SUBMIT_BYTES
 
@@ -55,7 +75,105 @@ log = get_logger(__name__)
 
 #: Request headers the router forwards to replicas verbatim.
 _FORWARD_HEADERS = ("X-Result-Format", "X-Priority", "X-Deadline-S",
-                    "Content-Type")
+                    "X-Tenant", "Content-Type")
+
+#: Failure-detector states (readyz-miss hysteresis).
+ALIVE, SUSPECT, DEAD = "alive", "suspect", "dead"
+
+
+class PinBoard:
+    """Session-pin records shared by a router set through a blob store.
+
+    One object per session (``router/pins/<sid>.json``) carrying
+    ``{url, gen, router}``. Records are totally ordered by
+    ``(gen, router)`` — writers stamp ``gen = known + 1``, the highest
+    order wins, and an equal-generation double-write tie-breaks on
+    router id — so every reader converges on ONE owner without
+    coordination: :meth:`write` refuses to clobber a higher-ranked
+    record, readers only adopt records that outrank their local
+    knowledge, and the owning router's periodic sync re-asserts a
+    record a racing replace landed over. Store failures are contained
+    here: a sick board degrades pin SHARING (each router falls back to
+    its local memory), never routing."""
+
+    PREFIX = "router/pins/"
+
+    def __init__(self, store: BlobStore, router_id: str):
+        self.store = store
+        self.router_id = router_id
+        self.write_failures = 0
+
+    def _key(self, session_id: str) -> str:
+        safe = "".join(c for c in session_id
+                       if c.isalnum() or c in "-_")
+        return f"{self.PREFIX}{safe}.json"
+
+    def write(self, session_id: str, url: str, gen: int) -> None:
+        """Publish a pin UNLESS the board already holds a higher-ranked
+        record ((gen, router) lexicographic — the tie-break that makes
+        two routers stamping the same generation deterministic). The
+        read-compare-write is not a CAS; a racing replace can still
+        land the lower-ranked record last, which the owning router's
+        periodic board sync detects and re-asserts."""
+        try:
+            cur = self.read(session_id)
+            if cur is not None \
+                    and (cur[1], cur[2]) > (int(gen), self.router_id):
+                return
+            rec = json.dumps({"url": url, "gen": int(gen),
+                              "router": self.router_id,
+                              "t_wall": time.time()}).encode()
+            self.store.replace(self._key(session_id), rec)
+        except OSError as e:
+            self.write_failures += 1
+            log.warning("pin-board write for %s failed: %s",
+                        session_id, e)
+
+    def clear(self, session_id: str) -> None:
+        try:
+            self.store.delete(self._key(session_id))
+        except OSError as e:
+            self.write_failures += 1
+            log.warning("pin-board clear for %s failed: %s",
+                        session_id, e)
+
+    @staticmethod
+    def _parse(data: bytes) -> tuple[str, int, str] | None:
+        try:
+            doc = json.loads(data.decode())
+            return str(doc["url"]), int(doc.get("gen", 0)), \
+                str(doc.get("router", ""))
+        except (ValueError, KeyError, UnicodeDecodeError):
+            return None  # torn record (FaultyBlobStore): ignore
+
+    def read(self, session_id: str) -> tuple[str, int, str] | None:
+        """(url, gen, router) or None — missing, unreadable or torn."""
+        try:
+            data = self.store.get(self._key(session_id))
+        except OSError:
+            return None
+        return self._parse(data) if data is not None else None
+
+    def load(self) -> dict:
+        """{session_id: (url, gen, router)} — the router-restart
+        re-learn path."""
+        out: dict = {}
+        try:
+            keys = self.store.list(self.PREFIX)
+        except OSError as e:
+            log.warning("pin-board load failed: %s", e)
+            return out
+        for key in keys:
+            if not key.endswith(".json"):
+                continue
+            try:
+                data = self.store.get(key)
+            except OSError:
+                continue
+            rec = self._parse(data) if data is not None else None
+            if rec is not None:
+                out[key[len(self.PREFIX):-5]] = rec
+        return out
 
 
 class FleetRouter:
@@ -66,7 +184,14 @@ class FleetRouter:
                  forward_timeout_s: float = 600.0,
                  transport: PeerTransport | None = None,
                  registry: "trace.MetricsRegistry | None" = None,
-                 max_job_pins: int = 65536):
+                 max_job_pins: int = 65536,
+                 router_id: str | None = None,
+                 pin_store: "BlobStore | str | None" = None,
+                 router_peers=(),
+                 proactive_repin: bool = True,
+                 suspect_misses: int = 2, dead_misses: int = 3,
+                 recover_hits: int = 2,
+                 signals_interval_s: float = 5.0):
         urls = [u.rstrip("/") for u in replicas]
         if not urls:
             raise ValueError("a router needs at least one replica URL")
@@ -79,15 +204,54 @@ class FleetRouter:
         self.registry = registry if registry is not None \
             else trace.MetricsRegistry()
         self.ring = HashRing(urls)
+        self.router_id = router_id or f"router-{uuid.uuid4().hex[:8]}"
+        self.router_peers = [u.rstrip("/") for u in router_peers]
+        if isinstance(pin_store, str):
+            pin_store = open_blob_store(pin_store)
+        self.pin_board: PinBoard | None = (
+            PinBoard(pin_store, self.router_id)
+            if pin_store is not None else None)
+        self.proactive_repin = bool(proactive_repin)
+        self.suspect_misses = max(1, int(suspect_misses))
+        self.dead_misses = max(self.suspect_misses, int(dead_misses))
+        self.recover_hits = max(1, int(recover_hits))
+        # Signal snapshots are a SLOWER cadence than readiness probes:
+        # scraping every replica's full /healthz stats at the sweep
+        # rate would tax the fleet for data an autoscaler reads every
+        # few seconds at most.
+        self.signals_interval_s = float(signals_interval_s)
+        self._signals_last = -float("inf")
+        # Board reconciliation cadence: a load() is list + one store
+        # GET per pinned session, so it runs at its own (slower)
+        # interval rather than per sweep — pin freshness between
+        # routers only needs to beat human/autoscaler reaction time,
+        # and the write-through path keeps the board itself current.
+        self.board_sync_interval_s = max(float(check_interval_s), 2.0)
         self._lock = threading.Lock()
         self._ready: dict[str, bool] = {u: False for u in urls}
         self._reasons: dict[str, str] = {}
         self._jobs: OrderedDict[str, str] = OrderedDict()  # job -> url
         self._max_job_pins = int(max_job_pins)
-        self._sessions: dict[str, str] = {}                # sid -> url
+        # sid -> (url, generation, writer router id): records are
+        # totally ordered by (gen, router) — the order the pin board
+        # shares, so concurrent routers converge on ONE owner.
+        self._sessions: dict[str, tuple[str, int, str]] = {}
+        # Failure detector (readyz-miss hysteresis, per replica).
+        self._det_state: dict[str, str] = {u: ALIVE for u in urls}
+        self._det_misses: dict[str, int] = {u: 0 for u in urls}
+        self._det_hits: dict[str, int] = {u: 0 for u in urls}
+        self._repin_inflight: set[str] = set()
+        # Peer routers (readyz-driven peering): url -> router_id | None.
+        self._peer_ids: dict[str, str | None] = {
+            u: None for u in self.router_peers}
+        # Per-replica signal snapshots scraped by the sweep (the
+        # /fleet/signals + corrupt-aggregation source — request handlers
+        # never fan out to replicas themselves).
+        self._replica_stats: dict[str, dict] = {}
         self._rr = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._board_thread: threading.Thread | None = None
         self._requests = lambda route: self.registry.counter(
             "router_requests_total", "requests by route", route=route)
         self._failovers = self.registry.counter(
@@ -97,11 +261,22 @@ class FleetRouter:
             "router_session_repins_total",
             "sessions handed off to a survivor after their pinned "
             "replica died")
+        self._proactive = self.registry.counter(
+            "router_proactive_repins_total",
+            "sessions adopted in the background by the failure "
+            "detector, before any client op needed them")
         self._unroutable = self.registry.counter(
             "router_unroutable_total",
             "requests refused with no ready replica")
         self._ready_gauge = self.registry.gauge(
             "router_ready_replicas", "replicas currently routable")
+        if self.pin_board is not None:
+            # Router-restart re-learn: adopt the board's pins as-is.
+            # Believing the board (instead of probing/adopting) is what
+            # keeps a restarted router from stealing a session that is
+            # alive and well on its pinned replica.
+            for sid, rec in self.pin_board.load().items():
+                self._sessions[sid] = rec
 
     # -- lifecycle ------------------------------------------------------
 
@@ -111,14 +286,23 @@ class FleetRouter:
         self._thread = threading.Thread(target=self._watch,
                                         name="router-health", daemon=True)
         self._thread.start()
+        if self.pin_board is not None:
+            # Board reconciliation runs on its OWN thread: pin-store
+            # I/O (possibly a slow remote object service) must never
+            # delay the readiness probes above.
+            self._board_thread = threading.Thread(
+                target=self._board_watch, name="router-board-sync",
+                daemon=True)
+            self._board_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        t = self._thread
-        if t is not None:
-            t.join(timeout=5.0)
-            self._thread = None
+        for t in (self._thread, self._board_thread):
+            if t is not None:
+                t.join(timeout=5.0)
+        self._thread = None
+        self._board_thread = None
 
     def _watch(self) -> None:
         while not self._stop.wait(self.check_interval_s):
@@ -128,8 +312,290 @@ class FleetRouter:
         for url in self.replicas:
             ready, reason = self._probe(url)
             self._set_ready(url, ready, reason)
+            self._detect(url, ready)
+        self._probe_router_peers()
+        self._scrape_signals()
+        if self.proactive_repin:
+            # Re-kick while any dead replica still has pinned sessions:
+            # covers pins that reached the shared board after the dead
+            # transition, a standby promoted to primary by its peer's
+            # death, and transiently failed adoptions.
+            with self._lock:
+                dead = [u for u, s in self._det_state.items()
+                        if s == DEAD]
+            for url in dead:
+                self._kick_proactive(url)
         with self._lock:
             self._ready_gauge.set(sum(self._ready.values()))
+
+    # -- failure detector (proactive re-pin) ---------------------------
+
+    def _detect(self, url: str, ready: bool) -> None:
+        """Readyz-miss hysteresis: ``suspect_misses`` consecutive misses
+        → suspect, ``dead_misses`` → dead (fires the proactive re-pin);
+        ``recover_hits`` consecutive hits to come back — a replica
+        flapping at the probe cadence never oscillates the detector."""
+        dead_now = False
+        with self._lock:
+            state = self._det_state.get(url, ALIVE)
+            if ready:
+                self._det_misses[url] = 0
+                self._det_hits[url] = self._det_hits.get(url, 0) + 1
+                if state != ALIVE \
+                        and self._det_hits[url] >= self.recover_hits:
+                    self._det_state[url] = ALIVE
+                    log.info("detector: replica %s recovered", url)
+            else:
+                self._det_hits[url] = 0
+                misses = self._det_misses.get(url, 0) + 1
+                self._det_misses[url] = misses
+                if misses >= self.dead_misses and state != DEAD:
+                    self._det_state[url] = DEAD
+                    dead_now = True
+                elif misses >= self.suspect_misses and state == ALIVE:
+                    self._det_state[url] = SUSPECT
+        if dead_now:
+            events.record("router_replica_dead", severity="warning",
+                          url=url, misses=self.dead_misses)
+            log.warning("detector: replica %s declared dead after %d "
+                        "missed probes", url, self.dead_misses)
+            if self.proactive_repin:
+                self._kick_proactive(url)
+
+    def _dead_pinned_sessions(self, url: str) -> list:
+        """Sessions pinned to ``url`` that a proactive sweep should
+        move. LOCAL map only — board records arrive via the board-sync
+        thread (`_sync_board`), so the health sweep itself never blocks
+        on pin-store I/O: a slow or hung board must not stall readiness
+        probing for the whole fleet."""
+        with self._lock:
+            return [sid for sid, rec in self._sessions.items()
+                    if rec[0] == url
+                    and sid not in self._repin_inflight]
+
+    def _sync_board(self) -> None:
+        """One board reconciliation pass (its own thread, never the
+        health sweep): pull peer routers' pins into the local map by
+        (gen, router) order — a session created through a PEER router
+        must be visible to THIS router's failure detector — and
+        re-assert any of our OWN records a racing lower-ranked replace
+        clobbered on the board. Deletions win: a record absent from the
+        board is never resurrected from local memory."""
+        board = self.pin_board.load()
+        for sid, (url, gen, stamp) in board.items():
+            self._merge_pin(sid, url, gen, stamp)
+        with self._lock:
+            local = dict(self._sessions)
+        for sid, (url, gen, stamp) in local.items():
+            rec = board.get(sid)
+            if rec is not None and stamp == self.router_id \
+                    and (rec[1], rec[2]) < (gen, stamp):
+                self.pin_board.write(sid, url, gen)
+
+    def _board_watch(self) -> None:
+        while not self._stop.wait(self.board_sync_interval_s):
+            try:
+                self._sync_board()
+            except Exception as e:  # a sick board degrades pin sharing
+                log.warning("pin-board sync failed: %s", e)
+
+    def _kick_proactive(self, url: str) -> None:
+        """Adopt the dead replica's pinned sessions on ring survivors in
+        a background thread — failover becomes pre-completed work. Only
+        the detector PRIMARY (lowest router id among live peered
+        routers) sweeps; a standby whose primary just died takes over at
+        its own next sweep (the sweep re-kicks while a dead replica
+        still has pinned sessions), and any election race is benign:
+        the replica-side adopt is idempotent and the pin board
+        converges last-writer-wins."""
+        if not self._is_detector_primary():
+            log.debug("detector: standing by (peer router is primary) "
+                      "for dead replica %s", url)
+            return
+        sids = self._dead_pinned_sessions(url)
+        if not sids:
+            return
+        with self._lock:
+            self._repin_inflight.update(sids)
+        threading.Thread(target=self._proactive_repin_replica,
+                         args=(url, sids), name="router-repin",
+                         daemon=True).start()
+
+    def _proactive_repin_replica(self, url: str, sids: list) -> None:
+        try:
+            # The cached detector flag can be stale — and unlike the
+            # lazy path (where a client op needs a home NOW), the
+            # proactive path has time to be conservative: re-probe
+            # fresh, and adopt ONLY from a replica whose socket is
+            # dead. A replica that ANSWERS — even a 503 (drain,
+            # warmup-after-restart, watchdog lane swap) — is alive and
+            # may be hosting (or recovering) these sessions; stealing
+            # them would double-host. route_session_ex still covers
+            # the alive-but-unready case when a client op actually
+            # needs to move.
+            ready, reason = self._probe(url)
+            self._set_ready(url, ready, reason)
+            if ready or not reason.startswith("unreachable"):
+                log.info("proactive re-pin of %s aborted: replica "
+                         "answered its probe (%s)", url,
+                         reason or "ready")
+                return
+            for sid in sids:
+                t0 = time.monotonic()
+                with self._lock:
+                    still_dead = self._det_state.get(url) == DEAD
+                    rec = self._sessions.get(sid)
+                    pin = rec[0] if rec is not None else None
+                if not still_dead or pin != url:
+                    continue
+                new, unknown = self._adopt_on_survivor_ex(sid)
+                if new is not None:
+                    self._proactive.inc()
+                    events.record(
+                        "session_proactive_repin", severity="warning",
+                        session_id=sid, from_url=url, to_url=new,
+                        seconds=round(time.monotonic() - t0, 3))
+                elif unknown:
+                    # Definitively ended fleet-wide (every survivor
+                    # answered 404, no adoptable stream): drop the pin
+                    # so the sweep stops hunting a ghost.
+                    self.unpin_session(sid)
+        finally:
+            with self._lock:
+                self._repin_inflight.difference_update(sids)
+
+    def detector_state(self, url: str) -> str:
+        with self._lock:
+            return self._det_state.get(url, ALIVE)
+
+    # -- router peering -------------------------------------------------
+
+    def _probe_router_peers(self) -> None:
+        for peer in self.router_peers:
+            rid = None
+            try:
+                status, _, body = self.transport.get(
+                    f"{peer}/healthz", timeout_s=self.health_timeout_s)
+                if status == 200:
+                    doc = json.loads(body.decode())
+                    rid = doc.get("router_id")
+                    if rid is None:
+                        # A 200 WITHOUT a router id is not a router
+                        # (a replica URL listed in --router-peers by
+                        # mistake) — it must not participate in the
+                        # primary election, where a placeholder id
+                        # would outrank every real router and silently
+                        # disable proactive failover fleet-wide.
+                        log.warning(
+                            "router peer %s answered /healthz without "
+                            "a router_id (a replica URL in "
+                            "--router-peers?); ignoring for election",
+                            peer)
+            except (OSError, ValueError, UnicodeDecodeError):
+                rid = None
+            with self._lock:
+                self._peer_ids[peer] = rid
+
+    def _is_detector_primary(self) -> bool:
+        with self._lock:
+            alive = [rid for rid in self._peer_ids.values()
+                     if rid is not None]
+        return all(self.router_id <= rid for rid in alive)
+
+    # -- replica signal scraping (autoscaler + corrupt aggregation) ----
+
+    #: /healthz keys the sweep snapshots per replica.
+    _SIGNAL_KEYS = ("replica_id", "queue_depth", "queue_capacity",
+                    "workers_alive", "sessions", "governor",
+                    "content_cache", "lanes", "store", "handoff")
+
+    def _scrape_signals(self) -> None:
+        now = time.monotonic()
+        if now - self._signals_last < self.signals_interval_s:
+            return
+        self._signals_last = now
+        for url in self.ready_replicas():
+            try:
+                status, _, body = self.transport.get(
+                    f"{url}/healthz", timeout_s=self.health_timeout_s)
+                if status != 200:
+                    continue
+                doc = json.loads(body.decode())
+            except (OSError, ValueError, UnicodeDecodeError):
+                continue
+            snap = {k: doc.get(k) for k in self._SIGNAL_KEYS
+                    if k in doc}
+            snap["t_mono"] = time.monotonic()
+            with self._lock:
+                self._replica_stats[url] = snap
+
+    def signals(self) -> dict:
+        """``GET /fleet/signals``: the aggregate an autoscaler consumes
+        — queue pressure, lane/session occupancy, shed + overload state,
+        device-memory pressure — computed from the sweep's cached
+        per-replica snapshots (a scrape never fans out to the fleet)."""
+        with self._lock:
+            snaps = {u: dict(s) for u, s in self._replica_stats.items()}
+            ready = [u for u in self.replicas if self._ready.get(u)]
+        queue_depth = queue_cap = sessions_live = lanes_total = 0
+        shed_total = 0
+        workers = 0
+        mem_frac = 0.0
+        overload = 0
+        for url in ready:
+            s = snaps.get(url)
+            if not s:
+                continue
+            queue_depth += int(s.get("queue_depth") or 0)
+            queue_cap += int(s.get("queue_capacity") or 0)
+            workers += int(s.get("workers_alive") or 0)
+            sess = s.get("sessions") or {}
+            sessions_live += int(sess.get("live") or 0)
+            lanes = (s.get("lanes") or {}).get("lanes") or []
+            lanes_total += len(lanes)
+            gov = s.get("governor") or {}
+            overload = max(overload, int(gov.get("level") or 0))
+            mem_frac = max(mem_frac,
+                           float(gov.get("memory_pressure") or 0.0))
+            shed_total += sum(
+                int(v) for v in (gov.get("shed_total") or {}).values())
+        return {
+            "router_id": self.router_id,
+            "ready_replicas": len(ready),
+            "replicas_total": len(self.replicas),
+            "queue_depth_total": queue_depth,
+            "queue_capacity_total": queue_cap,
+            "queue_frac": (round(queue_depth / queue_cap, 4)
+                           if queue_cap else 0.0),
+            "sessions_live_total": sessions_live,
+            "worker_lanes_total": workers,
+            "device_lanes_total": lanes_total,
+            "overload_level_max": overload,
+            "memory_pressure_max": round(mem_frac, 4),
+            "shed_total": shed_total,
+            "unroutable_total": int(self._unroutable.value),
+        }
+
+    def corrupt_blob_summary(self) -> dict:
+        """Fleet-wide content-cache corruption view for ``/fleet``:
+        quarantined-blob counters summed over ready replicas (blob
+        corruption is a VOLUME problem — per-replica counters hide a
+        shared sick disk)."""
+        with self._lock:
+            snaps = {u: dict(s) for u, s in self._replica_stats.items()}
+        per = {}
+        corrupt = quarantined = 0
+        for url, s in snaps.items():
+            cc = s.get("content_cache") or {}
+            c = int(cc.get("corrupt_quarantined") or 0)
+            q = int(cc.get("quarantined_objects") or 0)
+            per[url] = {"corrupt_quarantined": c,
+                        "quarantined_objects": q}
+            corrupt += c
+            quarantined += q
+        return {"corrupt_quarantined_total": corrupt,
+                "quarantined_objects_total": quarantined,
+                "per_replica": per}
 
     def _probe(self, url: str) -> tuple[bool, str]:
         try:
@@ -201,17 +667,69 @@ class FleetRouter:
         with self._lock:
             return self._jobs.get(job_id)
 
+    def _merge_pin(self, session_id: str, url: str, gen: int,
+                   stamp: str) -> bool:
+        """Adopt one pin record into the local map iff it outranks what
+        we know ((gen, router) lexicographic — the pin board's total
+        order; re-checked INSIDE the lock so a concurrent higher-ranked
+        adoption can never be rolled back). True when adopted."""
+        with self._lock:
+            known = self._sessions.get(session_id)
+            if known is not None and (known[1], known[2]) >= (gen, stamp):
+                return False
+            self._sessions[session_id] = (url, gen, stamp)
+            return True
+
     def pin_session(self, session_id: str, url: str) -> None:
         with self._lock:
-            self._sessions[session_id] = url
+            known = self._sessions.get(session_id)
+            gen = (known[1] if known is not None else 0) + 1
+            self._sessions[session_id] = (url, gen, self.router_id)
+        if self.pin_board is not None:
+            # Write-through OUTSIDE the lock (board I/O must never
+            # stall routing); the board's (gen, router) order keeps
+            # concurrent routers convergent.
+            self.pin_board.write(session_id, url, gen)
 
     def session_url(self, session_id: str) -> str | None:
         with self._lock:
-            return self._sessions.get(session_id)
+            pin = self._sessions.get(session_id)
+        if pin is not None:
+            return pin[0]
+        if self.pin_board is not None:
+            # Local miss (pin created through a peer router after our
+            # restart re-learn): believe the shared board.
+            rec = self.pin_board.read(session_id)
+            if rec is not None:
+                self._merge_pin(session_id, *rec)
+                return rec[0]
+        return None
+
+    def _fresher_board_pin(self, session_id: str,
+                           avoid: str | None) -> str | None:
+        """A pin-board record OUTRANKING our local knowledge, pointing
+        at a READY replica that is not ``avoid`` — the peer router
+        already moved this session; believe it instead of adopting a
+        second time."""
+        if self.pin_board is None:
+            return None
+        rec = self.pin_board.read(session_id)
+        if rec is None:
+            return None
+        url, gen, stamp = rec
+        with self._lock:
+            ready = self._ready.get(url, False)
+        if url == avoid or not ready:
+            return None
+        if not self._merge_pin(session_id, url, gen, stamp):
+            return None
+        return url
 
     def unpin_session(self, session_id: str) -> None:
         with self._lock:
             self._sessions.pop(session_id, None)
+        if self.pin_board is not None:
+            self.pin_board.clear(session_id)
 
     # -- forwarding ------------------------------------------------------
 
@@ -247,6 +765,12 @@ class FleetRouter:
         retry cannot help. Transport failures and busy refusals (503)
         keep it False — those warrant the caller's retryable 503."""
         old = self.session_url(session_id)
+        fresher = self._fresher_board_pin(session_id, avoid=old)
+        if fresher is not None:
+            # A peer router already re-pinned this session (its board
+            # record outran our knowledge): converge on ITS owner
+            # instead of adopting a second copy.
+            return fresher, False
         attempted = 0
         uncertain = 0      # transport failures + non-404 refusals
         for url in self.place_session(session_id):
@@ -336,16 +860,29 @@ class FleetRouter:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
+                "router_id": self.router_id,
                 "replicas": [
                     {"url": u, "ready": self._ready.get(u, False),
+                     "detector": self._det_state.get(u, ALIVE),
                      "reason": self._reasons.get(u, "")}
                     for u in self.replicas],
-                "sessions_pinned": dict(self._sessions),
+                "routers": [
+                    {"url": u, "router_id": rid, "alive": rid is not None}
+                    for u, rid in self._peer_ids.items()],
+                "sessions_pinned": {sid: rec[0] for sid, rec
+                                    in self._sessions.items()},
                 "jobs_pinned": len(self._jobs),
                 "failovers": int(self._failovers.value),
                 "session_repins": int(self._repins.value),
+                "proactive_repins": int(self._proactive.value),
+                "pin_board": (None if self.pin_board is None else {
+                    "backend": self.pin_board.store.stats()
+                    .get("backend"),
+                    "write_failures": self.pin_board.write_failures}),
             }
+        out["content_cache"] = self.corrupt_blob_summary()
+        return out
 
     def metrics_text(self) -> str:
         with self._lock:
@@ -535,6 +1072,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                        200 if ready else 503)
         elif url.path == "/fleet":
             self._json(r.stats())
+        elif url.path == "/fleet/signals":
+            self._json(r.signals())
         elif url.path == "/metrics":
             data = r.metrics_text().encode()
             self.send_response(200)

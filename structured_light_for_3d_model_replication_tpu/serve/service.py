@@ -63,6 +63,7 @@ from .jobs import (
 from .lanes import DeviceLanePool
 from .sessions import SessionManager, UnknownSessionError
 from .store import JournalStore, SessionStreamStore
+from .tenants import TenantQuotas
 from .worker import DeviceWorker
 
 log = get_logger(__name__)
@@ -170,8 +171,20 @@ class ServeConfig:
     # Shared session-handoff volume: the WAL streams session ops there
     # (SessionStreamStore sink) so a survivor replica can adopt a dead
     # replica's live sessions. Requires store_dir (the stream rides the
-    # WAL's group commit).
+    # WAL's group commit). May be a local directory (the historical
+    # shared-POSIX layout) or an object-store spec
+    # (``http://host:port[/prefix]`` — serve/blobstore.py; replicas
+    # then share no filesystem at all).
     handoff_dir: str | None = None
+    # -- per-tenant admission quotas (serve/tenants.py) -------------------
+    # Sustained admissions/s per tenant (the X-Tenant header; 0 = quotas
+    # off) and the token bucket's burst headroom. Enforced at admission
+    # BEFORE the queue and governor — one hot client can't starve the
+    # fleet — with retryable 429s (taxonomy TenantQuotaError +
+    # Retry-After) and per-tenant serve_tenant_* counters. Content-cache
+    # hits are exempt (they cost the fleet nothing).
+    tenant_rate_per_s: float = 0.0
+    tenant_burst: int = 8
 
 
 def synthetic_calib_provider(proj: ProjectorConfig):
@@ -284,6 +297,11 @@ class ReconstructionService:
         self.governor = OverloadGovernor(
             config.governor, self.queue, self.registry,
             telemetry=self.telemetry, store=self.store)
+        # Per-tenant admission quotas (serve/tenants.py); None = off.
+        self.tenants: TenantQuotas | None = (
+            TenantQuotas(config.tenant_rate_per_s, config.tenant_burst,
+                         self.registry)
+            if config.tenant_rate_per_s > 0 else None)
         # Device-lane pool (serve/lanes.py): every worker lane is pinned
         # to one local device; sessions get sticky lanes; buckets past
         # shard_min_pixels route to the cross-chip sharded tier.
@@ -742,15 +760,16 @@ class ReconstructionService:
 
     def submit_array(self, stack: np.ndarray, result_format: str = "ply",
                      priority="normal",
-                     deadline_s: float | None = None) -> Job:
+                     deadline_s: float | None = None,
+                     tenant: str | None = None) -> Job:
         """Validate + admit one capture stack; returns the live Job.
         Raises a :class:`~.jobs.JobRejected` subclass on refusal.
 
         A content-cache hit (same bytes, same config, finished before —
         even pre-restart or post-eviction) returns a completed job
         WITHOUT touching the queue; the lookup runs before the overload
-        governor because a cached answer costs nothing and relieves
-        load."""
+        governor AND the tenant quota because a cached answer costs
+        nothing and relieves load."""
         cfg = self.config
         try:
             stack = self._validate_stack(stack)
@@ -789,7 +808,13 @@ class ReconstructionService:
                     return self._complete_from_cache(
                         ckey, result_format, int(priority), cached,
                         source=source)
+            # Governor BEFORE the tenant spend: a fleet-side refusal
+            # (breaker open, shedding) must not drain the tenant's
+            # bucket for work that never ran — and a queue-full
+            # rejection below refunds the token for the same reason.
             self.governor.admit(int(priority))
+            if self.tenants is not None:
+                self.tenants.admit(tenant)
             job = Job(stack=stack, col_bits=cfg.proj.col_bits,
                       row_bits=cfg.proj.row_bits,
                       decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
@@ -808,7 +833,12 @@ class ReconstructionService:
             # growth under the exact overload the bounded queue exists
             # for).
             job.on_terminal = self._on_terminal
-            self.queue.submit(job)
+            try:
+                self.queue.submit(job)
+            except JobRejected:
+                if self.tenants is not None:
+                    self.tenants.refund(tenant)  # nothing ran
+                raise
             self._journal_job(job, stack)
             self._register(job)
         except JobRejected:
@@ -897,24 +927,33 @@ class ReconstructionService:
 
     # -- streaming sessions (docs/STREAMING.md) ----------------------------
 
-    def create_session(self, options: dict | None = None) -> dict:
+    def create_session(self, options: dict | None = None,
+                       tenant: str | None = None) -> dict:
         """``POST /session``: open a streaming session. Refused while
-        draining (same rule as submissions) or past ``max_sessions``."""
+        draining (same rule as submissions), past ``max_sessions``, or
+        over the tenant's admission quota."""
         if self._draining:
             from .jobs import QueueClosedError
 
             self._jobs_total("rejected").inc()
             raise QueueClosedError()
         try:
-            entry = self.sessions.create(options)
+            if self.tenants is not None:
+                self.tenants.admit(tenant)
+            try:
+                entry = self.sessions.create(options)
+            except JobRejected:
+                if self.tenants is not None:
+                    self.tenants.refund(tenant)  # registry refused
+                raise
         except JobRejected:
             self._jobs_total("rejected").inc()
             raise
         return {"session_id": entry.session_id,
                 "scan_id": entry.session.scan_id}
 
-    def submit_session_stop(self, session_id: str,
-                            stack: np.ndarray) -> Job:
+    def submit_session_stop(self, session_id: str, stack: np.ndarray,
+                            tenant: str | None = None) -> Job:
         """``POST /session/<id>/stop``: admit one stop through the SAME
         queue → batcher → program-cache lane as one-shot jobs; the
         decoded arrays are handed to the session instead of a writer.
@@ -923,7 +962,11 @@ class ReconstructionService:
         cfg = self.config
         try:
             stack = self._validate_stack(stack)
+            # Governor before the tenant spend (same rationale as
+            # submit_array: fleet-side refusals don't charge tenants).
             self.governor.admit(1)
+            if self.tenants is not None:
+                self.tenants.admit(tenant)
             job = Job(stack=stack, col_bits=cfg.proj.col_bits,
                       row_bits=cfg.proj.row_bits,
                       decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
@@ -937,7 +980,12 @@ class ReconstructionService:
             if entry.lane is not None:
                 job.lane = entry.lane.index
             job.on_terminal = self._on_terminal
-            self.queue.submit(job)
+            try:
+                self.queue.submit(job)
+            except JobRejected:
+                if self.tenants is not None:
+                    self.tenants.refund(tenant)  # nothing ran
+                raise
             if self.store is not None:
                 # The accepted stop IS the session's recoverable state:
                 # replaying these blobs in order through the B=1 lane
@@ -988,6 +1036,29 @@ class ReconstructionService:
                 '{"representation": "splat"} to get novel-view renders')
         return mesher
 
+    def _splat_scene_off_lock(self, entry, mesher):
+        """Build the session's current splat scene with the EXPENSIVE
+        phase off the session lock (the ROADMAP async-scene-build
+        item): the cheap seed snapshot runs under the lock, the
+        fixed-iteration appearance fit runs lock-FREE on the snapshot
+        (concurrent stop ingest proceeds — a live-polling render
+        client no longer delays the capture cadence), and the publish
+        re-takes the lock (newest-stops-wins, so racing builds
+        converge). Returns the built scene, or None before the first
+        fused stop."""
+        with entry.lock:
+            with entry.device_ctx():
+                token = mesher.begin_scene_build()
+        if token is None:
+            return None
+        with entry.device_ctx():
+            mesher.finish_scene_build(token)
+        with entry.lock:
+            with entry.device_ctx():
+                scene = mesher.adopt_scene(token)
+            entry.last_t = time.monotonic()
+        return scene
+
     def render_session(self, session_id: str, azim: float, elev: float,
                        width: int | None = None,
                        height: int | None = None):
@@ -998,13 +1069,12 @@ class ReconstructionService:
         program per resolution; ``w``/``h`` must name a configured
         render size (each size is its own program — an open set would
         mint compiles on demand, which the zero-steady-state-recompile
-        bar forbids), else 400. Runs under the session lock on the
-        session's sticky lane device (the scene/fit/render programs
-        were warmed per lane at start). A render that follows new
-        stops REBUILDS the scene here (seed + ``splat_fit_iters`` fit
-        steps) while holding the lock — concurrent stop ingest waits
-        for it, so live-polling clients should render at a coarser
-        cadence than they submit (docs/RENDERING.md)."""
+        bar forbids), else 400. A render that follows new stops
+        REBUILDS the scene (seed + ``splat_fit_iters`` fit steps) with
+        the fit OFF the session lock (`_splat_scene_off_lock`), so
+        concurrent stop ingest is not delayed; only the cheap
+        seed/publish/raster phases hold the lock, on the session's
+        sticky lane device (docs/RENDERING.md)."""
         entry = self.sessions.get(session_id)
         mesher = self._session_splat_mesher(entry)
         if (width is None) != (height is None):
@@ -1020,10 +1090,13 @@ class ReconstructionService:
             raise StackFormatError(
                 f"render angles out of range (az {azim}, el {elev}): "
                 "az in [-360, 360], el in [-90, 90]")
+        scene = self._splat_scene_off_lock(entry, mesher)
+        if scene is None:
+            return None
         with entry.lock:
             with entry.device_ctx():
                 out = mesher.render_png(float(azim), float(elev),
-                                        width, height)
+                                        width, height, scene=scene)
             entry.last_t = time.monotonic()
         if out is not None:
             events.record("session_rendered", session_id=session_id,
@@ -1035,12 +1108,16 @@ class ReconstructionService:
         """``GET /session/<id>/splats``: the current splat scene as an
         .npz archive — ``cli render`` reproduces the endpoint's pixels
         from it offline (the serve↔CLI parity contract), or None
-        before the first fused stop."""
+        before the first fused stop. The scene build's fit phase runs
+        off the session lock, like renders."""
         entry = self.sessions.get(session_id)
         mesher = self._session_splat_mesher(entry)
+        scene = self._splat_scene_off_lock(entry, mesher)
+        if scene is None:
+            return None
         with entry.lock:
             with entry.device_ctx():
-                return mesher.scene_bytes()
+                return mesher.scene_bytes(scene=scene)
 
     def finalize_session(self, session_id: str,
                          result_format: str = "stl") -> Job:
@@ -1238,13 +1315,20 @@ class ReconstructionService:
             return None
         return self.content_cache.peek(key)
 
-    def check_admission(self, priority: int = 1) -> None:
+    def check_admission(self, priority: int = 1,
+                        tenant: str | None = None) -> None:
         """Headers-time backpressure probe for the HTTP layer: raises the
-        rejection `submit_array` would (governor shedding/breaker OR
-        queue backpressure), AND counts it — a refusal must hit the
-        rejected counter whether it happened before or after the body
-        was read."""
+        rejection `submit_array` would (tenant quota, governor
+        shedding/breaker OR queue backpressure), AND counts it — a
+        refusal must hit the rejected counter whether it happened before
+        or after the body was read. The tenant check is the NON-spending
+        probe (`TenantQuotas.check`) — rejecting an over-budget tenant
+        before its ~95 MB body is buffered, while the authoritative
+        token spend happens exactly once, inside
+        `submit_array`/`submit_session_stop`."""
         try:
+            if self.tenants is not None:
+                self.tenants.check(tenant)
             self.governor.admit(priority)
             self.queue.check_admission()
         except JobRejected:
@@ -1347,6 +1431,7 @@ class ReconstructionService:
         out = {
             "replica_id": self.replica_id,
             "queue_depth": self.queue.depth(),
+            "queue_capacity": self.queue.max_depth,
             "pending_batches": self.batcher.pending_depth(),
             "draining": self._draining,
             "ready": self.ready,
@@ -1357,6 +1442,8 @@ class ReconstructionService:
             "sessions": self.sessions.stats(),
             "governor": self.governor.stats(),
         }
+        if self.tenants is not None:
+            out["tenants"] = self.tenants.stats()
         if self.content_cache is not None:
             out["content_cache"] = self.content_cache.stats()
         if self.store is not None:
@@ -1496,13 +1583,18 @@ class _ServeHandler(BaseHTTPRequestHandler):
         # sometimes refused here — the cache cannot be consulted before
         # the body exists.
         try:
-            self.service.check_admission(_PRIORITY_NAMES.get(
-                self.headers.get("X-Priority", "normal"), 1))
+            self.service.check_admission(
+                _PRIORITY_NAMES.get(
+                    self.headers.get("X-Priority", "normal"), 1),
+                tenant=self._tenant())
         except JobRejected:
             self.close_connection = True
             raise
         body = self.rfile.read(length)
         return np.load(io.BytesIO(body), allow_pickle=False)
+
+    def _tenant(self) -> str | None:
+        return self.headers.get("X-Tenant")
 
     def _read_json_body(self) -> dict:
         """Small JSON POST body ({} when absent)."""
@@ -1533,7 +1625,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
                     result_format=self.headers.get("X-Result-Format",
                                                    "ply"),
                     priority=self.headers.get("X-Priority", "normal"),
-                    deadline_s=float(deadline) if deadline else None)
+                    deadline_s=float(deadline) if deadline else None,
+                    tenant=self._tenant())
                 self._json({"job_id": job.job_id, "status": job.status})
             elif parts and parts[0] == "session":
                 self._post_session(parts)
@@ -1560,11 +1653,13 @@ class _ServeHandler(BaseHTTPRequestHandler):
         """POST /session | /session/<id>/stop | /session/<id>/finalize
         (docs/STREAMING.md)."""
         if len(parts) == 1:
-            out = self.service.create_session(self._read_json_body())
+            out = self.service.create_session(self._read_json_body(),
+                                              tenant=self._tenant())
             self._json(out)
         elif len(parts) == 3 and parts[2] == "stop":
             stack = self._read_stack_body()
-            job = self.service.submit_session_stop(parts[1], stack)
+            job = self.service.submit_session_stop(parts[1], stack,
+                                                   tenant=self._tenant())
             self._json({"job_id": job.job_id, "status": job.status,
                         "session_id": parts[1]})
         elif len(parts) == 3 and parts[2] == "adopt":

@@ -51,6 +51,7 @@ import numpy as np
 
 from ..utils import events
 from ..utils.log import get_logger
+from .blobstore import BlobStore, open_blob_store
 
 log = get_logger(__name__)
 
@@ -207,25 +208,33 @@ class SessionStreamStore:
     A mirrored ``session_end`` deletes the stream file and its blobs:
     an empty stream directory after drain is the fleet-level
     journal-clean signal.
+
+    Storage rides the :class:`~.blobstore.BlobStore` seam: ``root`` may
+    be a local directory (the historical shared-POSIX-volume layout,
+    preserved byte for byte) or an object-store spec
+    (``http://host:port[/prefix]`` — replicas then share NO filesystem;
+    serve/blobstore.py). Every store failure is an OSError the callers'
+    containment already absorbs: a sick store degrades handoff
+    durability, never serving.
     """
 
     BLOBS_DIR = "blobs"
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, store: BlobStore | None = None):
         self.root = root
-        os.makedirs(os.path.join(root, self.BLOBS_DIR), exist_ok=True)
+        self.store = store if store is not None else open_blob_store(root)
         self.mirror_failures = 0
 
-    # -- paths ----------------------------------------------------------
+    # -- keys -----------------------------------------------------------
 
-    def _stream_path(self, session_id: str) -> str:
-        # Session ids are uuid hex (ours) but defend the path join
+    def _stream_key(self, session_id: str) -> str:
+        # Session ids are uuid hex (ours) but defend the key join
         # anyway: a traversal-shaped id must not escape the volume.
         safe = "".join(c for c in session_id if c.isalnum() or c in "-_")
-        return os.path.join(self.root, f"{safe}.jsonl")
+        return f"{safe}.jsonl"
 
-    def _blob_path(self, name: str) -> str:
-        return os.path.join(self.root, self.BLOBS_DIR, name)
+    def _blob_key(self, name: str) -> str:
+        return f"{self.BLOBS_DIR}/{name}"
 
     # -- writing --------------------------------------------------------
 
@@ -233,19 +242,13 @@ class SessionStreamStore:
         """Append one op line to its session's stream (atomic-enough
         single write; readers tolerate interleaves)."""
         line = json.dumps(op) + "\n"
-        with open(self._stream_path(op["session_id"]), "a",
-                  encoding="utf-8") as f:
-            f.write(line)
-            f.flush()
+        self.store.append(self._stream_key(op["session_id"]),
+                          line.encode("utf-8"))
 
     def put_blob(self, name: str, data: bytes) -> str:
-        """Store one stack blob by content bytes (tmp + atomic rename);
-        returns the blob name."""
-        path = self._blob_path(name)
-        tmp = f"{path}.tmp-{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        """Store one stack blob by content bytes (atomic whole-object
+        write); returns the blob name."""
+        self.store.put(self._blob_key(name), data)
         return name
 
     def mirror(self, op: dict, store: "JournalStore") -> None:
@@ -258,8 +261,7 @@ class SessionStreamStore:
         out = dict(op)
         if kind == "stop" and op.get("stack"):
             blob = f"{op['session_id']}-{op.get('job_id') or 'stop'}.npy"
-            existing = self._blob_path(blob)
-            if not os.path.exists(existing):
+            if self.store.size(self._blob_key(blob)) is None:
                 with open(os.path.join(store.root, op["stack"]),
                           "rb") as f:
                     self.put_blob(blob, f.read())
@@ -303,18 +305,16 @@ class SessionStreamStore:
         gone, which an adopter would dutifully "adopt" as an all-
         degraded empty session."""
         info = self._read(session_id, include_failed=True)
-        path = self._stream_path(session_id)
-        tmp = f"{path}.tmp-{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(json.dumps({"op": "session_end",
-                                "session_id": session_id,
-                                "reason": reason,
-                                "t_wall": time.time()}) + "\n")
-        os.replace(tmp, path)
+        line = json.dumps({"op": "session_end",
+                           "session_id": session_id,
+                           "reason": reason,
+                           "t_wall": time.time()}) + "\n"
+        self.store.replace(self._stream_key(session_id),
+                           line.encode("utf-8"))
         if info is not None:
             for _, blob in info.stops:
                 try:
-                    os.remove(self._blob_path(blob))
+                    self.store.delete(self._blob_key(blob))
                 except OSError:
                     log.debug("handoff blob %s already gone", blob)
 
@@ -323,7 +323,7 @@ class SessionStreamStore:
         after consuming an end tombstone at recovery, bounding
         tombstone accumulation on long-lived volumes)."""
         try:
-            os.remove(self._stream_path(session_id))
+            self.store.delete(self._stream_key(session_id))
         except OSError:
             log.debug("handoff stream %s already gone", session_id)
 
@@ -335,9 +335,6 @@ class SessionStreamStore:
         missing, unreadable or headless. ``ended`` True = an end
         tombstone is present (positive evidence the session finished
         SOMEWHERE in the fleet)."""
-        path = self._stream_path(session_id)
-        if not os.path.exists(path):
-            return False, None
         head = None
         owner = None
         ended = False
@@ -345,12 +342,13 @@ class SessionStreamStore:
         anon: list[tuple[None, str]] = []
         failed: set[str] = set()
         try:
-            with open(path, "rb") as f:
-                lines = f.readlines()
+            data = self.store.get(self._stream_key(session_id))
         except OSError as e:
             log.warning("handoff stream %s unreadable: %s", session_id, e)
             return False, None
-        for raw in lines:
+        if data is None:
+            return False, None
+        for raw in data.splitlines():
             line = raw.strip()
             if not line:
                 continue
@@ -417,19 +415,21 @@ class SessionStreamStore:
         return self.stream_state(session_id) == "live"
 
     def load_blob(self, name: str) -> np.ndarray:
-        with open(self._blob_path(name), "rb") as f:
-            return np.load(io.BytesIO(f.read()), allow_pickle=False)
+        data = self.store.get(self._blob_key(name))
+        if data is None:
+            raise FileNotFoundError(f"handoff blob {name} missing")
+        return np.load(io.BytesIO(data), allow_pickle=False)
 
     def list_sessions(self) -> list[str]:
         """Session ids with LIVE streams (end tombstones excluded) —
         the fleet-level "journal clean?" probe."""
         try:
-            names = os.listdir(self.root)
+            names = self.store.list("")
         except OSError:
             return []
         out = []
-        for n in sorted(names):
-            if not n.endswith(".jsonl"):
+        for n in names:
+            if "/" in n or not n.endswith(".jsonl"):
                 continue
             sid = n[:-6]
             if self.stream_state(sid) == "live":
@@ -438,23 +438,23 @@ class SessionStreamStore:
 
     def stats(self) -> dict:
         # Parse-free on purpose: this rides every /healthz scrape, and
-        # the shared volume may be remote (NFS). ``streams`` counts
-        # stream FILES — live sessions plus not-yet-consumed end
-        # tombstones; the exact live set is ``list_sessions()``, which
-        # parses every stream and belongs in probes, not health scrapes.
+        # the shared volume may be remote (NFS or an object service).
+        # ``streams`` counts stream OBJECTS — live sessions plus
+        # not-yet-consumed end tombstones; the exact live set is
+        # ``list_sessions()``, which parses every stream and belongs in
+        # probes, not health scrapes.
         try:
-            streams = sum(1 for n in os.listdir(self.root)
-                          if n.endswith(".jsonl"))
+            names = self.store.list("")
         except OSError:
-            streams = 0
-        try:
-            blobs = sum(1 for n in os.listdir(
-                os.path.join(self.root, self.BLOBS_DIR))
-                if ".tmp" not in n)   # temp suffix is .tmp-<pid>
-        except OSError:
-            blobs = 0
+            names = []
+        streams = sum(1 for n in names
+                      if "/" not in n and n.endswith(".jsonl"))
+        blobs = sum(1 for n in names
+                    if n.startswith(f"{self.BLOBS_DIR}/")
+                    and ".tmp" not in n)   # temp suffix is .tmp-<pid>
         return {"root": self.root, "streams": streams, "blobs": blobs,
-                "mirror_failures": self.mirror_failures}
+                "mirror_failures": self.mirror_failures,
+                "backend": self.store.stats().get("backend")}
 
 
 # ---------------------------------------------------------------------------

@@ -12,13 +12,17 @@ working unchanged) with the appearance tier:
   the buffered frames only when a render is actually requested (the
   serve render endpoint, ``--preview-render``, finalize's
   ``render_png``) and only when stops arrived since the last build —
-  the INGEST path never runs seed/fit work itself. A render that
-  follows new stops pays the rebuild at request time, and in serve it
-  does so under the session lock (every session operation serializes
-  there), so a client polling renders between stops delays the next
-  stop's ingest by the rebuild — bound it with ``fit_iters``, or poll
-  the cheap mesh preview for progress and render at a coarser cadence
-  (an async snapshot build is the ROADMAP follow-on);
+  the INGEST path never runs seed/fit work itself. The build is SPLIT
+  so serve can run it off the session lock
+  (:meth:`~SplatPreviewMesher.begin_scene_build` — the one cheap seed
+  pass, under the lock / :meth:`~SplatPreviewMesher.finish_scene_build`
+  — the expensive fixed-iteration fit, lock-FREE on an immutable
+  snapshot / :meth:`~SplatPreviewMesher.adopt_scene` — publish,
+  newest-stops-wins, under the lock again): a live-polling render
+  client no longer delays the next stop's ingest by the rebuild
+  (the ROADMAP async-scene-build item; regression-tested in
+  tests/test_stream.py). ``ensure_scene`` composes the three for
+  synchronous callers (CLI, finalize);
 * re-builds are from-scratch (re-seed + fixed-iteration fit), so a
   render is a deterministic function of the volume + frame buffer —
   no incremental optimizer drift, and the serve/CLI parity contract
@@ -45,6 +49,23 @@ from .fit import fit_appearance, fit_pinhole, frame_target
 from .model import SplatParams, SplatScene, seed_from_volume
 
 log = get_logger(__name__)
+
+
+class _SceneBuild:
+    """One in-flight lazy scene rebuild (the begin/finish/adopt split
+    of :class:`SplatPreviewMesher`): the seeded scene plus an immutable
+    snapshot of the fit inputs, so the expensive fit phase can run
+    without the session lock."""
+
+    __slots__ = ("scene", "stops", "frames", "cams", "t0", "done")
+
+    def __init__(self, scene, stops, frames, cams, t0, done=False):
+        self.scene = scene
+        self.stops = stops
+        self.frames = frames
+        self.cams = cams
+        self.t0 = t0
+        self.done = done
 
 
 class SplatPreviewMesher(TSDFPreviewMesher):
@@ -125,16 +146,35 @@ class SplatPreviewMesher(TSDFPreviewMesher):
         return (self._scene is None or self.volume is None
                 or self._scene_stops != self.volume.stops_integrated)
 
-    def ensure_scene(self) -> SplatScene | None:
-        """Seed (+ fit, when frames exist) the scene if stops arrived
-        since the last build; None before the first integrated stop."""
+    def begin_scene_build(self) -> "_SceneBuild | None":
+        """Phase 1 (call under the session lock): snapshot the build
+        inputs and run the CHEAP seed pass. Returns None before the
+        first integrated stop; a non-stale scene returns a done token
+        (finish/adopt are then no-ops). The token holds everything the
+        fit needs — the frame buffer entries are immutable tuples and
+        the volume is not touched again — so phase 2 runs without the
+        lock while ingest keeps mutating the live buffers."""
         if self.volume is None:
             return None
         if not self.scene_stale:
-            return self._scene
+            return _SceneBuild(scene=self._scene,
+                               stops=self._scene_stops, frames=(),
+                               cams=(), t0=time.monotonic(), done=True)
         t0 = time.monotonic()
         scene = seed_from_volume(self.volume, self.splat_params)
-        if self._frames and scene.n_splats:
+        return _SceneBuild(scene=scene,
+                           stops=self.volume.stops_integrated,
+                           frames=tuple(self._frames),
+                           cams=tuple(self._cams), t0=t0)
+
+    def finish_scene_build(self, token: "_SceneBuild") -> "_SceneBuild":
+        """Phase 2 (lock-free): the fixed-iteration appearance fit —
+        the expensive part of a rebuild. Deterministic function of the
+        token's snapshot, so two racing builds of the same stop count
+        produce identical scenes."""
+        if token.done:
+            return token
+        if token.frames and token.scene.n_splats:
             # Pad the buffer to the FIXED max_frames slot count by
             # cycling what exists (duplicate supervision ≈ extra epochs
             # on fewer frames — harmless and deterministic): the fit
@@ -142,17 +182,38 @@ class SplatPreviewMesher(TSDFPreviewMesher):
             # growing buffer would otherwise recompile it at every size
             # 1..max_frames — including inside the first render
             # requests of a session the replica warmup claimed warm.
-            idx = [i % len(self._frames) for i in range(self.max_frames)]
-            frames = np.stack([self._frames[i][0] for i in idx])
-            masks = np.stack([self._frames[i][1] for i in idx])
-            fit_appearance(scene, frames, masks,
-                           [self._cams[i] for i in idx],
+            idx = [i % len(token.frames)
+                   for i in range(self.max_frames)]
+            frames = np.stack([token.frames[i][0] for i in idx])
+            masks = np.stack([token.frames[i][1] for i in idx])
+            fit_appearance(token.scene, frames, masks,
+                           [token.cams[i] for i in idx],
                            iters=self.fit_iters)
-        scene.fit_stats["build_seconds"] = round(
-            time.monotonic() - t0, 3)
-        self._scene = scene
-        self._scene_stops = self.volume.stops_integrated
-        return scene
+        token.scene.fit_stats["build_seconds"] = round(
+            time.monotonic() - token.t0, 3)
+        token.done = True
+        return token
+
+    def adopt_scene(self, token: "_SceneBuild") -> SplatScene:
+        """Phase 3 (call under the session lock): publish the built
+        scene. Newest-stops wins — a racing build that fused MORE stops
+        keeps its (fresher) scene; the returned scene is the token's
+        own build either way, so the caller renders exactly what it
+        asked for."""
+        if self._scene is None or self._scene_stops <= token.stops:
+            self._scene = token.scene
+            self._scene_stops = token.stops
+        return token.scene
+
+    def ensure_scene(self) -> SplatScene | None:
+        """Synchronous compose of the three build phases (offline/CLI
+        callers, finalize): seed + fit if stops arrived since the last
+        build; None before the first integrated stop."""
+        token = self.begin_scene_build()
+        if token is None:
+            return None
+        self.finish_scene_build(token)
+        return self.adopt_scene(token)
 
     # -- rendering ---------------------------------------------------------
 
@@ -161,9 +222,15 @@ class SplatPreviewMesher(TSDFPreviewMesher):
 
     def render_image(self, azim: float, elev: float,
                      width: int | None = None,
-                     height: int | None = None) -> np.ndarray | None:
-        """(H, W, 3) uint8 novel view, or None before the first stop."""
-        scene = self.ensure_scene()
+                     height: int | None = None,
+                     scene: "SplatScene | None" = None
+                     ) -> np.ndarray | None:
+        """(H, W, 3) uint8 novel view, or None before the first stop.
+        ``scene`` renders a PRE-BUILT scene (the serve path, which ran
+        the build phases off the session lock) instead of triggering a
+        synchronous ``ensure_scene`` here."""
+        if scene is None:
+            scene = self.ensure_scene()
         if scene is None:
             return None
         w, h = self.render_sizes[0]
@@ -182,17 +249,20 @@ class SplatPreviewMesher(TSDFPreviewMesher):
 
     def render_png(self, azim: float, elev: float,
                    width: int | None = None,
-                   height: int | None = None
+                   height: int | None = None,
+                   scene: "SplatScene | None" = None
                    ) -> tuple[bytes, dict] | None:
-        img = self.render_image(azim, elev, width, height)
+        img = self.render_image(azim, elev, width, height, scene=scene)
         if img is None:
             return None
         return png_bytes(img), dict(self.last_render_meta)
 
-    def scene_bytes(self) -> bytes | None:
+    def scene_bytes(self, scene: "SplatScene | None" = None
+                    ) -> bytes | None:
         """The current scene as .npz bytes (the ``/session/<id>/splats``
         payload; ``cli render`` re-renders it bit-identically)."""
-        scene = self.ensure_scene()
+        if scene is None:
+            scene = self.ensure_scene()
         return None if scene is None else scene.to_bytes()
 
     def stats(self) -> dict:

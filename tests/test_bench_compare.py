@@ -249,6 +249,59 @@ def test_fleet_headline_lines_and_direction(tmp_path, capsys):
     assert doc["regressions"] == 2
 
 
+def test_proactive_repin_and_signal_metric_directions(tmp_path, capsys):
+    """ISSUE 14: config [10]'s proactive tier adds
+    ``fleet_proactive_repin_s`` — background adoption latency, LOWER is
+    better — alongside the tenant/signal families: count-shaped
+    ``*_rejected_total``/``*_shed_total`` lines keep the lower-wins
+    default, hit-rate ``*_ratio`` and capacity ``*_replicas`` lines
+    invert (up = healthier). --strict judges a mixed fresh run with
+    each metric's own direction."""
+    assert not bench_compare.higher_is_better("fleet_proactive_repin_s")
+    assert not bench_compare.higher_is_better(
+        "fleet_tenant_rejected_total")
+    assert not bench_compare.higher_is_better("fleet_shed_total")
+    assert bench_compare.higher_is_better("fleet_dup_hit_ratio")
+    assert bench_compare.higher_is_better("fleet_ready_replicas")
+    tail = "\n".join([
+        _headline("fleet_failover_s", 12.0),
+        _headline("fleet_proactive_repin_s", 4.0),
+        _headline("fleet_dup_hit_ratio", 0.8),
+        "[10] fleet: proactive re-pin 4.00s, post-failover stop 12.00s",
+    ])
+    _round(tmp_path, 1, tail)
+    traj = bench_compare.load_history([str(tmp_path / "BENCH_r01.json")])
+    assert traj["fleet_proactive_repin_s"] == [(1, 4.0)]
+
+    # Proactive re-pin DOWN + failover DOWN + ratio UP: all improved.
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text("\n".join([
+        _headline("fleet_failover_s", 2.0),
+        _headline("fleet_proactive_repin_s", 1.5),
+        _headline("fleet_dup_hit_ratio", 0.9),
+    ]) + "\n", encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["fleet_proactive_repin_s"] == "improved"
+    assert by_metric["fleet_dup_hit_ratio"] == "improved"
+
+    # Re-pin latency UP + ratio DOWN beyond threshold: regressions in
+    # BOTH directions' senses.
+    fresh.write_text("\n".join([
+        _headline("fleet_failover_s", 12.0),
+        _headline("fleet_proactive_repin_s", 9.0),
+        _headline("fleet_dup_hit_ratio", 0.4),
+    ]) + "\n", encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["fleet_proactive_repin_s"] == "REGRESSION"
+    assert by_metric["fleet_dup_hit_ratio"] == "REGRESSION"
+
+
 def test_tsdf_headline_line_and_direction(tmp_path, capsys):
     """Bench config [11] adds ``tsdf_preview_s`` — per-stop preview
     latency, LOWER is better (a latency line, not throughput). The

@@ -1,0 +1,339 @@
+"""Blob/object-store backend (serve/blobstore.py).
+
+The acceptance bars of the fleet availability tier's storage seam:
+
+* **LocalDirStore** reproduces the historical shared-directory layout
+  byte for byte (keys are relative paths, tmp + atomic rename writes);
+* **ObjectStore** serves the same six-call contract over the in-memory
+  fake and the stdlib HTTP mini-service, so SessionStreamStore and
+  ContentCache work UNCHANGED with no shared filesystem;
+* **FaultyBlobStore** injects seeded, deterministic latency / errors /
+  torn writes, and every consumer degrades durability — quarantine,
+  shorter stream, miss — never availability (no exception escapes into
+  the serving path).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.serve.blobstore import (
+    BlobFaultPlan,
+    FaultyBlobStore,
+    HTTPObjectClient,
+    InMemoryObjectClient,
+    LocalDirStore,
+    ObjectStore,
+    ObjectStoreServer,
+    open_blob_store,
+)
+from structured_light_for_3d_model_replication_tpu.serve.cache import (
+    ContentCache,
+)
+from structured_light_for_3d_model_replication_tpu.serve.router import (
+    PinBoard,
+)
+from structured_light_for_3d_model_replication_tpu.serve.store import (
+    JournalStore,
+    SessionStreamStore,
+)
+from structured_light_for_3d_model_replication_tpu.utils import trace
+
+
+# ---------------------------------------------------------------------------
+# Store contract, across all three backends
+# ---------------------------------------------------------------------------
+
+
+def _contract(store):
+    """The shared BlobStore contract every backend must satisfy."""
+    assert store.get("missing") is None
+    assert store.size("missing") is None
+    store.delete("missing")                  # no-op, no raise
+    store.put("a/b.bin", b"hello")
+    assert store.get("a/b.bin") == b"hello"
+    assert store.size("a/b.bin") == 5
+    store.append("log.jsonl", b"one\n")
+    store.append("log.jsonl", b"two\n")
+    assert store.get("log.jsonl") == b"one\ntwo\n"
+    store.replace("log.jsonl", b"tomb\n")
+    assert store.get("log.jsonl") == b"tomb\n"
+    store.put("a/c.bin", b"x")
+    assert store.list("a/") == ["a/b.bin", "a/c.bin"]
+    assert "log.jsonl" in store.list("")
+    store.rename("a/c.bin", "q/c.bin")
+    assert store.get("a/c.bin") is None
+    assert store.get("q/c.bin") == b"x"
+    store.delete("a/b.bin")
+    assert store.get("a/b.bin") is None
+    with pytest.raises(ValueError):
+        store.put("../escape", b"no")
+    assert "backend" in store.stats()
+
+
+def test_local_dir_store_contract_and_layout(tmp_path):
+    store = LocalDirStore(str(tmp_path))
+    _contract(store)
+    # Layout parity: keys ARE relative paths (the PR-9 on-disk shape).
+    store.put("blobs/s1-j1.npy", b"\x01\x02")
+    assert (tmp_path / "blobs" / "s1-j1.npy").read_bytes() == b"\x01\x02"
+    store.append("s1.jsonl", b'{"op": "session"}\n')
+    assert (tmp_path / "s1.jsonl").exists()
+    # No stray temp files after atomic writes.
+    assert not [p for p in tmp_path.rglob("*.tmp-*")]
+
+
+def test_in_memory_object_store_contract():
+    _contract(ObjectStore(InMemoryObjectClient()))
+    # Prefixed stores are disjoint namespaces over one client.
+    client = InMemoryObjectClient()
+    a = ObjectStore(client, prefix="handoff")
+    b = ObjectStore(client, prefix="pins")
+    a.put("x", b"1")
+    b.put("x", b"2")
+    assert a.get("x") == b"1" and b.get("x") == b"2"
+    assert client.list_objects("") == ["handoff/x", "pins/x"]
+
+
+def test_http_object_store_server_contract():
+    srv = ObjectStoreServer().start()
+    try:
+        _contract(ObjectStore(HTTPObjectClient(srv.url)))
+        # A second client sees the first one's writes (the
+        # cross-process property the fleet smoke relies on).
+        c1 = ObjectStore(HTTPObjectClient(srv.url), prefix="handoff")
+        c2 = ObjectStore(HTTPObjectClient(srv.url), prefix="handoff")
+        c1.put("shared.bin", b"fleet")
+        assert c2.get("shared.bin") == b"fleet"
+    finally:
+        srv.stop()
+    # A dead server is an OSError (containment), not a hang.
+    dead = ObjectStore(HTTPObjectClient("http://127.0.0.1:1",
+                                        timeout_s=0.5))
+    with pytest.raises(OSError):
+        dead.put("x", b"y")
+
+
+def test_open_blob_store_specs(tmp_path, monkeypatch):
+    assert isinstance(open_blob_store(str(tmp_path)), LocalDirStore)
+    assert isinstance(open_blob_store(f"file:{tmp_path}"),
+                      LocalDirStore)
+    mem = open_blob_store("mem:")
+    assert isinstance(mem, ObjectStore)
+    srv = ObjectStoreServer().start()
+    try:
+        http = open_blob_store(f"{srv.url}/handoff")
+        assert isinstance(http, ObjectStore) and http.prefix == "handoff"
+        http.put("k", b"v")
+        assert http.get("k") == b"v"
+    finally:
+        srv.stop()
+    # SL_BLOB_FAULTS wraps (the subprocess chaos hook)...
+    monkeypatch.setenv("SL_BLOB_FAULTS",
+                       '{"seed": 3, "error_rate": 1.0}')
+    faulty = open_blob_store(str(tmp_path))
+    assert isinstance(faulty, FaultyBlobStore)
+    with pytest.raises(OSError):
+        faulty.get("anything")
+    # ...unless the caller opted out (private stores).
+    clean = open_blob_store(str(tmp_path), allow_faults=False)
+    assert isinstance(clean, LocalDirStore)
+    monkeypatch.setenv("SL_BLOB_FAULTS", "not json")
+    assert BlobFaultPlan.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_faulty_blob_store_deterministic_and_torn():
+    plan = BlobFaultPlan(seed=7, error_rate=0.3, latency_s=0.2,
+                        latency_rate=0.3, torn_write_rate=0.4)
+
+    def run():
+        slept = []
+        store = FaultyBlobStore(ObjectStore(InMemoryObjectClient()),
+                                plan, sleep=slept.append)
+        outcomes = []
+        for i in range(64):
+            try:
+                store.put(f"k{i}", b"0123456789")
+                outcomes.append(len(store.inner.get(f"k{i}") or b""))
+            except OSError:
+                outcomes.append("err")
+        return store, outcomes, slept
+
+    s1, o1, slept1 = run()
+    s2, o2, _ = run()
+    assert o1 == o2                       # same seed, same schedule
+    assert s1.errors > 5 and s1.torn_writes > 5 and s1.delays > 5
+    assert slept1 and all(s == 0.2 for s in slept1)
+    # Torn writes persist a TRUNCATED payload while reporting success.
+    torn = [n for n in o1 if n != "err" and n < 10]
+    assert torn, "no torn write landed short"
+    # Reads/lists inject errors too.
+    with pytest.raises(OSError):
+        FaultyBlobStore(ObjectStore(InMemoryObjectClient()),
+                        BlobFaultPlan(error_rate=1.0)).list("")
+
+
+def test_session_stream_store_over_object_store(tmp_path):
+    """The handoff stream with NO shared filesystem: a JournalStore
+    mirrors into a SessionStreamStore backed by the in-memory object
+    store, and every reader-side semantic (dedup, owner, tombstone,
+    journal-clean probe) holds unchanged."""
+    client = InMemoryObjectClient()
+    sink = SessionStreamStore("object://handoff",
+                              store=ObjectStore(client))
+    s = JournalStore(str(tmp_path / "wal"), sink=sink)
+    s.append({"op": "session", "session_id": "s1", "scan_id": "scan-1",
+              "options": {"preview_every": 2}, "replica": "rA"})
+    rel = s.put_stack("s1-j1", np.ones((2, 3, 4), np.uint8))
+    s.append({"op": "stop", "session_id": "s1", "job_id": "j1",
+              "stack": rel})
+    s.append({"op": "stop", "session_id": "s1", "job_id": "j1",
+              "stack": rel})                      # dup: dedup on read
+    info = sink.read_session("s1")
+    assert info is not None and info.scan_id == "scan-1"
+    assert [jid for jid, _ in info.stops] == ["j1"]
+    assert np.array_equal(sink.load_blob(info.stops[0][1]),
+                          np.ones((2, 3, 4), np.uint8))
+    assert sink.owner("s1") == "rA"
+    assert sink.list_sessions() == ["s1"]
+    assert sink.stats()["backend"] == "object"
+    # Torn line injected mid-stream (a faulted writer elsewhere):
+    # readers skip it.
+    client.append_object("s1.jsonl", b'{"op": "stop", "blo')
+    assert sink.read_session("s1") is not None
+    s.append({"op": "session_end", "session_id": "s1",
+              "reason": "finalized", "replica": "rA"})
+    s.close()
+    assert sink.stream_state("s1") == "ended"
+    assert sink.list_sessions() == [] and sink.stats()["blobs"] == 0
+
+
+def test_content_cache_over_object_store_with_faults():
+    """ContentCache on an object backend: hits roundtrip; a corrupted
+    object is quarantined and MISSES (never raises into admission);
+    a fully failing store degrades writes loudly but get() still
+    answers None."""
+    client = InMemoryObjectClient()
+    reg = trace.MetricsRegistry()
+    c = ContentCache(max_bytes=1 << 20,
+                     store=ObjectStore(client, prefix="content"),
+                     registry=reg)
+    c.put("k" * 64, b"payload-bytes", {"points": 9}, "ply")
+    payload, meta, fmt = c.get("k" * 64)
+    assert payload == b"payload-bytes" and meta["points"] == 9
+    # Persistent backends drop the in-memory payload; corrupt the
+    # object server-side and the NEXT hit must quarantine + miss.
+    client.put_object(f"content/{'k' * 64}.bin", b"payload-bytEs")
+    c2 = ContentCache(max_bytes=1 << 20,
+                      store=ObjectStore(client, prefix="content"),
+                      registry=trace.MetricsRegistry())
+    assert c2.get("k" * 64) is None
+    st = c2.stats()
+    assert st["corrupt_quarantined"] == 1
+    assert client.list_objects("content/quarantine/")
+    # A store erroring on every op: puts warn-and-return, gets miss.
+    broken = ContentCache(
+        max_bytes=1 << 20,
+        store=FaultyBlobStore(ObjectStore(InMemoryObjectClient()),
+                              BlobFaultPlan(error_rate=1.0)),
+        registry=trace.MetricsRegistry())
+    broken.put("q" * 64, b"data", {}, "ply")
+    assert broken.get("q" * 64) is None   # degraded, never raised
+
+
+# ---------------------------------------------------------------------------
+# Router pin board (the router-HA shared state)
+# ---------------------------------------------------------------------------
+
+
+def test_pin_board_lww_generations_and_torn_records():
+    client = InMemoryObjectClient()
+    a = PinBoard(ObjectStore(client), "router-a")
+    b = PinBoard(ObjectStore(client), "router-b")
+    a.write("s1", "http://r0", 1)
+    assert b.read("s1") == ("http://r0", 1, "router-a")
+    # Higher generation wins regardless of writer.
+    b.write("s1", "http://r1", 2)
+    assert a.read("s1") == ("http://r1", 2, "router-b")
+    assert a.load() == {"s1": ("http://r1", 2, "router-b")}
+    # Equal-generation double-write tie-breaks on router id: the
+    # lower-ranked writer's replace is REFUSED, so every reader sees
+    # the same single owner.
+    b.write("s3", "http://rB", 5)
+    a.write("s3", "http://rA", 5)
+    assert a.read("s3") == ("http://rB", 5, "router-b")
+    a.write("s3", "http://rA", 6)          # higher gen reclaims
+    assert b.read("s3")[:2] == ("http://rA", 6)
+    # A torn record (a FaultyBlobStore write) reads as None, not a crash.
+    client.put_object("router/pins/s2.json", b'{"url": "ht')
+    assert a.read("s2") is None
+    assert "s2" not in a.load()
+    a.clear("s1")
+    assert b.read("s1") is None
+    # A dead store degrades pin SHARING, not the caller.
+    dead = PinBoard(FaultyBlobStore(ObjectStore(InMemoryObjectClient()),
+                                    BlobFaultPlan(error_rate=1.0)),
+                    "router-c")
+    dead.write("sX", "http://r0", 1)      # no raise
+    assert dead.write_failures == 1
+    assert dead.read("sX") is None and dead.load() == {}
+
+
+def test_router_board_sync_merges_and_reasserts():
+    """The board-sync pass (its own thread in a running router; driven
+    manually here): a pin written through router A becomes visible to
+    router B's LOCAL map — the failure detector's source — and a
+    racing lower-ranked replace landed over A's record is re-asserted
+    by A's next sync."""
+    from structured_light_for_3d_model_replication_tpu.serve.router \
+        import FleetRouter
+
+    client = InMemoryObjectClient()
+    urls = ["http://127.0.0.1:1", "http://127.0.0.1:2"]
+    ra = FleetRouter(urls, router_id="router-a",
+                     pin_store=ObjectStore(client))
+    rb = FleetRouter(urls, router_id="router-b",
+                     pin_store=ObjectStore(client))
+    ra.pin_session("sX", urls[0])
+    rb._sync_board()
+    with rb._lock:
+        assert rb._sessions["sX"][0] == urls[0]
+    assert rb._dead_pinned_sessions(urls[0]) == ["sX"]
+    # A stale lower-ranked record physically lands over A's (the
+    # non-CAS race): A's sync pass re-asserts its own higher rank.
+    client.put_object("router/pins/sX.json",
+                      b'{"url": "http://other", "gen": 0, '
+                      b'"router": "router-0"}')
+    ra._sync_board()
+    assert ra.pin_board.read("sX")[:2] == (urls[0], 1)
+    # Deletions win: a cleared record is not resurrected by sync.
+    ra.unpin_session("sX")
+    ra._sync_board()
+    assert ra.pin_board.read("sX") is None
+
+
+def test_object_store_concurrent_appends_atomic():
+    """The fake's append is atomic under its lock: N threads appending
+    whole lines never interleave bytes (the contract a real S3 adapter
+    must emulate with per-record objects)."""
+    store = ObjectStore(InMemoryObjectClient())
+    lines = [f"line-{i:03d}\n".encode() for i in range(100)]
+
+    def worker(chunk):
+        for ln in chunk:
+            store.append("log", ln)
+
+    threads = [threading.Thread(target=worker,
+                                args=(lines[i::4],)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got = store.get("log").splitlines(keepends=True)
+    assert sorted(got) == sorted(lines)

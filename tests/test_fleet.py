@@ -511,7 +511,12 @@ def test_corrupt_cache_blob_quarantined_not_raised(tmp_path,
         key = done.content_key
         blob = pathlib.Path(store) / "content" / f"{key}.bin"
         deadline = time.monotonic() + 10.0
-        while not blob.exists():   # on_terminal's put runs after wait()
+        # on_terminal's put runs after wait(), and the cache INDEX
+        # insert lands after the file writes — poll for both, or the
+        # dup submit below can slip into the window and miss cleanly
+        # (no quarantine) instead of hitting the corrupt blob.
+        while not blob.exists() \
+                or svc.content_cache.stats()["entries"] < 1:
             assert time.monotonic() < deadline, "artifact never cached"
             time.sleep(0.02)
         raw = bytearray(blob.read_bytes())
@@ -528,7 +533,8 @@ def test_corrupt_cache_blob_quarantined_not_raised(tmp_path,
         assert (q / f"{key}.bin").exists()
         # Recomputed artifact is cached again and hits clean.
         deadline = time.monotonic() + 10.0
-        while not blob.exists():   # recompute's put also trails wait()
+        while not blob.exists() \
+                or svc.content_cache.stats()["entries"] < 1:
             assert time.monotonic() < deadline, "artifact not re-cached"
             time.sleep(0.02)
         dup2 = svc.submit_array(serve_stack)
@@ -734,6 +740,264 @@ def test_router_hash_admission_sticky_sessions_and_handoff(
             if any(w.alive for w in svc.workers):
                 svc.drain(timeout=10.0)
                 http.stop()
+
+
+# ---------------------------------------------------------------------------
+# Router HA: shared pin board, proactive failure detector, tenants,
+# autoscale signals (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def _two_replicas(tmp_path, handoff=None):
+    members = []
+    for i in range(2):
+        svc = ReconstructionService(_config(
+            str(tmp_path / f"v{i}"),
+            handoff_dir=handoff or str(tmp_path / "handoff"),
+            replica_id=f"r{i}")).start()
+        http = ServeHTTPServer(svc, port=0).start()
+        members.append((svc, http))
+    urls = [f"http://127.0.0.1:{h.port}" for _, h in members]
+    return members, urls
+
+
+def _teardown(members, *routers):
+    for r in routers:
+        r.stop()
+    for svc, http in members:
+        if any(w.alive for w in svc.workers):
+            svc.drain(timeout=10.0)
+        http.stop()
+
+
+def test_router_restart_relearn_races_survivor_adoption(
+        tmp_path, serve_ring):
+    """Satellite: a router restarting (re-learning pins from the shared
+    board) racing a peer's concurrent survivor adoption must CONVERGE
+    on one owner — and a live session must never end up served by two
+    replicas. Two phases: with the pinned replica HEALTHY, a fresh
+    router must believe the board and not steal; with it DEAD, both
+    routers racing route_session_ex adopt idempotently onto the same
+    survivor."""
+    from structured_light_for_3d_model_replication_tpu.serve import (
+        InMemoryObjectClient,
+        ObjectStore,
+    )
+
+    members, urls = _two_replicas(tmp_path)
+    board_client = InMemoryObjectClient()
+    rA = FleetRouter(urls, check_interval_s=0.1, router_id="router-a",
+                     pin_store=ObjectStore(board_client),
+                     proactive_repin=False).start()
+    try:
+        (svc0, _), (svc1, _) = members
+        sid = svc0.create_session({})["session_id"]
+        assert svc0.submit_session_stop(sid, serve_ring[0]).wait(120.0)
+        rA.pin_session(sid, urls[0])
+
+        # Phase 1: router restart with the replica ALIVE — the fresh
+        # router re-learns the pin from the board and steals nothing.
+        rB = FleetRouter(urls, check_interval_s=0.1,
+                         router_id="router-b",
+                         pin_store=ObjectStore(board_client),
+                         proactive_repin=False).start()
+        try:
+            assert rB.session_url(sid) == urls[0]
+            assert rB.route_session(sid) == urls[0]
+            assert rB.stats()["session_repins"] == 0
+            assert svc1.sessions.stats()["live"] == 0  # never stolen
+
+            # Phase 2: kill the pinned replica; BOTH routers race the
+            # re-route concurrently.
+            svc0.abort()
+            members[0][1].stop()
+            deadline = time.monotonic() + 10.0
+            while (urls[0] in rA.ready_replicas()
+                   or urls[0] in rB.ready_replicas()):
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            results = {}
+
+            def route(name, router):
+                results[name] = router.route_session(sid)
+
+            threads = [threading.Thread(target=route, args=(n, r))
+                       for n, r in (("a", rA), ("b", rB))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60.0)
+            # Both converge on the one survivor; the session is live
+            # EXACTLY once fleet-wide.
+            assert results == {"a": urls[1], "b": urls[1]}
+            assert svc1.sessions.stats()["live"] == 1
+            assert rA.session_url(sid) == urls[1]
+            assert rB.session_url(sid) == urls[1]
+            # The board holds one converged record.
+            rec = rA.pin_board.read(sid)
+            assert rec is not None and rec[0] == urls[1]
+            # The adopted session still serves.
+            assert svc1.submit_session_stop(
+                sid, serve_ring[1]).wait(120.0)
+            fin = svc1.finalize_session(sid, "ply")
+            assert fin.result_bytes.startswith(b"ply")
+        finally:
+            rB.stop()
+    finally:
+        _teardown(members, rA)
+
+
+def test_proactive_detector_repins_in_background(tmp_path, serve_ring):
+    """Tentpole: the readyz-miss failure detector adopts a dead
+    replica's sessions on survivors WITHOUT any client op driving it,
+    and hysteresis keeps a single missed probe from triggering it."""
+    members, urls = _two_replicas(tmp_path)
+    router = FleetRouter(urls, check_interval_s=0.05,
+                         router_id="router-a",
+                         suspect_misses=2, dead_misses=3,
+                         recover_hits=2).start()
+    try:
+        (svc0, http0), (svc1, _) = members
+        sid = svc0.create_session({})["session_id"]
+        assert svc0.submit_session_stop(sid, serve_ring[0]).wait(120.0)
+        router.pin_session(sid, urls[0])
+        # One flapped probe is NOT death (hysteresis).
+        router._detect(urls[0], False)
+        assert router.detector_state(urls[0]) != "dead"
+        router._detect(urls[0], True)
+        router._detect(urls[0], True)
+        assert router.detector_state(urls[0]) == "alive"
+
+        svc0.abort()                     # kill -9 equivalent
+        http0.stop()
+        deadline = time.monotonic() + 30.0
+        while int(router.stats()["proactive_repins"]) < 1:
+            assert time.monotonic() < deadline, router.stats()
+            time.sleep(0.05)
+        # The session moved to the survivor with NO client op.
+        assert router.session_url(sid) == urls[1]
+        assert svc1.sessions.stats()["live"] == 1
+        assert any(e.kind == "session_proactive_repin"
+                   for e in events.tail(100))
+        # The pre-adopted session serves its next op at plain-op cost.
+        assert svc1.submit_session_stop(sid, serve_ring[1]).wait(120.0)
+    finally:
+        _teardown(members, router)
+
+
+def test_tenant_quota_token_bucket_and_taxonomy(serve_stack):
+    """Per-tenant admission quotas: over-budget submits raise the
+    retryable TenantQuotaError (429 + Retry-After taxonomy), other
+    tenants are unaffected, the headers-time probe does not double
+    charge, and cache hits are exempt."""
+    from structured_light_for_3d_model_replication_tpu.serve import (
+        TenantQuotaError,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.jobs import (
+        error_payload,
+    )
+
+    svc = ReconstructionService(_config(
+        tenant_rate_per_s=0.001, tenant_burst=2))
+    # (not started: admission-side behavior only — jobs just queue)
+    j1 = svc.submit_array(serve_stack, tenant="hot-client")
+    # Headers-time probe does NOT spend: two checks + one submit leave
+    # one token standing...
+    svc.check_admission(1, tenant="hot-client")
+    svc.check_admission(1, tenant="hot-client")
+    j2 = svc.submit_array(serve_stack, tenant="hot-client")
+    assert j1.job_id != j2.job_id
+    # ...and the third admission is refused, retryably, with taxonomy.
+    with pytest.raises(TenantQuotaError) as exc:
+        svc.submit_array(serve_stack, tenant="hot-client")
+    payload = error_payload(exc.value)
+    assert "TenantQuotaError" in payload["taxonomy"]
+    assert "JobRejected" in payload["taxonomy"]
+    assert payload["retry_after_s"] > 0
+    # The probe now refuses too (and counts a rejection).
+    with pytest.raises(TenantQuotaError):
+        svc.check_admission(1, tenant="hot-client")
+    # A refund (queue-level rejection after the spend) restores the
+    # token — the tenant isn't charged for work that never ran.
+    svc.tenants.refund("hot-client")
+    assert svc.tenants.admit("hot-client") == "hot-client"
+    # Another tenant (and the anon default) still flows.
+    svc.submit_array(serve_stack, tenant="polite-client")
+    svc.submit_array(serve_stack)
+    # Hostile/oversized ids collapse to the bounded "other" label.
+    svc.submit_array(serve_stack, tenant="x" * 99)
+    assert "other" in svc.tenants.stats()["tokens"]
+    # Per-tenant counters are on the registry.
+    text = svc.registry.prometheus_text()
+    assert 'serve_tenant_admitted_total{tenant="hot-client"} 3' in text
+    assert 'serve_tenant_rejected_total{tenant="hot-client"} 2' in text
+    # Duplicate submit = content-cache hit path → EXEMPT even with the
+    # bucket empty. (Complete the first job artificially so its
+    # artifact is cached.)
+    j1.complete(b"plyfake", points=1)
+    svc.content_cache.put(j1.content_key, b"plyfake", {}, "ply")
+    hit = svc.submit_array(serve_stack, tenant="hot-client")
+    assert hit.result_meta.get("content_cache_hit") is True
+
+
+def test_fleet_signals_and_corrupt_aggregation(tmp_path, serve_stack):
+    """/fleet/signals aggregates the autoscaler inputs from the sweep's
+    cached per-replica snapshots, and /fleet carries the fleet-wide
+    content-cache corruption summary (satellite)."""
+    svc = ReconstructionService(_config(
+        str(tmp_path / "v0"), replica_id="r0")).start()
+    http = ServeHTTPServer(svc, port=0).start()
+    url = f"http://127.0.0.1:{http.port}"
+    router = FleetRouter([url], check_interval_s=0.1,
+                         router_id="router-a",
+                         signals_interval_s=0.0)
+    try:
+        router._sweep()                  # synchronous: no thread races
+        sig = router.signals()
+        assert sig["ready_replicas"] == 1
+        assert sig["queue_capacity_total"] == 16   # _config queue_depth
+        assert sig["queue_frac"] == 0.0
+        assert sig["worker_lanes_total"] == 1
+        assert sig["overload_level_max"] == 0
+        assert "memory_pressure_max" in sig
+        # Corrupt-blob aggregation rides /fleet (router.stats).
+        st = router.stats()
+        agg = st["content_cache"]
+        assert agg["corrupt_quarantined_total"] == 0
+        assert url in agg["per_replica"]
+        # Poison one cached artifact on the replica; its counter must
+        # surface fleet-wide after the next sweep.
+        job = svc.submit_array(serve_stack)
+        assert job.wait(120.0) and job.status == "done"
+        key = job.content_key
+        bin_path = pathlib.Path(svc.store.content_dir) / f"{key}.bin"
+        deadline = time.monotonic() + 30.0
+        while not bin_path.exists():     # cache put follows the
+            assert time.monotonic() < deadline  # terminal event
+            time.sleep(0.02)
+        data = bytearray(bin_path.read_bytes())
+        data[0] ^= 0xFF
+        bin_path.write_bytes(bytes(data))
+        assert svc.content_cache.get(key) is None   # quarantined
+        router._sweep()
+        agg = router.stats()["content_cache"]
+        assert agg["corrupt_quarantined_total"] == 1
+        assert agg["quarantined_objects_total"] == 1
+        # The HTTP surface serves the same aggregate.
+        rh = RouterHTTPServer(router, port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rh.port}/fleet/signals",
+                    timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc["ready_replicas"] == 1
+        finally:
+            rh.stop()
+    finally:
+        router.stop()
+        if any(w.alive for w in svc.workers):
+            svc.drain(timeout=10.0)
+        http.stop()
 
 
 # ---------------------------------------------------------------------------

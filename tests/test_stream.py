@@ -578,6 +578,63 @@ def test_session_rejects_bad_representation(stream_service):
         client.create_session(representation="gaussian")
 
 
+def test_render_rebuild_runs_off_session_lock(stream_service, serve_ring,
+                                              monkeypatch):
+    """ISSUE 14 satellite: the splat scene rebuild's expensive fit
+    phase runs OFF the session lock (begin/finish/adopt split in
+    splat/preview.py + service._splat_scene_off_lock): while a render's
+    rebuild is in flight, the session lock stays available and a real
+    stop ingests to completion — a live-polling render client no
+    longer delays the capture cadence."""
+    import threading
+
+    import structured_light_for_3d_model_replication_tpu.splat.preview \
+        as splat_preview
+
+    svc, client = stream_service
+    sid = client.create_session(representation="splat")
+    st = client.wait(client.submit_stop(sid, serve_ring[0]),
+                     timeout_s=120.0)
+    assert st["status"] == "done", st
+    entry = svc.sessions.get(sid)
+
+    fit_started = threading.Event()
+    release_fit = threading.Event()
+    real_fit = splat_preview.fit_appearance
+
+    def slow_fit(*a, **kw):
+        fit_started.set()
+        assert release_fit.wait(60.0), "test never released the fit"
+        return real_fit(*a, **kw)
+
+    monkeypatch.setattr(splat_preview, "fit_appearance", slow_fit)
+    out = {}
+    t = threading.Thread(target=lambda: out.update(
+        r=svc.render_session(sid, 30.0, 20.0)), daemon=True)
+    t.start()
+    try:
+        assert fit_started.wait(60.0), \
+            "render rebuild never reached its fit phase"
+        # Mid-fit, the session lock must be FREE (the old behavior held
+        # it through the whole rebuild — ingest waited).
+        assert entry.lock.acquire(timeout=5.0), \
+            "render rebuild held the session lock through the fit"
+        entry.lock.release()
+        # A real stop flows to completion WHILE the fit is in flight.
+        st2 = client.wait(client.submit_stop(sid, serve_ring[1]),
+                          timeout_s=120.0)
+        assert st2["status"] == "done", st2
+        assert "r" not in out            # the rebuild is still parked
+    finally:
+        release_fit.set()
+    t.join(120.0)
+    # The parked render completes against its own (stop-1) snapshot.
+    assert out.get("r") is not None
+    png, meta = out["r"]
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    client.delete_session(sid)
+
+
 def test_session_manager_ttl_expires_abandoned(monkeypatch):
     """An abandoned live session frees its slot after the idle TTL —
     max_sessions never wedges on crashed clients."""

@@ -61,7 +61,14 @@ The remaining BASELINE configs are measured too and written to
    rows and device-memory gauges, asserting zero steady-state
    recompiles per lane and ≥ 3× throughput at 8 devices vs 1 where the
    host can express the parallelism — emits the
-   ``serve_scans_per_s_8dev`` headline line;
+   ``serve_scans_per_s_8dev`` headline line; 7c is the LANE-CHAOS gate
+   (device-loss tolerance, serve/lanes.py): offered load over 2 lanes
+   with a seeded ``SL_DEVICE_FAULTS`` device-lost rule killing one
+   chip mid-load — asserts ZERO lost acked jobs, the victim's sticky
+   session re-pinned to a survivor with zero program-cache miss
+   growth, and emits the ``lane_failover_s`` headline line (first
+   injected fault → the victim session's first completed stop on the
+   adopted lane);
 8. streaming incremental reconstruction (`stream/`) on the same 24-stop
    scan: per-stop fusion with progressive previews — emits the
    ``first_preview_s`` and ``incremental_vs_batch_final_s`` headline
@@ -1479,6 +1486,156 @@ def main():
                     f"{sps1} — the device dimension is not scaling")
 
     guarded("serve_multidevice_sweep", config7b)
+
+    # ------------------------------------------------------------------
+    # Config 7c: LANE-CHAOS gate (device-loss tolerance, serve/lanes.py).
+    # Offered load over 2 device lanes with a seeded SL_DEVICE_FAULTS
+    # device-lost rule turning one chip dead mid-load: asserts zero lost
+    # acked jobs (every submit AND every session stop completes — the
+    # faulted batches re-queue cross-lane), the victim's sticky session
+    # re-pinned to the survivor with ZERO program-cache miss growth
+    # (per-device warmup), and emits lane_failover_s = first injected
+    # fault → the victim session's first completed stop on the adopted
+    # lane. Same forced-host-platform topology posture as 7b.
+    # ------------------------------------------------------------------
+    def config7c():
+        from structured_light_for_3d_model_replication_tpu.config import (
+            ProjectorConfig as _PC,
+        )
+        from structured_light_for_3d_model_replication_tpu.hw import (
+            faults as hwfaults,
+        )
+        from structured_light_for_3d_model_replication_tpu.serve import (
+            ReconstructionService,
+            ServeConfig,
+        )
+        from structured_light_for_3d_model_replication_tpu.serve import (
+            lanes as lanes_mod,
+        )
+        from structured_light_for_3d_model_replication_tpu.stream import (
+            StreamParams,
+        )
+
+        n_local = len(jax.local_devices())
+        if n_local < 2:
+            _log(f"[7c] skipped: {n_local} local device(s) — force 8 "
+                 "with XLA_FLAGS=--xla_force_host_platform_device_count=8")
+            details["serve_lane_chaos"] = {
+                "skipped": f"{n_local} local device(s)"}
+            flush_details()
+            return
+
+        chaos_proj = _PC(width=160, height=96)
+        chaos_stack = np.asarray(patterns.pattern_stack(
+            chaos_proj.width, chaos_proj.height, chaos_proj.col_bits,
+            chaos_proj.row_bits, chaos_proj.brightness))
+        sh, sw = chaos_stack.shape[1], chaos_stack.shape[2]
+        platform = jax.devices()[0].platform
+        victim_label = f"{platform}:1"
+        plan = hwfaults.DeviceFaultPlan([hwfaults.DeviceFaultRule(
+            device=victim_label, kind="device_lost", after_launches=4)])
+        prev_env = os.environ.get(hwfaults.DEVICE_FAULTS_ENV)
+        os.environ[hwfaults.DEVICE_FAULTS_ENV] = plan.to_env()
+        svc = None
+        # One outer try: a start() failure or a failed arming assert
+        # must still drain whatever was constructed — leaked worker/
+        # watchdog threads would skew every later bench config.
+        try:
+            try:
+                cfg = ServeConfig(
+                    proj=chaos_proj, buckets=((sh, sw),),
+                    batch_sizes=(1, 2), linger_ms=5.0, queue_depth=32,
+                    workers=2, devices=2, content_cache=False,
+                    stream=StreamParams(preview_depth=5),
+                    device_probe_interval_s=300.0)
+                svc = ReconstructionService(cfg)
+                t0 = time.perf_counter()
+                svc.start()
+                warm_s = time.perf_counter() - t0
+                warmed_misses = svc.cache.stats()["misses"]
+            finally:
+                if prev_env is None:
+                    os.environ.pop(hwfaults.DEVICE_FAULTS_ENV, None)
+                else:
+                    os.environ[hwfaults.DEVICE_FAULTS_ENV] = prev_env
+            injector = svc.fault_injector
+            assert injector is not None, "SL_DEVICE_FAULTS did not arm"
+            # Sessions spread least-loaded: the second lands on the
+            # victim lane (device 1).
+            svc.create_session({"covis": False})
+            sid = svc.create_session({"covis": False})["session_id"]
+            victim = svc.sessions.get(sid)
+            assert victim.lane.label == victim_label, victim.lane
+            victim_index = victim.lane.index
+            acked: list = []
+            stop_jobs: list = []
+            # Offered load: one-shots + victim-session stops until the
+            # chip has died under the session and its stops flow on the
+            # adopted lane (bounded by n_jobs).
+            for i in range(24):
+                j = svc.submit_array(chaos_stack + np.uint8(1 + i % 7))
+                acked.append(j)
+                s = svc.submit_session_stop(
+                    sid, chaos_stack + np.uint8(1 + (i * 3) % 9))
+                acked.append(s)
+                stop_jobs.append(s)
+                assert s.wait(120.0), s.status_dict()
+                if svc.lanes.device_state(victim_label) \
+                        == lanes_mod.LANE_DEAD and i >= 12:
+                    break
+            for j in acked:
+                assert j.wait(120.0), j.status_dict()
+            lost = [j.status_dict() for j in acked
+                    if j.status != "done"]
+            # The zero-lost-acked-jobs bar.
+            assert not lost, lost[:3]
+            assert svc.lanes.device_state(victim_label) \
+                == lanes_mod.LANE_DEAD, "victim device never died"
+            assert victim.lane.label != victim_label, \
+                "sticky session did not re-pin"
+            # Zero program-cache miss growth across the failover: the
+            # adopted lane's programs were warmed at start.
+            cache = svc.cache.stats()
+            assert cache["misses"] == warmed_misses, cache
+            t_fault = injector.first_fault_t()
+            assert t_fault is not None
+            adopted = [s.finished_t for s in stop_jobs
+                       if s.status == "done"
+                       and s.finished_t is not None
+                       and s.finished_t > t_fault
+                       and (s.launch_retries > 0
+                            or s.lane != victim_index)]
+            assert adopted, "no stop completed on the adopted lane"
+            failover_s = min(adopted) - t_fault
+            snap = svc.registry.snapshot()
+            dead_total = sum(
+                snap.get("serve_device_dead_total", {}).values())
+            repins = sum(
+                snap.get("serve_lane_repins_total", {}).values())
+            details["serve_lane_chaos"] = {
+                "stack": f"{sh}x{sw}x{chaos_stack.shape[0]}",
+                "warmup_s": round(warm_s, 2),
+                "jobs_acked": len(acked),
+                "jobs_lost": len(lost),
+                "devices_dead": dead_total,
+                "session_repins": repins,
+                "faults_injected": len(injector.injected),
+                "lane_failover_s": round(failover_s, 4),
+            }
+            flush_details()
+            _log(f"[7c] lane failover {failover_s:.3f}s "
+                 f"({len(acked)} acked jobs, 0 lost, "
+                 f"{len(injector.injected)} faults injected)")
+            print(json.dumps({"metric": "lane_failover_s",
+                              "value": round(failover_s, 4),
+                              "unit": "s",
+                              "direction": "lower_is_better"}),
+                  flush=True)
+        finally:
+            if svc is not None:
+                svc.drain(timeout=60.0)
+
+    guarded("serve_lane_chaos", config7c)
 
     # ------------------------------------------------------------------
     # Config 9: durability soak — sustained offered load against a

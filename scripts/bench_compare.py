@@ -53,13 +53,14 @@ def higher_is_better(metric: str) -> bool:
     ratio) and capacity-shaped ``*_replicas`` lines (the
     /fleet/signals family — more ready replicas is healthier) invert —
     going UP is the improvement, going down the regression.
-    Latency-shaped fleet lines (``fleet_failover_s`` and the proactive
+    Latency-shaped fleet lines (``fleet_failover_s``, the proactive
     tier's ``fleet_proactive_repin_s`` — background adoption must get
-    FASTER), config [11]'s per-stop preview latency
-    (``tsdf_preview_s``), config [12]'s per-view render latency
-    (``render_view_s``), and count-shaped tenant/overload lines
-    (``*_rejected_total``, ``*_shed_total`` — shed work going up is a
-    regression) keep the lower-wins default."""
+    FASTER — and config [7c]'s ``lane_failover_s``, the device-loss
+    tier's fault-to-adopted-lane window), config [11]'s per-stop
+    preview latency (``tsdf_preview_s``), config [12]'s per-view
+    render latency (``render_view_s``), and count-shaped
+    tenant/overload lines (``*_rejected_total``, ``*_shed_total`` —
+    shed work going up is a regression) keep the lower-wins default."""
     return (metric.endswith("_per_s") or "_per_s_" in metric
             or metric.endswith("_psnr_db")
             or metric.endswith("_ratio")

@@ -151,6 +151,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "serve_tenant_* metrics")
     p.add_argument("--tenant-burst", type=int, default=d.tenant_burst,
                    help="per-tenant token-bucket burst headroom")
+    p.add_argument("--tenant-cost-weighted", action="store_true",
+                   help="weight the per-tenant token spend by stack "
+                        "MEGAPIXELS instead of 1-per-submit (a 4K scan "
+                        "costs ~8x a 1080p one; --tenant-rate becomes "
+                        "sustained megapixels/s)")
     p.add_argument("--router", action="store_true",
                    help="run the thin fleet FRONT ROUTER instead of a "
                         "replica: consistent-hash admission, sticky "
@@ -319,6 +324,7 @@ def main(argv=None) -> int:
         stream=stream,
         tenant_rate_per_s=args.tenant_rate,
         tenant_burst=args.tenant_burst,
+        tenant_cost_weighted=args.tenant_cost_weighted,
         replica_id=args.replica_id,
         peers=tuple(u.strip() for u in (args.peers or "").split(",")
                     if u.strip()),

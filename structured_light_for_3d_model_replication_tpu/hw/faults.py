@@ -31,7 +31,10 @@ so a chaos run can be photometrically realistic AND fault-injected.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
+import threading
+import time
 from collections import defaultdict
 from typing import Callable, Sequence
 
@@ -45,6 +48,12 @@ log = get_logger(__name__)
 CAMERA_FAULTS = ("timeout", "black", "saturated", "duplicate", "truncate")
 #: Turntable fault kinds understood by :class:`FlakyTurntable`.
 TURNTABLE_FAULTS = ("done_timeout", "stuck")
+#: Device (accelerator) fault kinds understood by :class:`FaultyDevice`.
+DEVICE_FAULTS = ("device_lost", "nan_output", "latency", "hang")
+#: Env var carrying a JSON :class:`DeviceFaultPlan` for subprocess
+#: replicas and the lane-chaos bench (the chaos harness sets it;
+#: production never does).
+DEVICE_FAULTS_ENV = "SL_DEVICE_FAULTS"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -303,6 +312,215 @@ class FlakyTurntable:
 
     def close(self) -> None:
         self.inner.close()
+
+
+# ---------------------------------------------------------------------------
+# Device (accelerator) fault injection — the serve tier's lane boundary
+# ---------------------------------------------------------------------------
+
+
+class DeviceLostError(RuntimeError):
+    """The launch's view of a dead chip: the runtime refused the program
+    because the device is gone (the ``DEVICE_LOST`` shape real backends
+    raise). The serve tier's lane-health escalation keys on this class
+    (plus a string sniff for real runtime errors, `serve/worker.py`)."""
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Device-loss classifier shared by the worker and the probe: the
+    injected :class:`DeviceLostError`, or a real runtime error whose
+    message carries the backend's device-loss vocabulary."""
+    if isinstance(exc, DeviceLostError):
+        return True
+    msg = str(exc).lower()
+    return "device_lost" in msg or "device lost" in msg \
+        or "device is gone" in msg
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceFaultRule:
+    """Faults for launches on devices whose label contains ``device``.
+
+    Launches count per device (the wrapper/injector owns the counter —
+    plans stay stateless, the :class:`FaultPlan` rule). The fault fires
+    on launch indices ``[after_launches, after_launches + count)``;
+    ``count = -1`` repeats forever — a genuinely dead chip no retry can
+    outlast. ``stall_s`` is the injected delay for ``latency`` (the
+    launch then proceeds) and ``hang`` (the launch stalls the worker's
+    heartbeat — the watchdog's wedge signal — then raises device-lost).
+    """
+
+    device: str
+    kind: str
+    after_launches: int = 0
+    count: int = -1
+    stall_s: float = 0.25
+
+    def fires(self, launch: int) -> bool:
+        if launch < self.after_launches:
+            return False
+        if self.count < 0:
+            return True
+        return launch < self.after_launches + self.count
+
+
+class DeviceFaultPlan:
+    """Ordered rule list; first rule whose ``device`` is a substring of
+    the lane's device label wins. Stateless — launch counting lives in
+    :class:`DeviceFaultInjector`, so one plan drives several runs (and
+    serializes to/from the ``SL_DEVICE_FAULTS`` env for subprocess
+    replicas, the :class:`~..serve.blobstore.BlobFaultPlan` idiom)."""
+
+    def __init__(self, rules: Sequence[DeviceFaultRule] = ()):
+        self.rules = list(rules)
+        for r in self.rules:
+            if r.kind not in DEVICE_FAULTS:
+                raise ValueError(f"unknown device fault kind {r.kind!r}")
+
+    def fault_for(self, device_label: str,
+                  launch: int) -> DeviceFaultRule | None:
+        for rule in self.rules:
+            if rule.device in device_label:
+                return rule if rule.fires(launch) else None
+        return None
+
+    # -- env round-trip (subprocess replicas / chaos bench) ------------
+
+    def to_env(self) -> str:
+        return json.dumps({"rules": [dataclasses.asdict(r)
+                                     for r in self.rules]})
+
+    @classmethod
+    def from_env(cls, env: str = DEVICE_FAULTS_ENV
+                 ) -> "DeviceFaultPlan | None":
+        spec = os.environ.get(env)
+        if not spec:
+            return None
+        try:
+            doc = json.loads(spec)
+        except ValueError as e:
+            log.error("ignoring malformed %s: %s", env, e)
+            return None
+        allowed = {f.name for f in dataclasses.fields(DeviceFaultRule)}
+        try:
+            rules = [DeviceFaultRule(
+                **{k: v for k, v in r.items() if k in allowed})
+                for r in doc.get("rules", [])]
+            return cls(rules)
+        except (TypeError, ValueError) as e:
+            log.error("ignoring malformed %s: %s", env, e)
+            return None
+
+    @classmethod
+    def seeded(cls, seed: int, devices: Sequence[str],
+               p_dead: float = 0.0, p_nan: float = 0.0,
+               after_launches: int = 0) -> "DeviceFaultPlan":
+        """Reproducible random campaign over device labels: each device
+        independently draws a permanent device-loss or NaN-output fault
+        (hw/faults determinism rule — same seed, same casualties)."""
+        rng = np.random.default_rng(seed)
+        rules = []
+        for d in devices:
+            u = float(rng.random())
+            if u < p_dead:
+                rules.append(DeviceFaultRule(
+                    device=d, kind="device_lost",
+                    after_launches=after_launches))
+            elif u < p_dead + p_nan:
+                rules.append(DeviceFaultRule(
+                    device=d, kind="nan_output",
+                    after_launches=after_launches))
+        return cls(rules)
+
+
+class DeviceFaultInjector:
+    """Per-process launch counters + fired-fault ledger over one plan.
+
+    ``injected`` logs every (monotonic t, device, launch index, kind)
+    that actually fired, so the lane-chaos gate can measure
+    ``lane_failover_s`` from the FIRST injection and assert the lane
+    health report records exactly the injected faults."""
+
+    def __init__(self, plan: DeviceFaultPlan, sleep=time.sleep):
+        self.plan = plan
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._launches: dict[str, int] = defaultdict(int)
+        self.injected: list[tuple[float, str, int, str]] = []
+
+    def next_fault(self, device_label: str) -> DeviceFaultRule | None:
+        """Count one launch on ``device_label``; the rule that fires for
+        it, if any (recorded in the ledger). Quarantine PROBES count as
+        launches too, deliberately: a dead device receives no worker
+        launches, so a count-limited (transient) outage could otherwise
+        never expire while quarantined — probe attempts are what walk
+        the fault window shut, and a probe a rule fires against IS an
+        injected fault in the ledger."""
+        with self._lock:
+            launch = self._launches[device_label]
+            self._launches[device_label] += 1
+            rule = self.plan.fault_for(device_label, launch)
+            if rule is not None:
+                self.injected.append((time.monotonic(), device_label,
+                                      launch, rule.kind))
+        if rule is not None:
+            log.debug("chaos: device fault %r injected (%s launch %d)",
+                      rule.kind, device_label, launch)
+        return rule
+
+    def first_fault_t(self) -> float | None:
+        """Monotonic stamp of the first fired fault (the lane-chaos
+        bench's ``lane_failover_s`` zero point), or None."""
+        with self._lock:
+            return self.injected[0][0] if self.injected else None
+
+    def fire_pre_launch(self, rule: DeviceFaultRule,
+                        device_label: str) -> None:
+        """The pre-launch side of a fired rule: stall and/or raise.
+        ``nan_output`` does nothing here (the launch must succeed so
+        the poisoned payload flows through the readback path)."""
+        if rule.kind in ("latency", "hang"):
+            self._sleep(rule.stall_s)
+        if rule.kind == "device_lost" or rule.kind == "hang":
+            raise DeviceLostError(
+                f"injected device loss on {device_label} "
+                f"(kind={rule.kind})")
+
+    @staticmethod
+    def poison_output(out):
+        """The post-launch side of ``nan_output``: the launch succeeded
+        but the chip returned garbage — every point lane becomes NaN
+        while validity still claims them good (exactly the payload the
+        SL_SANITIZE finite-check must catch at the readback
+        boundary)."""
+        import types
+
+        points = np.asarray(out.points, dtype=np.float32).copy()
+        points[...] = np.nan
+        return types.SimpleNamespace(points=points, colors=out.colors,
+                                     valid=out.valid)
+
+
+class FaultyDevice:
+    """Wraps one AOT executable at the lane boundary (`serve/worker.py`):
+    launches on the wrapped device consult the injector first, so a
+    seeded plan turns one chip of a healthy pool into a dead / stalling
+    / NaN-emitting one without touching the runtime."""
+
+    def __init__(self, compiled, device_label: str,
+                 injector: DeviceFaultInjector):
+        self.compiled = compiled
+        self.device_label = device_label
+        self.injector = injector
+
+    def __call__(self, *args):
+        rule = self.injector.next_fault(self.device_label)
+        if rule is not None:
+            self.injector.fire_pre_launch(rule, self.device_label)
+        out = self.compiled(*args)
+        if rule is not None and rule.kind == "nan_output":
+            out = self.injector.poison_output(out)
+        return out
 
 
 class FlakyChannel:

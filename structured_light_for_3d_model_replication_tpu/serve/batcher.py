@@ -101,6 +101,10 @@ class Batch:
     jobs: list
     size: int
     lane: "int | None" = None   # device-lane affinity the flush honored
+    # The ProgramKey the worker launched this batch through (stamped in
+    # DeviceWorker._process): failure handling needs to know whether
+    # the launch was lane-pinned or sharded cross-chip.
+    program_key: object = None
 
     @property
     def occupancy(self) -> int:
@@ -147,6 +151,12 @@ class BucketBatcher:
         # chip). Affine and free jobs never share a batch: they launch
         # through different executables.
         self._pending: dict[tuple, list] = {}
+        # Device-loss hook (serve/service.py): maps a job to the lane it
+        # should ride NOW — a stop whose session re-pinned after its
+        # device died must land in the adopting lane's buckets, not wait
+        # forever in a dead lane's. Applied at absorb time and by
+        # repin_pending(); None = affinity is taken as stamped.
+        self.lane_resolver = None  # callable(Job) -> int | None
 
     # ------------------------------------------------------------------
 
@@ -165,10 +175,49 @@ class BucketBatcher:
     # ------------------------------------------------------------------
 
     def _absorb(self, job: Job) -> None:
-        key = (job.lane, self.key_for(job))
+        bkey = self.key_for(job)
         with self._lock:
-            self._pending.setdefault(key, []).append(
+            # Resolve INSIDE the lock: repin_pending() re-keys under
+            # this lock, so a job resolved to a lane in its last
+            # healthy instant either lands before the re-key (and is
+            # re-keyed with the rest) or resolves after the death (and
+            # sees the dead lane) — never inserted-after-re-key into a
+            # bucket no worker will ever flush. The resolver takes the
+            # pool/session locks; that order (batcher → pool/session)
+            # matches repin_pending and is never reversed.
+            if self.lane_resolver is not None:
+                job.lane = self.lane_resolver(job)
+            self._pending.setdefault((job.lane, bkey), []).append(
                 (time.monotonic(), job))
+
+    def requeue(self, job: Job) -> None:
+        """Re-absorb a job whose batch died under it (the device-loss
+        cross-lane retry, serve/worker.py): the lane resolver re-routes
+        it to a surviving lane. Original enqueue order is NOT preserved
+        — the retry is new work from the batcher's point of view."""
+        self._absorb(job)
+
+    def repin_pending(self) -> int:
+        """Re-key every pending job through the lane resolver (the
+        device-dead path: jobs parked in a dead lane's buckets would
+        never flush — its workers are gone). Returns jobs moved."""
+        if self.lane_resolver is None:
+            return 0
+        moved = 0
+        with self._lock:
+            items = [(key, entry) for key, lst in self._pending.items()
+                     for entry in lst]
+            self._pending.clear()
+            for (old_lane, bkey), (t, job) in items:
+                lane = self.lane_resolver(job)
+                job.lane = lane
+                if lane != old_lane:
+                    moved += 1
+                self._pending.setdefault((lane, bkey), []).append(
+                    (t, job))
+            for lst in self._pending.values():
+                lst.sort(key=lambda e: e[0])
+        return moved
 
     def _flushable(self, now: float, force: bool,
                    lane: "int | None") -> tuple | None:

@@ -66,12 +66,14 @@ class GovernorParams:
     # Generous by design: a cold lazy compile (warmup off) is minutes on
     # a big program and must never be mistaken for a hang.
     wedge_timeout_s: float = 300.0
-    # Lifetime replacement budget: a systemic hang (e.g. a device wedged
-    # inside a compile that every fresh lane then blocks on) must not
-    # grow one abandoned thread per wedge_timeout_s forever. At the cap
-    # the watchdog stops replacing and journals an error — the process
-    # needs operator attention (or its orchestrator's liveness action),
-    # not more threads.
+    # PER-DEVICE replacement budget: a hang that eats every fresh lane
+    # on one chip must not grow one abandoned thread per wedge_timeout_s
+    # forever. Counted per device lane (a dead chip burning its budget
+    # used to disable the watchdog for every HEALTHY chip too — the
+    # global-counter bug): at the cap the lane is ESCALATED to
+    # device-dead when an escalate hook is wired (serve/lanes.py — the
+    # pool re-pins its sessions and the probe path owns revival), else
+    # the watchdog stops replacing that lane and journals an error.
     watchdog_max_restarts: int = 4
 
 
@@ -203,6 +205,11 @@ class OverloadGovernor:
         self._restarts = registry.counter(
             "serve_worker_restarts_total",
             "wedged workers replaced by the watchdog")
+        # Per-device replacement spend (the budget is per chip, not
+        # global — a dead device must not disable the watchdog for the
+        # healthy ones) + devices whose budget outcome already fired.
+        self._restarts_by: dict[str, int] = {}
+        self._budget_spent: set[str] = set()
         self._watch_stop = threading.Event()
         self._watch_thread: threading.Thread | None = None
 
@@ -283,14 +290,22 @@ class OverloadGovernor:
 
     # -- watchdog ----------------------------------------------------------
 
-    def start_watchdog(self, workers_fn, restart_fn) -> None:
+    def start_watchdog(self, workers_fn, restart_fn,
+                       escalate_fn=None) -> None:
         """``workers_fn()`` → current worker list; ``restart_fn(worker)``
-        replaces one wedged worker and returns its successor."""
+        replaces one wedged worker and returns its successor;
+        ``escalate_fn(worker)`` (optional — the device-loss tier) is
+        called INSTEAD of a replacement once a worker's device has spent
+        its per-device restart budget: same-device swapping a chip that
+        wedges every fresh lane is the failure mode this escalates to
+        device-dead. Returns True when it escalated (the watchdog stops
+        touching that device; the probe path owns revival)."""
         if not (self.params.enabled and self.params.watchdog):
             return
         self._watch_stop.clear()
         self._watch_thread = threading.Thread(
-            target=self._watch, args=(workers_fn, restart_fn),
+            target=self._watch, args=(workers_fn, restart_fn,
+                                      escalate_fn),
             name="serve-watchdog", daemon=True)
         self._watch_thread.start()
 
@@ -301,9 +316,22 @@ class OverloadGovernor:
             t.join(timeout=5.0)
             self._watch_thread = None
 
-    def _watch(self, workers_fn, restart_fn) -> None:
+    @staticmethod
+    def _budget_key(worker) -> str:
+        """Restart budgets are PER DEVICE (lanes sharing a chip share
+        its budget); lane-less workers fall back to their name."""
+        lane = getattr(worker, "lane", None)
+        return lane.label if lane is not None else worker.name
+
+    def reset_restart_budget(self, key: str) -> None:
+        """A revived device (probe path) gets a fresh watchdog budget —
+        its past wedges belonged to the failure the revival cleared."""
+        self._restarts_by.pop(key, None)
+        self._budget_spent.discard(key)
+
+    def _watch(self, workers_fn, restart_fn, escalate_fn=None) -> None:
         p = self.params
-        budget_spent = False
+        budget_spent = self._budget_spent
         while not self._watch_stop.wait(p.watchdog_interval_s):
             now = time.monotonic()
             for w in workers_fn():
@@ -311,19 +339,52 @@ class OverloadGovernor:
                 if not w.alive or getattr(w, "abandoned", False) \
                         or stalled <= p.wedge_timeout_s:
                     continue
-                if int(self._restarts.value) >= p.watchdog_max_restarts:
-                    if not budget_spent:
-                        budget_spent = True
+                key = self._budget_key(w)
+                if self._restarts_by.get(key, 0) \
+                        >= p.watchdog_max_restarts:
+                    if key in budget_spent:
+                        continue
+                    if escalate_fn is not None:
+                        # A chip that wedges every fresh lane is DEAD,
+                        # not unlucky: hand it to the lane-health tier
+                        # (re-pin + probe-revive) instead of swapping
+                        # onto the same device forever.
+                        budget_spent.add(key)
                         events.record(
-                            "watchdog_budget_exhausted", severity="error",
-                            message=f"{p.watchdog_max_restarts} worker "
-                                    "replacements spent and lanes still "
-                                    "wedge — systemic hang; not "
-                                    "replacing further",
-                            worker=w.name)
+                            "watchdog_device_escalated", severity="error",
+                            message=f"device {key} spent its "
+                                    f"{p.watchdog_max_restarts}-restart "
+                                    "budget and still wedges — "
+                                    "escalating to device-dead",
+                            worker=w.name, device=key)
+                        if self.store is not None:
+                            self.store.note("watchdog_device_escalated",
+                                            worker=w.name, device=key)
+                        try:
+                            escalate_fn(w)
+                        except Exception as e:
+                            # Abandon only on SUCCESS: a still-live
+                            # worker is what lets the next pass retry
+                            # the escalation (abandoned workers are
+                            # skipped at the top of the scan).
+                            log.error("device escalation failed: %s", e)
+                            budget_spent.discard(key)
+                            continue
+                        w.abandoned = True
+                        continue
+                    budget_spent.add(key)
+                    events.record(
+                        "watchdog_budget_exhausted", severity="error",
+                        message=f"{p.watchdog_max_restarts} worker "
+                                f"replacements spent on {key} and its "
+                                "lanes still wedge — not replacing "
+                                "further on this device (others keep "
+                                "their budgets)",
+                        worker=w.name, device=key)
                     continue
                 w.abandoned = True
                 self._restarts.inc()
+                self._restarts_by[key] = self._restarts_by.get(key, 0) + 1
                 events.record(
                     "worker_wedged", severity="error",
                     message=f"worker {w.name} made no progress for "
@@ -349,6 +410,7 @@ class OverloadGovernor:
             "breaker_open_s": (round(remaining, 2)
                                if remaining is not None else None),
             "worker_restarts": int(self._restarts.value),
+            "worker_restarts_by_device": dict(self._restarts_by),
             # Autoscaler signals (router /fleet/signals aggregates
             # these across replicas).
             "memory_pressure": round(self.memory_pressure(), 4),

@@ -168,6 +168,16 @@ class Job:
     # device (its jit programs were warmed there — migrating mid-scan
     # would compile).
     lane: int | None = dataclasses.field(default=None, repr=False)
+    # Device-loss retries: how many times this job's batch died under it
+    # with a device-class fault and was re-queued onto another lane
+    # (serve/worker.py). Bounded — past the pool's live-device count the
+    # job fails honestly instead of ping-ponging between sick chips.
+    launch_retries: int = dataclasses.field(default=0, repr=False)
+    # Deferred NaN attribution (serve/worker.py): the lane whose launch
+    # returned NaN under this job, pending the cross-lane retry's
+    # verdict — clean elsewhere convicts the chip, NaN elsewhere
+    # convicts the data.
+    nan_lane: str | None = dataclasses.field(default=None, repr=False)
 
     submitted_t: float = 0.0
     started_t: float | None = None
@@ -383,6 +393,16 @@ class AdmissionQueue:
     def depth(self) -> int:
         with self._lock:
             return len(self._heap)
+
+    def set_max_depth(self, max_depth: int) -> int:
+        """Re-bound the queue (the device-loss tier's degraded-capacity
+        honesty: a pool at N−1 chips advertises — and enforces — N−1
+        chips' worth of admission headroom). Already-admitted jobs above
+        a shrunken bound are NOT scrubbed (they were acked); the bound
+        re-engages as they drain. Returns the new bound."""
+        with self._lock:
+            self.max_depth = max(1, int(max_depth))
+            return self.max_depth
 
     @property
     def closed(self) -> bool:

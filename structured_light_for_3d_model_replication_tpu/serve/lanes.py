@@ -17,6 +17,16 @@ idle. This module gives the service its device dimension:
   affinity, so the session's jit programs (fuse, refine, preview —
   warmed per lane at replica start) never migrate mid-scan.
 
+Since the device-loss tier the pool also owns **lane health**: each
+distinct device carries a healthy → suspect → dead state machine with
+hysteresis (consecutive launch failures promote, mirroring the router's
+readyz-miss detector; only a successful probe revives a dead device),
+visible as ``serve_lane_state{device=}``. A dead transition fires the
+service's ``on_device_dead`` hook, sticky sessions re-pin to surviving
+lanes (``serve_lane_repins_total``), and the sharded big-bucket tier
+degrades its span down the 8→4→2→off ladder instead of launching over a
+dead mesh member (docs/MESHING.md § shard degrade).
+
 The pool is pure bookkeeping — no threads, no device I/O. Constructing
 one (without an explicit ``devices`` list) calls ``jax.local_devices()``,
 which initializes the backend: set platform/topology flags
@@ -28,12 +38,35 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 
+from ..utils import events, trace
 from ..utils.log import get_logger
 from .batcher import BucketKey
 from .cache import ProgramKey
 
 log = get_logger(__name__)
+
+#: Lane (device) health states, mild to terminal. The numeric values are
+#: the ``serve_lane_state{device=}`` gauge's encoding.
+LANE_HEALTHY, LANE_SUSPECT, LANE_DEAD = "healthy", "suspect", "dead"
+_STATE_VALUE = {LANE_HEALTHY: 0, LANE_SUSPECT: 1, LANE_DEAD: 2}
+
+
+class _DeviceHealth:
+    """Per-device failure hysteresis (the router's readyz-miss detector
+    shape applied to launch outcomes): ``suspect_failures`` consecutive
+    failures → suspect, ``dead_failures`` → dead; any success while not
+    dead resets to healthy. Dead is sticky — only an explicit revive
+    (the probe path) returns a device to service."""
+
+    __slots__ = ("state", "failures", "dead_since", "reason")
+
+    def __init__(self):
+        self.state = LANE_HEALTHY
+        self.failures = 0
+        self.dead_since: float | None = None
+        self.reason = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,7 +98,9 @@ class DeviceLanePool:
 
     def __init__(self, n_lanes: int = 1, max_devices: int | None = None,
                  shard_min_pixels: int | None = None,
-                 shard_devices: int = 0, devices=None):
+                 shard_devices: int = 0, devices=None,
+                 registry: "trace.MetricsRegistry | None" = None,
+                 suspect_failures: int = 2, dead_failures: int = 3):
         if devices is None:
             import jax
 
@@ -90,6 +125,33 @@ class DeviceLanePool:
         self._lock = threading.Lock()
         self._session_lane: dict[str, DeviceLane] = {}
         self._solve_meshes: dict[int, object] = {}
+        # -- lane health (device-loss tier) ----------------------------
+        self.registry = registry if registry is not None \
+            else trace.REGISTRY
+        self.suspect_failures = max(1, int(suspect_failures))
+        self.dead_failures = max(self.suspect_failures,
+                                 int(dead_failures))
+        # One health record per LANE DEVICE (lanes sharing a chip share
+        # its fate — a dead chip kills every lane pinned to it).
+        self._health: dict[str, _DeviceHealth] = {
+            ln.label: _DeviceHealth() for ln in self.lanes}
+        # Fired by a healthy→…→dead transition, OUTSIDE the pool lock
+        # (the service hooks its re-pin/worker-deactivation here; that
+        # work takes other locks and must not nest under ours).
+        self.on_device_dead = None  # callable(label) | None
+        self._state_gauge = {
+            label: self.registry.gauge(
+                "serve_lane_state",
+                "device-lane health (0 healthy, 1 suspect, 2 dead)",
+                device=label)
+            for label in self._health}
+        self._dead_total = self.registry.counter(
+            "serve_device_dead_total",
+            "devices declared dead by lane-health escalation")
+        self._repins = self.registry.counter(
+            "serve_lane_repins_total",
+            "sticky sessions re-pinned to a surviving lane after their "
+            "device died")
 
     # -- lanes ---------------------------------------------------------
 
@@ -99,6 +161,19 @@ class DeviceLanePool:
     @property
     def n_lanes(self) -> int:
         return len(self.lanes)
+
+    def lanes_on(self, label: str) -> list[DeviceLane]:
+        """Every lane pinned to the device ``label`` (the set the
+        service deactivates/revives together — a chip dies whole)."""
+        return [ln for ln in self.lanes if ln.label == label]
+
+    def device_by_label(self, label: str):
+        """The jax.Device behind a lane label, or None (the probe
+        path's lookup)."""
+        for d in self.devices:
+            if device_label(d) == label:
+                return d
+        return None
 
     @property
     def multi_device(self) -> bool:
@@ -116,19 +191,218 @@ class DeviceLanePool:
             seen.setdefault(lane.label, lane)
         return list(seen.values())
 
+    # -- lane health (device-loss tier) --------------------------------
+
+    def device_state(self, label: str) -> str:
+        with self._lock:
+            h = self._health.get(label)
+            return h.state if h is not None else LANE_HEALTHY
+
+    def live_devices(self) -> list[str]:
+        with self._lock:
+            return [d for d, h in self._health.items()
+                    if h.state != LANE_DEAD]
+
+    def dead_devices(self) -> list[str]:
+        with self._lock:
+            return [d for d, h in self._health.items()
+                    if h.state == LANE_DEAD]
+
+    def lane_alive(self, index: int) -> bool:
+        """True while the lane's DEVICE is not dead (suspect lanes keep
+        serving — hysteresis exists exactly so one flaky launch doesn't
+        strand a chip's sticky sessions)."""
+        if not (0 <= int(index) < len(self.lanes)):
+            return False
+        return self.device_state(self.lanes[int(index)].label) != LANE_DEAD
+
+    def _set_state(self, h: _DeviceHealth, label: str,
+                   state: str) -> None:
+        h.state = state
+        self._state_gauge[label].set(_STATE_VALUE[state])
+
+    def note_launch_ok(self, label: str) -> None:
+        """A clean launch on ``label``: resets the failure streak and
+        demotes suspect back to healthy. A DEAD device stays dead — only
+        the probe path revives it (a straggler batch completing after
+        the death call must not un-kill the chip under the re-pin)."""
+        with self._lock:
+            h = self._health.get(label)
+            if h is None or h.state == LANE_DEAD:
+                return
+            h.failures = 0
+            if h.state != LANE_HEALTHY:
+                self._set_state(h, label, LANE_HEALTHY)
+
+    def note_launch_failure(self, label: str, reason: str = "") -> str:
+        """A device-class launch failure on ``label``; returns the NEW
+        state. Consecutive failures walk healthy → suspect → dead
+        (``suspect_failures`` / ``dead_failures``); the dead transition
+        counts ``serve_device_dead_total`` and fires ``on_device_dead``
+        outside the pool lock."""
+        dead_now = False
+        with self._lock:
+            h = self._health.get(label)
+            if h is None:
+                h = self._health[label] = _DeviceHealth()
+                self._state_gauge.setdefault(label, self.registry.gauge(
+                    "serve_lane_state",
+                    "device-lane health (0 healthy, 1 suspect, 2 dead)",
+                    device=label))
+            if h.state == LANE_DEAD:
+                return LANE_DEAD
+            h.failures += 1
+            h.reason = reason
+            if h.failures >= self.dead_failures:
+                self._set_state(h, label, LANE_DEAD)
+                h.dead_since = time.monotonic()
+                dead_now = True
+            elif h.failures >= self.suspect_failures \
+                    and h.state == LANE_HEALTHY:
+                self._set_state(h, label, LANE_SUSPECT)
+                events.record("lane_suspect", severity="warning",
+                              device=label, reason=reason,
+                              failures=h.failures)
+            state = h.state
+        if dead_now:
+            self._dead_total.inc()
+            events.record("device_dead", severity="error", device=label,
+                          reason=reason,
+                          message=f"device {label} declared dead after "
+                                  f"{self.dead_failures} consecutive "
+                                  f"launch failures ({reason})")
+            log.error("device %s declared dead (%s)", label, reason)
+            cb = self.on_device_dead
+            if cb is not None:
+                cb(label)
+        return state
+
+    def mark_device_dead(self, label: str, reason: str = "") -> bool:
+        """Escalation entry (the watchdog's repeatedly-wedged-lane path):
+        declare ``label`` dead directly. True iff this call made the
+        transition (idempotent — a second caller is a no-op)."""
+        with self._lock:
+            h = self._health.get(label)
+            if h is None or h.state == LANE_DEAD:
+                return False
+            self._set_state(h, label, LANE_DEAD)
+            h.dead_since = time.monotonic()
+            h.reason = reason
+        self._dead_total.inc()
+        events.record("device_dead", severity="error", device=label,
+                      reason=reason,
+                      message=f"device {label} escalated to dead "
+                              f"({reason})")
+        log.error("device %s escalated to dead (%s)", label, reason)
+        cb = self.on_device_dead
+        if cb is not None:
+            cb(label)
+        return True
+
+    def revive_device(self, label: str) -> bool:
+        """The probe path's success: return a dead device to service
+        (healthy, streak cleared). True iff it was dead."""
+        with self._lock:
+            h = self._health.get(label)
+            if h is None or h.state != LANE_DEAD:
+                return False
+            h.failures = 0
+            h.dead_since = None
+            h.reason = ""
+            self._set_state(h, label, LANE_HEALTHY)
+        events.record("device_revived", severity="info", device=label)
+        log.info("device %s revived — rejoining the pool", label)
+        return True
+
+    def _healthy_lanes(self) -> list[DeviceLane]:
+        """Lanes on non-dead devices (callers hold self._lock)."""
+        return [ln for ln in self.lanes
+                if self._health.get(ln.label) is None
+                or self._health[ln.label].state != LANE_DEAD]
+
+    def retry_lane(self, exclude: str | None = None) -> DeviceLane | None:
+        """Least-loaded healthy lane (optionally excluding one device) —
+        the cross-lane retry target for a batch that died on its chip.
+        None when no healthy lane exists (single-device pool with its
+        chip dead: the caller fails the work honestly)."""
+        with self._lock:
+            lanes = [ln for ln in self._healthy_lanes()
+                     if exclude is None or ln.label != exclude]
+            if not lanes:
+                return None
+            load: dict[int, int] = {ln.index: 0 for ln in self.lanes}
+            for assigned in self._session_lane.values():
+                load[assigned.index] = load.get(assigned.index, 0) + 1
+            return min(lanes, key=lambda ln: (load[ln.index], ln.index))
+
+    def repin_sessions(self, dead_label: str) -> dict[str, DeviceLane]:
+        """Migrate every sticky session off ``dead_label`` onto
+        least-loaded surviving lanes; returns {session_id: new lane}.
+        Counts ``serve_lane_repins_total`` per migrated session. The
+        caller (service) updates the live ServeSession entries — their
+        per-device session programs were warmed at replica start, so
+        adoption is compile-free (asserted by the lane-chaos gate)."""
+        moved: dict[str, DeviceLane] = {}
+        with self._lock:
+            survivors = [ln for ln in self._healthy_lanes()
+                         if ln.label != dead_label]
+            if not survivors:
+                return moved
+            load: dict[int, int] = {ln.index: 0 for ln in survivors}
+            for sid, assigned in self._session_lane.items():
+                if assigned.index in load:
+                    load[assigned.index] += 1
+            for sid, assigned in list(self._session_lane.items()):
+                if assigned.label != dead_label:
+                    continue
+                lane = min(survivors,
+                           key=lambda ln: (load[ln.index], ln.index))
+                load[lane.index] += 1
+                self._session_lane[sid] = lane
+                moved[sid] = lane
+        for sid, lane in moved.items():
+            self._repins.inc()
+            events.record("session_lane_repin", severity="warning",
+                          session_id=sid, from_device=dead_label,
+                          to_device=lane.label)
+        return moved
+
     # -- program routing ----------------------------------------------
+
+    def effective_shard_devices(self) -> int:
+        """The span the sharded tier can honestly use RIGHT NOW: the
+        configured ``shard_devices``, halved down the 8→4→2 ladder while
+        any device in the program's span (``devices[:k]`` — the mesh the
+        cache stages over) is dead. Below 2 the tier is off (0): the
+        bucket degrades to a lane-pinned program on a surviving chip
+        rather than launching over a dead mesh member
+        (docs/MESHING.md § shard degrade)."""
+        k = self.shard_devices
+        with self._lock:
+            dead = {d for d, h in self._health.items()
+                    if h.state == LANE_DEAD}
+        if not dead:
+            return k
+        while k >= 2:
+            span = {device_label(d) for d in self.devices[:k]}
+            if not (span & dead):
+                return k
+            k //= 2
+        return 0
 
     def shards_for(self, key: BucketKey) -> int:
         """Shard count for a bucket: 0 (lane-pinned program) unless the
         sharded tier is enabled, spans >1 chip, the bucket meets the
         size threshold AND its row count splits evenly over the mesh
         (GSPMD would pad an uneven split; refusing keeps the dispatch
-        decision — and the warmed program set — exact)."""
-        if (self.shard_min_pixels is None or self.shard_devices < 2
+        decision — and the warmed program set — exact). With dead mesh
+        members the span degrades down the halving ladder first."""
+        shards = self.effective_shard_devices()
+        if (self.shard_min_pixels is None or shards < 2
                 or key.height * key.width < self.shard_min_pixels
-                or key.height % self.shard_devices):
+                or key.height % shards):
             return 0
-        return self.shard_devices
+        return shards
 
     def route(self, key: BucketKey, batch: int,
               lane: DeviceLane | None) -> ProgramKey:
@@ -165,15 +439,20 @@ class DeviceLanePool:
     def assign_session(self, session_id: str) -> DeviceLane:
         """Sticky placement: the least-loaded lane (fewest live
         sessions; ties break toward the lowest index — deterministic,
-        which the placement tests rely on). Idempotent per session."""
+        which the placement tests rely on). Idempotent per session.
+        Dead-device lanes are skipped — a degraded pool places every
+        new session on its surviving chips (falling back to all lanes
+        only in the every-device-dead degenerate, where the service is
+        not ready anyway)."""
         with self._lock:
             lane = self._session_lane.get(session_id)
             if lane is not None:
                 return lane
+            candidates = self._healthy_lanes() or self.lanes
             load = {ln.index: 0 for ln in self.lanes}
             for assigned in self._session_lane.values():
                 load[assigned.index] = load.get(assigned.index, 0) + 1
-            lane = min(self.lanes, key=lambda ln: (load[ln.index],
+            lane = min(candidates, key=lambda ln: (load[ln.index],
                                                    ln.index))
             self._session_lane[session_id] = lane
             return lane
@@ -193,12 +472,19 @@ class DeviceLanePool:
             per_lane: dict[int, int] = {ln.index: 0 for ln in self.lanes}
             for lane in self._session_lane.values():
                 per_lane[lane.index] = per_lane.get(lane.index, 0) + 1
+            states = {label: h.state for label, h in self._health.items()}
+            dead = sorted(d for d, s in states.items() if s == LANE_DEAD)
         return {
             "devices": [device_label(d) for d in self.devices],
             "lanes": [{"index": ln.index, "device": ln.label,
+                       "state": states.get(ln.label, LANE_HEALTHY),
                        "sessions": per_lane.get(ln.index, 0)}
                       for ln in self.lanes],
+            # Degraded-pool honesty (the /fleet/signals + /readyz
+            # surface): how many chips the pool is actually running on.
+            "devices_dead": dead,
+            "devices_live": len(states) - len(dead),
             "shard_min_pixels": self.shard_min_pixels,
-            "shard_devices": (self.shard_devices
+            "shard_devices": (self.effective_shard_devices()
                               if self.shard_min_pixels is not None else 0),
         }

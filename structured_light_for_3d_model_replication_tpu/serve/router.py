@@ -97,6 +97,7 @@ class PinBoard:
     its local memory), never routing."""
 
     PREFIX = "router/pins/"
+    JOB_PREFIX = "router/jobs/"
 
     def __init__(self, store: BlobStore, router_id: str):
         self.store = store
@@ -107,6 +108,77 @@ class PinBoard:
         safe = "".join(c for c in session_id
                        if c.isalnum() or c in "-_")
         return f"{self.PREFIX}{safe}.json"
+
+    def _job_key(self, job_id: str) -> str:
+        safe = "".join(c for c in job_id if c.isalnum() or c in "-_")
+        return f"{self.JOB_PREFIX}{safe}.json"
+
+    # -- job pins -------------------------------------------------------
+    #
+    # Jobs never MOVE (a job lives and dies on the replica that admitted
+    # it), so job records need none of the session records' generation
+    # machinery: last-writer-wins trivially because every writer writes
+    # the same placement. Sharing them is what lets a freshly restarted
+    # (or peer) router answer /status//result without probing the whole
+    # fleet — the ROADMAP open item. Records carry t_wall so the board
+    # sync can prune ones past their useful life (results are bounded
+    # registry entries replica-side anyway).
+
+    def write_job(self, job_id: str, url: str) -> None:
+        try:
+            rec = json.dumps({"url": url, "router": self.router_id,
+                              "t_wall": time.time()}).encode()
+            self.store.replace(self._job_key(job_id), rec)
+        except OSError as e:
+            self.write_failures += 1
+            log.warning("pin-board job write for %s failed: %s",
+                        job_id, e)
+
+    def read_job(self, job_id: str) -> str | None:
+        try:
+            data = self.store.get(self._job_key(job_id))
+        except OSError:
+            return None
+        if data is None:
+            return None
+        try:
+            return str(json.loads(data.decode())["url"])
+        except (ValueError, KeyError, UnicodeDecodeError):
+            return None  # torn record: ignore
+
+    def prune_jobs(self, ttl_s: float, max_records: int = 512) -> int:
+        """Drop job records older than ``ttl_s`` (board hygiene — the
+        replicas' bounded registries stopped answering for them long
+        ago). At most ``max_records`` are READ per sweep: each check
+        is a store GET, and this runs on the board-sync thread next to
+        session-pin reconciliation — an unbounded sweep over a
+        sustained-submit backlog would stall that thread for minutes
+        against a slow store (pruning is eventually-consistent by
+        design). Returns records dropped; store failures degrade
+        pruning only."""
+        dropped = 0
+        try:
+            keys = self.store.list(self.JOB_PREFIX)
+        except OSError:
+            return 0
+        cutoff = time.time() - ttl_s
+        for key in keys[:max(1, int(max_records))]:
+            if not key.endswith(".json"):
+                continue
+            try:
+                data = self.store.get(key)
+                doc = json.loads(data.decode()) if data is not None \
+                    else None
+            except (OSError, ValueError, UnicodeDecodeError):
+                continue
+            if doc is None or float(doc.get("t_wall", 0.0)) >= cutoff:
+                continue
+            try:
+                self.store.delete(key)
+                dropped += 1
+            except OSError:
+                continue
+        return dropped
 
     def write(self, session_id: str, url: str, gen: int) -> None:
         """Publish a pin UNLESS the board already holds a higher-ranked
@@ -227,6 +299,22 @@ class FleetRouter:
         # routers only needs to beat human/autoscaler reaction time,
         # and the write-through path keeps the board itself current.
         self.board_sync_interval_s = max(float(check_interval_s), 2.0)
+        # Job-pin board hygiene: records past this age are pruned by
+        # the board-sync thread (replica registries are bounded — a
+        # stale pin would just proxy to a 404 anyway). Pruning is a
+        # list+read sweep, so it runs far below the sync cadence.
+        self.job_pin_ttl_s = 3600.0
+        self._job_prune_interval_s = 600.0
+        self._job_prune_last = time.monotonic()
+        # Job-pin write BACKLOG: pin_job runs on the per-request
+        # handler thread, and a slow/hung pin store must never stall
+        # the hot submit path (the same hazard the board-sync thread
+        # already absorbs for session pins' reconciliation). Writes
+        # drain on that thread at its cadence; bounded — overflow
+        # drops the OLDEST pins, which merely fall back to the
+        # probe-the-fleet path after a router death.
+        self._job_pin_backlog: OrderedDict[str, str] = OrderedDict()
+        self._max_job_pin_backlog = 4096
         self._lock = threading.Lock()
         self._ready: dict[str, bool] = {u: False for u in urls}
         self._reasons: dict[str, str] = {}
@@ -381,6 +469,7 @@ class FleetRouter:
         re-assert any of our OWN records a racing lower-ranked replace
         clobbered on the board. Deletions win: a record absent from the
         board is never resurrected from local memory."""
+        self._flush_job_pins()
         board = self.pin_board.load()
         for sid, (url, gen, stamp) in board.items():
             self._merge_pin(sid, url, gen, stamp)
@@ -391,6 +480,10 @@ class FleetRouter:
             if rec is not None and stamp == self.router_id \
                     and (rec[1], rec[2]) < (gen, stamp):
                 self.pin_board.write(sid, url, gen)
+        now = time.monotonic()
+        if now - self._job_prune_last >= self._job_prune_interval_s:
+            self._job_prune_last = now
+            self.pin_board.prune_jobs(self.job_pin_ttl_s)
 
     def _board_watch(self) -> None:
         while not self._stop.wait(self.board_sync_interval_s):
@@ -538,7 +631,7 @@ class FleetRouter:
             snaps = {u: dict(s) for u, s in self._replica_stats.items()}
             ready = [u for u in self.replicas if self._ready.get(u)]
         queue_depth = queue_cap = sessions_live = lanes_total = 0
-        shed_total = 0
+        shed_total = devices_dead = 0
         workers = 0
         mem_frac = 0.0
         overload = 0
@@ -551,8 +644,14 @@ class FleetRouter:
             workers += int(s.get("workers_alive") or 0)
             sess = s.get("sessions") or {}
             sessions_live += int(sess.get("live") or 0)
-            lanes = (s.get("lanes") or {}).get("lanes") or []
-            lanes_total += len(lanes)
+            lane_stats = s.get("lanes") or {}
+            lanes = lane_stats.get("lanes") or []
+            dead = lane_stats.get("devices_dead") or []
+            # A dead chip's lanes are not capacity: the autoscaler must
+            # see the fleet as it runs, not as it was provisioned.
+            lanes_total += sum(1 for ln in lanes
+                               if ln.get("device") not in dead)
+            devices_dead += len(dead)
             gov = s.get("governor") or {}
             overload = max(overload, int(gov.get("level") or 0))
             mem_frac = max(mem_frac,
@@ -570,6 +669,7 @@ class FleetRouter:
             "sessions_live_total": sessions_live,
             "worker_lanes_total": workers,
             "device_lanes_total": lanes_total,
+            "devices_dead_total": devices_dead,
             "overload_level_max": overload,
             "memory_pressure_max": round(mem_frac, 4),
             "shed_total": shed_total,
@@ -662,10 +762,45 @@ class FleetRouter:
             self._jobs[job_id] = url
             while len(self._jobs) > self._max_job_pins:
                 self._jobs.popitem(last=False)
+            if self.pin_board is not None:
+                # Enqueue only: the board write is store I/O and this
+                # is the per-submit hot path — the board-sync thread
+                # drains the backlog (_flush_job_pins). Sharing the
+                # placement is what spares a restarted or peer router
+                # the probe-the-whole-fleet /status sweep.
+                self._job_pin_backlog[job_id] = url
+                while len(self._job_pin_backlog) \
+                        > self._max_job_pin_backlog:
+                    self._job_pin_backlog.popitem(last=False)
+
+    def _flush_job_pins(self) -> int:
+        """Drain the job-pin backlog to the board (board-sync thread;
+        also called directly by tests). Store failures are counted by
+        write_job and the pin simply isn't shared — routing never
+        depends on it."""
+        with self._lock:
+            pending = list(self._job_pin_backlog.items())
+            self._job_pin_backlog.clear()
+        for job_id, url in pending:
+            self.pin_board.write_job(job_id, url)
+        return len(pending)
 
     def job_url(self, job_id: str) -> str | None:
         with self._lock:
-            return self._jobs.get(job_id)
+            url = self._jobs.get(job_id)
+        if url is not None:
+            return url
+        if self.pin_board is not None:
+            # Local miss (router restart, or the job was admitted
+            # through a peer): believe the shared board before the
+            # caller falls back to probing every ready replica.
+            url = self.pin_board.read_job(job_id)
+            if url is not None:
+                with self._lock:
+                    self._jobs[job_id] = url
+                    while len(self._jobs) > self._max_job_pins:
+                        self._jobs.popitem(last=False)
+        return url
 
     def _merge_pin(self, session_id: str, url: str, gen: int,
                    stamp: str) -> bool:

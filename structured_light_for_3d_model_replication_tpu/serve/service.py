@@ -185,6 +185,22 @@ class ServeConfig:
     # hits are exempt (they cost the fleet nothing).
     tenant_rate_per_s: float = 0.0
     tenant_burst: int = 8
+    # Cost-weighted tenant spend (`tenants.stack_cost`): a token spend
+    # proportional to the stack's MEGAPIXELS instead of 1-per-submit —
+    # a 4K stack and a 240p stack stop costing the same. Rejections
+    # refund the exact cost spent (the refund-parity contract). The
+    # headers-time probe checks at the COST FLOOR (the body — and with
+    # it the true cost — hasn't been read yet, and probing higher
+    # would 429 cheap stacks a weighted admit accepts); the
+    # authoritative weighted spend happens at admission.
+    tenant_cost_weighted: bool = False
+    # -- device-loss tolerance (serve/lanes.py; SERVING.md failure
+    # matrix). A device declared dead (lane-health escalation or the
+    # watchdog's per-device budget) is probed with a tiny synthetic
+    # program at this cadence, doubling per miss up to the cap; a probe
+    # that answers re-warms the lane and returns it to the pool.
+    device_probe_interval_s: float = 5.0
+    device_probe_backoff_max_s: float = 60.0
 
 
 def synthetic_calib_provider(proj: ProjectorConfig):
@@ -304,12 +320,36 @@ class ReconstructionService:
             if config.tenant_rate_per_s > 0 else None)
         # Device-lane pool (serve/lanes.py): every worker lane is pinned
         # to one local device; sessions get sticky lanes; buckets past
-        # shard_min_pixels route to the cross-chip sharded tier.
+        # shard_min_pixels route to the cross-chip sharded tier. The
+        # pool also owns lane HEALTH — its device-dead transitions call
+        # back into _on_device_dead (cross-lane re-pin, worker
+        # deactivation, probe-revive scheduling).
         self.lanes = DeviceLanePool(
             n_lanes=max(1, config.workers),
             max_devices=config.devices,
             shard_min_pixels=config.shard_min_pixels,
-            shard_devices=config.shard_devices)
+            shard_devices=config.shard_devices,
+            registry=self.registry)
+        self.lanes.on_device_dead = self._on_device_dead
+        # Lane re-resolution at absorb time (device-loss tier): a stop
+        # whose session re-pinned must ride the adopting lane's buckets.
+        self.batcher.lane_resolver = self._resolve_lane
+        # Seeded device chaos (hw/faults.py): SL_DEVICE_FAULTS arms a
+        # FaultyDevice shim at every lane's launch boundary — how the
+        # chaos bench and the multichip-chaos CI gate kill a chip.
+        from ..hw import faults as hwfaults
+
+        plan = hwfaults.DeviceFaultPlan.from_env()
+        self.fault_injector = (hwfaults.DeviceFaultInjector(plan)
+                               if plan is not None else None)
+        if self.fault_injector is not None:
+            log.warning("device faults armed: %d rule(s)",
+                        len(plan.rules))
+        # Probe-revive bookkeeping: device label -> (backoff_s, due_t).
+        self._probe_plan: dict[str, tuple[float, float]] = {}
+        self._probe_stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        self._queue_depth0 = config.queue_depth
         self._workers_lock = threading.Lock()
         self._worker_seq = max(1, config.workers)
         self.workers = [self._make_worker(f"serve-worker-{i}",
@@ -355,7 +395,8 @@ class ReconstructionService:
                             name=name, governor=self.governor,
                             mesh_representation=self.config
                             .mesh_representation,
-                            lane=lane, lane_pool=self.lanes)
+                            lane=lane, lane_pool=self.lanes,
+                            fault_injector=self.fault_injector)
 
     def _restart_worker(self, wedged: DeviceWorker) -> DeviceWorker:
         """Watchdog callback: replace one wedged worker with a fresh
@@ -375,6 +416,235 @@ class ReconstructionService:
                             for w in self.workers]
         repl.start()
         return repl
+
+    # -- device-loss tolerance (serve/lanes.py; SERVING.md) ---------------
+
+    def _escalate_worker_device(self, worker: DeviceWorker) -> None:
+        """Watchdog escalation: a device whose per-device restart budget
+        is spent (every fresh lane wedges) is declared DEAD — the pool's
+        callback then re-pins its sessions and schedules the probe."""
+        if worker.lane is None:
+            return
+        self.lanes.mark_device_dead(worker.lane.label,
+                                    reason="watchdog budget exhausted")
+
+    def _resolve_lane(self, job: Job) -> int | None:
+        """Batcher lane hook: the lane a job should ride NOW. Session
+        stops follow their session's CURRENT sticky lane (it may have
+        re-pinned since the stop was submitted); anything stamped with
+        a dead lane re-routes to the least-loaded survivor."""
+        pool = self.lanes
+        if not pool.multi_device:
+            return job.lane
+        if job.session_id is not None and job.launch_retries == 0:
+            # Session affinity — EXCEPT for a job the device-loss path
+            # already re-laned: its explicit retry placement must win,
+            # or the resolver would bounce it straight back onto the
+            # sick (not-yet-dead) lane it just died on, burning the
+            # retry budget without ever reaching a survivor.
+            entry = self.sessions.peek(job.session_id)
+            if entry is not None and entry.lane is not None \
+                    and pool.lane_alive(entry.lane.index):
+                return entry.lane.index
+        if job.lane is None or pool.lane_alive(job.lane):
+            return job.lane
+        target = pool.retry_lane()
+        return target.index if target is not None else job.lane
+
+    def _lane_program_keys(self, lane) -> list:
+        """The ProgramKeys a worker on ``lane`` can dispatch to, over
+        the configured buckets × batch sizes — the single definition of
+        the warmed program set, shared by start()'s warmup and the
+        probe path's re-warm (divergence would silently re-introduce
+        post-revive compiles in the worker hot path)."""
+        keys = []
+        for h, w in self.config.buckets:
+            bkey = self._bucket_key(h, w)
+            for b in self.config.batch_sizes:
+                keys.append(self.lanes.route(bkey, int(b), lane))
+        return keys
+
+    def _lane_device_count(self) -> int:
+        return len(self.lanes.distinct_devices())
+
+    def _rescale_queue(self) -> None:
+        """Degraded-capacity honesty: the admission bound tracks the
+        live-device fraction, so /readyz, /fleet/signals and the 429
+        backpressure all describe the pool that actually exists."""
+        total = self._lane_device_count()
+        if total <= 1:
+            return
+        live = max(0, total - len(self.lanes.dead_devices()))
+        self.queue.set_max_depth(
+            max(1, round(self._queue_depth0 * max(1, live) / total)))
+        self._queue_gauge.set(self.queue.depth())
+
+    def _on_device_dead(self, label: str) -> None:
+        """The pool's dead-transition callback (worker or watchdog
+        thread; no locks held). Contain the chip: stop its workers
+        (their in-flight batch was already re-queued cross-lane), move
+        its sticky sessions to surviving lanes (compile-free — every
+        distinct lane device was session-warmed at start), re-key any
+        pending work, shrink the admission bound, and schedule the
+        probe-revive cycle."""
+        with self._workers_lock:
+            victims = [w for w in self.workers
+                       if w.lane is not None and w.lane.label == label]
+        for w in victims:
+            # abandoned: the watchdog must not "replace" a deactivated
+            # worker, and _revive_device's replacement scan must cover
+            # a victim still ALIVE at revival time (e.g. blocked inside
+            # a hung launch that outlives the quarantine) — skipping it
+            # would leave the revived lane permanently worker-less.
+            w.abandoned = True
+            w.request_stop()
+            w.abort()
+        moved = self.lanes.repin_sessions(label)
+        for sid, lane in moved.items():
+            entry = self.sessions.peek(sid)
+            if entry is not None:
+                # repin migrates the session's device-resident state
+                # too — committed arrays would otherwise keep pulling
+                # compute back to the dead chip.
+                entry.repin(lane)
+        repinned = self.batcher.repin_pending()
+        self._rescale_queue()
+        if self.store is not None:
+            self.store.note("device_dead", device=label,
+                            sessions_repinned=len(moved))
+        log.warning("device %s contained: %d worker(s) stopped, %d "
+                    "session(s) re-pinned, %d pending job(s) re-keyed",
+                    label, len(victims), len(moved), repinned)
+        cfg = self.config
+        self._probe_plan[label] = (
+            cfg.device_probe_interval_s,
+            time.monotonic() + cfg.device_probe_interval_s)
+        if not self._draining:
+            self._ensure_probe_thread()
+
+    def _ensure_probe_thread(self) -> None:
+        with self._workers_lock:
+            if self._probe_thread is not None \
+                    and self._probe_thread.is_alive():
+                # Benign with the exit handshake in _probe_loop: a
+                # thread seen alive here either already cleared
+                # _probe_thread (we spawn fresh) or will re-check
+                # dead_devices() under this same lock before exiting
+                # and keep looping for the device that just died.
+                return
+            self._probe_stop.clear()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="serve-device-probe",
+                daemon=True)
+            self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        """Quarantine probing: each dead device gets a tiny synthetic
+        launch at backoff cadence; success re-warms and revives the
+        lane. The thread exits when nothing is dead (restarted by the
+        next dead transition) — the exit re-checks under _workers_lock
+        so a concurrent dead transition can never slip between the
+        empty check and _ensure_probe_thread's is_alive() test and be
+        left with no probe cycle."""
+        tick = min(0.5, self.config.device_probe_interval_s / 2)
+        while not self._probe_stop.wait(max(0.05, tick)):
+            dead = self.lanes.dead_devices()
+            if not dead:
+                with self._workers_lock:
+                    if self.lanes.dead_devices():
+                        continue  # died between checks: keep probing
+                    self._probe_thread = None
+                    return
+            now = time.monotonic()
+            for label in dead:
+                backoff, due = self._probe_plan.get(
+                    label, (self.config.device_probe_interval_s, now))
+                if now < due:
+                    continue
+                ok = self._probe_device(label)
+                events.record("device_probe", severity="info",
+                              device=label, ok=ok,
+                              backoff_s=round(backoff, 2))
+                # The plan is dropped only on a COMPLETED revival: a
+                # probe that answered but whose re-warm failed keeps
+                # the device dead, and must keep its backoff too — a
+                # popped plan would retry probe + full re-warm every
+                # tick in a hot loop.
+                if ok and self._revive_device(label):
+                    self._probe_plan.pop(label, None)
+                else:
+                    backoff = min(
+                        backoff * 2,
+                        self.config.device_probe_backoff_max_s)
+                    self._probe_plan[label] = (backoff,
+                                               now + backoff)
+
+    def _probe_device(self, label: str) -> bool:
+        """One probe launch on a dead device, THROUGH the fault
+        boundary (a still-faulted chip must stay quarantined)."""
+        if self.fault_injector is not None:
+            # Counts as a launch on purpose (see next_fault): probes
+            # are what let a count-limited transient outage expire
+            # while the device is quarantined and worker-launch-free.
+            rule = self.fault_injector.next_fault(label)
+            if rule is not None and rule.kind != "latency":
+                # Any still-armed fault keeps the chip quarantined —
+                # including nan_output: the injector poisons WORKER
+                # launches, not this probe's arithmetic, so treating a
+                # NaN-emitting chip's probe as clean would revive it
+                # into an indefinite die/revive flap. (Real hardware
+                # needs no special case: whatever the sick chip
+                # actually returns hits the finite check below.)
+                return False
+        dev = self.lanes.device_by_label(label)
+        if dev is None:
+            return False
+        try:
+            import jax
+
+            x = jax.device_put(np.ones((8,), np.float32), dev)
+            out = np.asarray(x + np.float32(1.0))
+            return bool(np.isfinite(out).all())
+        except Exception as e:
+            log.debug("device probe %s failed: %s", label, e)
+            return False
+
+    def _revive_device(self, label: str) -> bool:
+        """Probe success: re-warm the lane's program set (cache hits
+        when still resident; honest counted compiles when the LRU
+        evicted them while dead), THEN rejoin — fresh workers, restored
+        admission bound, fresh watchdog budget. Sessions moved off the
+        device stay where they are; new sessions rebalance onto it.
+        True iff the device actually rejoined (a failed re-warm keeps
+        it dead and the caller keeps its probe backoff)."""
+        lanes = self.lanes.lanes_on(label)
+        if not lanes:
+            return False
+        try:
+            for k in self._lane_program_keys(lanes[0]):
+                if k.device == label:
+                    self.cache.get(k)
+        except Exception as e:
+            events.record("device_rewarm_failed", severity="error",
+                          device=label, message=str(e))
+            return False  # stays dead; the probe retries at backoff
+        self.lanes.revive_device(label)
+        self.governor.reset_restart_budget(label)
+        with self._workers_lock:
+            for lane in lanes:
+                for i, w in enumerate(self.workers):
+                    if w.lane is lane and (not w.alive
+                                           or getattr(w, "abandoned",
+                                                      False)):
+                        self._worker_seq += 1
+                        repl = self._make_worker(
+                            f"serve-worker-r{self._worker_seq}", lane)
+                        self.workers[i] = repl
+                        repl.start()
+        self._rescale_queue()
+        if self.store is not None:
+            self.store.note("device_revived", device=label)
+        return True
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -425,14 +695,11 @@ class ReconstructionService:
                 # zero-recompile steady state holds per chip.
                 t0 = time.monotonic()
                 pkeys, seen = [], set()
-                for h, w in self.config.buckets:
-                    bkey = self._bucket_key(h, w)
-                    for lane in self.lanes.distinct_devices():
-                        for b in self.config.batch_sizes:
-                            k = self.lanes.route(bkey, int(b), lane)
-                            if k not in seen:
-                                seen.add(k)
-                                pkeys.append(k)
+                for lane in self.lanes.distinct_devices():
+                    for k in self._lane_program_keys(lane):
+                        if k not in seen:
+                            seen.add(k)
+                            pkeys.append(k)
                 self._warmup_report = self.cache.warmup(
                     (), program_keys=pkeys)
                 log.info("warmup: %d programs in %.1fs",
@@ -479,7 +746,11 @@ class ReconstructionService:
         for w in self.workers:
             w.start()
         self.governor.start_watchdog(lambda: list(self.workers),
-                                     self._restart_worker)
+                                     self._restart_worker,
+                                     escalate_fn=(
+                                         self._escalate_worker_device
+                                         if self.lanes.multi_device
+                                         else None))
         self._started = True
         self._ready = True
         return self
@@ -491,6 +762,7 @@ class ReconstructionService:
         self._ready = False
         self.queue.close()
         self.governor.stop_watchdog()
+        self._probe_stop.set()
         for w in self.workers:
             w.request_stop()
         deadline = time.monotonic() + timeout
@@ -518,6 +790,7 @@ class ReconstructionService:
         self._draining = True
         self._ready = False
         self.governor.stop_watchdog()
+        self._probe_stop.set()
         for w in self.workers:
             w.abort()
         for w in self.workers:
@@ -758,6 +1031,18 @@ class ReconstructionService:
                 f"mesh{cfg.mesh_depth}/{cfg.mesh_representation}/"
                 f"{result_format}")
 
+    def _tenant_cost(self, stack: np.ndarray) -> float:
+        """Token spend for one admission: 1.0 historically, the stack's
+        megapixel cost under ``tenant_cost_weighted``
+        (`tenants.stack_cost` — a 4K stack spends ~8×, a 240p one
+        ~1/10th, so per-tenant budgets meter actual fleet burn)."""
+        if not self.config.tenant_cost_weighted:
+            return 1.0
+        from .tenants import stack_cost
+
+        _, h, w = stack.shape
+        return stack_cost(h, w)
+
     def submit_array(self, stack: np.ndarray, result_format: str = "ply",
                      priority="normal",
                      deadline_s: float | None = None,
@@ -813,8 +1098,9 @@ class ReconstructionService:
             # bucket for work that never ran — and a queue-full
             # rejection below refunds the token for the same reason.
             self.governor.admit(int(priority))
+            cost = self._tenant_cost(stack)
             if self.tenants is not None:
-                self.tenants.admit(tenant)
+                self.tenants.admit(tenant, cost=cost)
             job = Job(stack=stack, col_bits=cfg.proj.col_bits,
                       row_bits=cfg.proj.row_bits,
                       decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
@@ -837,7 +1123,8 @@ class ReconstructionService:
                 self.queue.submit(job)
             except JobRejected:
                 if self.tenants is not None:
-                    self.tenants.refund(tenant)  # nothing ran
+                    # Refund EXACTLY the weighted spend (refund parity).
+                    self.tenants.refund(tenant, cost=cost)
                 raise
             self._journal_job(job, stack)
             self._register(job)
@@ -965,8 +1252,9 @@ class ReconstructionService:
             # Governor before the tenant spend (same rationale as
             # submit_array: fleet-side refusals don't charge tenants).
             self.governor.admit(1)
+            cost = self._tenant_cost(stack)
             if self.tenants is not None:
-                self.tenants.admit(tenant)
+                self.tenants.admit(tenant, cost=cost)
             job = Job(stack=stack, col_bits=cfg.proj.col_bits,
                       row_bits=cfg.proj.row_bits,
                       decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
@@ -984,7 +1272,7 @@ class ReconstructionService:
                 self.queue.submit(job)
             except JobRejected:
                 if self.tenants is not None:
-                    self.tenants.refund(tenant)  # nothing ran
+                    self.tenants.refund(tenant, cost=cost)  # nothing ran
                 raise
             if self.store is not None:
                 # The accepted stop IS the session's recoverable state:
@@ -1328,7 +1616,17 @@ class ReconstructionService:
         `submit_array`/`submit_session_stop`."""
         try:
             if self.tenants is not None:
-                self.tenants.check(tenant)
+                # Under cost weighting the true cost is unknown until
+                # the body is read: probe at the COST FLOOR, so a cheap
+                # stack a weighted admit would accept is never 429'd
+                # at headers time (the probe stays advisory either
+                # way; the authoritative spend is the weighted admit).
+                from .tenants import MIN_STACK_COST
+
+                probe_cost = (MIN_STACK_COST
+                              if self.config.tenant_cost_weighted
+                              else 1.0)
+                self.tenants.check(tenant, cost=probe_cost)
             self.governor.admit(priority)
             self.queue.check_admission()
         except JobRejected:
@@ -1467,8 +1765,18 @@ class ReconstructionService:
             reasons.append("draining")
         if self._started and not any(w.alive for w in self.workers):
             reasons.append("no worker lanes alive")
-        return {"ready": self.ready, "reasons": reasons,
-                "replica_id": self.replica_id}
+        out = {"ready": self.ready, "reasons": reasons,
+               "replica_id": self.replica_id}
+        dead = self.lanes.dead_devices()
+        if dead:
+            # Degraded-but-ready honesty: the pool serves at N−1 chips.
+            # Routers keep sending (ready stays true while any lane
+            # lives); autoscalers read the shrunken capacity here and
+            # on /fleet/signals.
+            out["degraded"] = True
+            out["devices_dead"] = dead
+            out["queue_capacity"] = self.queue.max_depth
+        return out
 
     def metrics_text(self) -> str:
         self._queue_gauge.set(self.queue.depth())

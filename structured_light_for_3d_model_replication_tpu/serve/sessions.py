@@ -119,6 +119,22 @@ class ServeSession:
 
         return jax.default_device(self.lane.device)
 
+    def repin(self, lane) -> None:
+        """Move the session's sticky lane — the device-loss re-pin
+        (serve/lanes.py). The session's device-resident state (model
+        buffers, retained preps, preview grids) is UNCOMMITTED jax
+        arrays throughout (built from host arrays under the lane's
+        ``default_device`` context), so the next ingest/finalize under
+        the NEW lane's context transfers it lazily and hits the jit
+        programs warmed per device at replica start — an explicit
+        ``device_put`` here would mint COMMITTED arrays, whose distinct
+        sharding signature recompiles every warmed program (and on a
+        truly dead chip the copy-out would fail exactly like the
+        compute; total on-device data loss is the fleet handoff
+        replay's domain, docs/SERVING.md failure matrix)."""
+        with self.lock:
+            self.lane = lane
+
     def ingest(self, points, colors, valid, coverage=None,
                frame_shape=None) -> dict:
         """The job's ``decode_sink``: fuse one decoded stop. Runs on the
@@ -362,6 +378,12 @@ class SessionManager:
                 f"unknown session {session_id!r} (never created, "
                 "or evicted after finalize)")
         return entry
+
+    def peek(self, session_id: str) -> ServeSession | None:
+        """``get`` without the raise — the device-loss re-pin and lane
+        resolution paths probe sessions that may have ended."""
+        with self._lock:
+            return self._sessions.get(session_id)
 
     def delete(self, session_id: str) -> None:
         with self._lock:

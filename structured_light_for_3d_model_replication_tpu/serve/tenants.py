@@ -46,8 +46,23 @@ log = get_logger(__name__)
 #: The tenant every unlabelled request bills to.
 DEFAULT_TENANT = "anon"
 
+#: Floor on a cost-weighted spend: even a thumbnail stack pays
+#: something (a zero-cost admission would make the quota a no-op for
+#: tiny-stack floods, the exact abuse quotas exist for).
+MIN_STACK_COST = 0.125
+
 _ALLOWED = set("abcdefghijklmnopqrstuvwxyz"
                "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-")
+
+
+def stack_cost(height: int, width: int) -> float:
+    """Cost-weighted token spend for one capture stack: its MEGAPIXELS
+    (floored at :data:`MIN_STACK_COST`), so a 1080p stack (~2.07 MP)
+    spends ~2 tokens where a 240p one spends the 0.125 floor —
+    ``rate_per_s`` becomes sustained megapixels/s per tenant instead of
+    submits/s. Refunds must pass the SAME cost back
+    (:meth:`TenantQuotas.refund` — the refund-parity contract)."""
+    return max(MIN_STACK_COST, (int(height) * int(width)) / 1.0e6)
 
 
 def sanitize_tenant(raw: str | None) -> str:
@@ -117,33 +132,43 @@ class TenantQuotas:
             b[1] = now
         return b
 
-    def admit(self, tenant: str | None) -> str:
-        """Spend one token for ``tenant`` (sanitized; returned so the
-        caller can stamp the job). Raises :class:`TenantQuotaError`
-        when the bucket is empty."""
-        return self._admit(tenant, spend=True)
+    def admit(self, tenant: str | None, cost: float = 1.0) -> str:
+        """Spend ``cost`` tokens for ``tenant`` (sanitized; returned so
+        the caller can stamp the job). ``cost`` defaults to the
+        historical 1-per-submit; cost-weighted services pass
+        :func:`stack_cost` so spend tracks megapixels. Raises
+        :class:`TenantQuotaError` when the bucket can't cover it."""
+        return self._admit(tenant, spend=True, cost=cost)
 
-    def check(self, tenant: str | None) -> str:
+    def check(self, tenant: str | None, cost: float = 1.0) -> str:
         """The refusal :meth:`admit` WOULD raise right now, without
         spending a token — the HTTP layer's headers-time probe (reject
         an over-budget tenant before buffering its ~95 MB body; the
-        authoritative spend happens at the real admission). Advisory:
-        counts only rejections."""
-        return self._admit(tenant, spend=False)
+        authoritative spend happens at the real admission, where the
+        weighted cost is known). Advisory: counts only rejections."""
+        return self._admit(tenant, spend=False, cost=cost)
 
-    def _admit(self, tenant: str | None, spend: bool) -> str:
+    def _need(self, cost: float) -> float:
+        # Capped at burst: a stack costing more than the whole bucket
+        # must still be admittable at full burst, else it is rejected
+        # forever no matter how patient the tenant.
+        return min(float(self.burst), max(MIN_STACK_COST, float(cost)))
+
+    def _admit(self, tenant: str | None, spend: bool,
+               cost: float = 1.0) -> str:
         tenant = sanitize_tenant(tenant)
+        need = self._need(cost)
         now = self._clock()
         with self._lock:
             b = self._bucket(tenant, now)
-            if b[0] >= 1.0:
+            if b[0] >= need:
                 if spend:
-                    b[0] -= 1.0
+                    b[0] -= need
                 admitted = True
                 wait = 0.0
             else:
                 admitted = False
-                wait = (1.0 - b[0]) / self.rate_per_s
+                wait = (need - b[0]) / self.rate_per_s
         if admitted:
             if spend:
                 self._admitted(tenant).inc()
@@ -151,19 +176,22 @@ class TenantQuotas:
         self._rejected(tenant).inc()
         raise TenantQuotaError(tenant, max(0.05, wait))
 
-    def refund(self, tenant: str | None) -> None:
-        """Return one token (capped at burst): the admission a token
-        was spent on was refused FURTHER DOWN the gate chain (queue
-        full, session registry full) — nothing ran, so the tenant's
-        budget must not be charged. The ``serve_tenant_admitted_total``
-        counter keeps token-SPEND semantics (monotonic counters can't
-        decrement); a refunded spend shows up as a paired queue-level
-        rejection on the same scrape."""
+    def refund(self, tenant: str | None, cost: float = 1.0) -> None:
+        """Return the spend (capped at burst): the admission tokens were
+        spent on was refused FURTHER DOWN the gate chain (queue full,
+        session registry full) — nothing ran, so the tenant's budget
+        must not be charged. ``cost`` must be the SAME value the paired
+        :meth:`admit` spent (refund parity — asserted in tests). The
+        ``serve_tenant_admitted_total`` counter keeps token-SPEND
+        semantics (monotonic counters can't decrement); a refunded
+        spend shows up as a paired queue-level rejection on the same
+        scrape."""
         tenant = sanitize_tenant(tenant)
+        need = self._need(cost)
         with self._lock:
             b = self._buckets.get(tenant)
             if b is not None:
-                b[0] = min(float(self.burst), b[0] + 1.0)
+                b[0] = min(float(self.burst), b[0] + need)
 
     def stats(self) -> dict:
         with self._lock:

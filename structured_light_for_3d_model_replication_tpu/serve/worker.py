@@ -24,14 +24,31 @@ import time
 import numpy as np
 
 from ..health import QualityGates, ScanFault, StopQualityError
+from ..hw import faults as hwfaults
 from ..io.ply import PointCloud, write_ply
 from ..io.stl import write_stl
 from ..utils import events, sanitize, trace
 from ..utils.log import get_logger
 from .batcher import Batch, BucketBatcher
 from .cache import ProgramCache, ProgramKey
+from .jobs import DONE, FAILED
 
 log = get_logger(__name__)
+
+
+class DeviceOutputError(ScanFault):
+    """A launch SUCCEEDED but its valid-masked payload is non-finite.
+    Ambiguous on one observation — a sick chip emitting garbage OR a
+    degenerate stack tripping a decode corner — so attribution is
+    DEFERRED to the cross-lane retry's verdict: clean on another lane
+    convicts the chip (feeds LANE health, never the whole-service
+    breaker — one NaN-emitting chip must degrade itself, not shed
+    fleet admissions), a second NaN elsewhere convicts the data (the
+    job fails with the historical per-job containment semantics, and
+    no lane is blamed — a poisoned upload must not walk healthy
+    devices to dead). Detected only under SL_SANITIZE on multi-device
+    pools; single-device services keep the historical per-job
+    assert_finite containment."""
 
 
 def _ply_bytes(cloud: PointCloud) -> bytes:
@@ -73,7 +90,7 @@ class DeviceWorker:
                  tracer: "trace.Tracer | None" = None,
                  name: str = "serve-worker",
                  governor=None, mesh_representation: str = "poisson",
-                 lane=None, lane_pool=None):
+                 lane=None, lane_pool=None, fault_injector=None):
         self.batcher = batcher
         self.cache = cache
         self.gates = gates
@@ -86,6 +103,10 @@ class DeviceWorker:
         self.governor = governor
         self.lane = lane                # DeviceLane | None
         self.lane_pool = lane_pool      # DeviceLanePool | None
+        # Seeded device chaos (hw/faults.DeviceFaultInjector, armed via
+        # SL_DEVICE_FAULTS): launches on this lane go through the
+        # FaultyDevice shim. None in production.
+        self.fault_injector = fault_injector
         self.name = name
         # Heartbeat: stamped every loop iteration. While the thread is
         # stuck inside a launch it goes stale — the watchdog's wedge
@@ -171,21 +192,101 @@ class DeviceWorker:
                 if self.governor is not None and not contained:
                     self.governor.note_worker_ok()
             except Exception as e:
-                # Batch-scoped failure (compile, launch, transfer): every
-                # job in it fails with the fault payload; the worker — and
-                # with it the process — keeps serving. The governor's
-                # breaker counts it: enough of these in a window means
-                # the device lane itself is sick.
-                log.warning("batch %s failed: %s", batch.key.label(), e)
-                events.record(
-                    "batch_failed", severity="error", message=str(e),
-                    program=batch.key.label(), exc_type=type(e).__name__,
-                    jobs=",".join(j.job_id for j in batch.jobs))
-                for job in batch.jobs:
-                    with events.context(job_id=job.job_id):
-                        job.fail(e)
-                if self.governor is not None:
-                    self.governor.note_worker_failure()
+                self._handle_batch_failure(batch, e)
+
+    def _handle_batch_failure(self, batch: Batch, e: Exception) -> None:
+        """Batch-scoped failure (compile, launch, transfer — or an
+        injected/real device loss). Device-class faults feed LANE health
+        (serve/lanes.py: healthy→suspect→dead escalation) and their jobs
+        are RE-QUEUED onto a surviving lane instead of failed — a dead
+        chip must cost latency, never acked work. Anything else keeps
+        the historical containment: every job fails with the fault
+        payload, and the governor's breaker counts it."""
+        log.warning("batch %s failed: %s", batch.key.label(), e)
+        device_fault = (isinstance(e, DeviceOutputError)
+                        or hwfaults.is_device_loss(e))
+        key = getattr(batch, "program_key", None)
+        sharded = key is not None and bool(key.shards)
+        label = self.lane.label if self.lane is not None else None
+        events.record(
+            "batch_failed", severity="error", message=str(e),
+            program=batch.key.label(), exc_type=type(e).__name__,
+            device=label or "default", device_fault=device_fault,
+            sharded=sharded,
+            jobs=",".join(j.job_id for j in batch.jobs))
+        nan_fault = isinstance(e, DeviceOutputError)
+        if device_fault and self.lane_pool is not None \
+                and label is not None and not sharded and not nan_fault:
+            # Lane health hears only LANE-PINNED launches: a sharded
+            # program spans many chips, and we cannot tell WHICH mesh
+            # member died from here — blaming the driving worker's own
+            # (healthy) lane would kill the wrong chip while the dead
+            # member stayed "healthy" and the span never degraded. The
+            # dead member's own lane launches are the detection path
+            # (one lane per chip is the recommended topology); the
+            # sharded batch's jobs still retry below and re-dispatch
+            # through whatever span shards_for answers then. NaN
+            # faults defer attribution further (below): the fault
+            # could live in the DATA, and only the cross-lane retry's
+            # outcome disambiguates.
+            self.lane_pool.note_launch_failure(label,
+                                               reason="device_lost")
+        failed = 0
+        for job in batch.jobs:
+            if device_fault and nan_fault and not sharded \
+                    and getattr(job, "nan_lane", None) is not None:
+                # Second NaN for this job, on a DIFFERENT lane: the
+                # NaN follows the JOB, not the chip — a degenerate
+                # stack tripping a decode/triangulate corner. Fail it
+                # per the historical containment (below) and blame no
+                # lane: without this, one poisoned upload retried a
+                # few times would walk every healthy device to dead.
+                pass
+            elif device_fault and self._retry_cross_lane(job, label):
+                if nan_fault and label is not None:
+                    # Deferred attribution: remember where the NaN
+                    # happened; a CLEAN completion on another lane
+                    # confirms the chip (fed in _process), a second
+                    # NaN elsewhere convicts the data (above).
+                    job.nan_lane = label
+                continue
+            failed += 1
+            with events.context(job_id=job.job_id):
+                job.fail(e)
+        # The breaker hears only batches that actually COST jobs: a
+        # device-class fault whose work was absorbed by surviving lanes
+        # is the lane escalation's problem, not grounds to shed
+        # admissions fleet-wide. (On a single-device pool nothing can
+        # absorb it, every job fails, and the breaker opens — the
+        # historical protection.)
+        if failed and self.governor is not None:
+            self.governor.note_worker_failure()
+
+    def _retry_cross_lane(self, job, exclude_label: str | None) -> bool:
+        """Re-queue one job from a device-faulted batch onto a surviving
+        lane. False (→ the caller fails the job honestly) when the pool
+        has no healthy lane off this device, the retry budget is spent,
+        or the job is already terminal (deadline scrub race)."""
+        pool = self.lane_pool
+        if pool is None or not pool.multi_device:
+            return False
+        if job.status in (DONE, FAILED):
+            return True  # terminal already: nothing to fail OR retry
+        if job.launch_retries >= max(2, len(pool.devices)):
+            return False
+        target = pool.retry_lane(exclude=exclude_label)
+        if target is None:
+            return False
+        job.launch_retries += 1
+        # Pin the retry to the surviving lane (the service's lane
+        # resolver may re-route a session stop to its session's current
+        # sticky lane at absorb time).
+        job.lane = target.index
+        events.record("job_lane_retry", severity="warning",
+                      job_id=job.job_id, from_device=exclude_label,
+                      to_device=target.label, retry=job.launch_retries)
+        self.batcher.requeue(job)
+        return True
 
     # ------------------------------------------------------------------
 
@@ -205,10 +306,22 @@ class DeviceWorker:
             key = self.lane_pool.route(batch.key, batch.size, self.lane)
         else:
             key = ProgramKey(bucket=batch.key, batch=batch.size)
+        # Stashed for _handle_batch_failure: a fault in a SHARDED
+        # launch must not be attributed to this worker's own lane
+        # device (route() may answer differently after a degrade).
+        batch.program_key = key
         contained = False
         with self.tracer.span("serve.batch", program=key.label(),
                               occupancy=batch.occupancy):
             compiled = self.cache.get(key)
+            if self.fault_injector is not None and self.lane is not None \
+                    and not key.shards:
+                # Seeded device chaos (hw/faults.py): the lane boundary
+                # is where a dead/NaN-emitting chip manifests — the
+                # sharded cross-chip tier degrades via the pool's lane
+                # health instead (docs/MESHING.md § shard degrade).
+                compiled = hwfaults.FaultyDevice(
+                    compiled, self.lane.label, self.fault_injector)
             calib = self.cache.placed_calib(key)
             with self.tracer.span("launch"):  # path: serve.batch.launch
                 out = compiled(self.cache.stage(key, batch.stacked()),
@@ -218,6 +331,44 @@ class DeviceWorker:
                 points = np.asarray(out.points)
                 colors = np.asarray(out.colors)
                 valid = np.asarray(out.valid)
+            if sanitize.enabled() and self.lane_pool is not None \
+                    and self.lane is not None \
+                    and self.lane_pool.multi_device:
+                # Device-output integrity at the READBACK boundary: a
+                # chip claiming validity over non-finite points is a
+                # device fault — escalate the lane and retry the batch
+                # on a survivor (DeviceOutputError → device-class path
+                # in _handle_batch_failure), instead of containing it
+                # per job as a client-data problem.
+                masked = points[valid.astype(bool)]
+                if masked.size and not np.isfinite(masked).all():
+                    raise DeviceOutputError(
+                        f"launch on {self.lane.label} returned "
+                        "non-finite points under a claimed-valid mask "
+                        "— NaN-emitting device output")
+            # Lane health hears the clean LAUNCH here (before the
+            # postprocess, whose per-job failures are not the chip's
+            # fault): the failure streak resets the moment the device
+            # answers with sane output — and before the jobs turn
+            # terminal, so a caller observing a done job observes the
+            # healthy lane too. Sharded launches are excluded both
+            # ways (see _handle_batch_failure): a cross-chip success
+            # is not evidence about THIS lane's chip and must not
+            # reset a genuine lane-pinned failure streak.
+            if self.lane_pool is not None and self.lane is not None \
+                    and not key.shards:
+                self.lane_pool.note_launch_ok(self.lane.label)
+                # NaN verdicts (deferred from _handle_batch_failure):
+                # this batch decoded CLEAN here, so a job that NaN'd on
+                # another lane convicts THAT chip — the same data on a
+                # healthy device is fine.
+                for job in batch.jobs:
+                    nan_lane = getattr(job, "nan_lane", None)
+                    if nan_lane is not None \
+                            and nan_lane != self.lane.label:
+                        job.nan_lane = None
+                        self.lane_pool.note_launch_failure(
+                            nan_lane, reason="nan_output")
             self._batches.inc()
             self._occupancy.observe(batch.occupancy)
             self._padded.inc(batch.size - batch.occupancy)

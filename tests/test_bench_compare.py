@@ -209,6 +209,9 @@ def test_fleet_headline_lines_and_direction(tmp_path, capsys):
     lines, and --strict judges each with its own direction."""
     assert bench_compare.higher_is_better("fleet_scans_per_s")
     assert not bench_compare.higher_is_better("fleet_failover_s")
+    # Config [7c]'s device-loss failover window: latency-shaped, the
+    # fault-to-adopted-lane time must get FASTER round over round.
+    assert not bench_compare.higher_is_better("lane_failover_s")
     tail = "\n".join([
         _headline("full_360_scan_to_mesh_s", 5.9),
         _headline("soak_scans_per_s", 8.0),

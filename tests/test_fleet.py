@@ -1279,3 +1279,135 @@ def test_fleet_chaos_gate(tmp_path, serve_stack, serve_ring):
         for proc in procs.values():
             if proc.poll() is None:
                 proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 satellites: cost-weighted tenant quotas, job-pin board sharing
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_cost_weighted_spend_and_refund_parity():
+    """Cost-weighted quotas: token spend tracks stack megapixels, a
+    gate-chain rejection refunds EXACTLY the weighted spend (refund
+    parity), and an over-burst stack stays admittable at full burst."""
+    from structured_light_for_3d_model_replication_tpu.serve.tenants \
+        import MIN_STACK_COST, TenantQuotaError, TenantQuotas, stack_cost
+
+    # Megapixel costing with the thumbnail floor.
+    assert stack_cost(1080, 1920) == pytest.approx(2.0736)
+    assert stack_cost(240, 320) == MIN_STACK_COST
+    assert stack_cost(2160, 3840) == pytest.approx(8.2944)
+
+    clock = [0.0]
+    q = TenantQuotas(rate_per_s=1.0, burst=4,
+                     registry=trace.MetricsRegistry(),
+                     clock=lambda: clock[0])
+    # Spend 2.5 tokens, then refund the SAME cost: the bucket returns
+    # bit-exactly to its pre-admission level.
+    q.admit("t", cost=2.5)
+    assert q.stats()["tokens"]["t"] == pytest.approx(1.5)
+    q.refund("t", cost=2.5)
+    assert q.stats()["tokens"]["t"] == pytest.approx(4.0)
+    # A 4K-sized cost drains most of the burst; the next one is refused
+    # with the exact refill wait for the WEIGHTED need.
+    big = 3.0
+    q.admit("t", cost=big)
+    with pytest.raises(TenantQuotaError) as exc:
+        q.admit("t", cost=big)
+    assert exc.value.retry_after_s == pytest.approx((big - 1.0) / 1.0)
+    # An over-burst cost caps at burst: waiting a full refill admits it
+    # (never rejected-forever).
+    clock[0] += 10.0
+    q.admit("t", cost=99.0)
+    assert q.stats()["tokens"]["t"] == pytest.approx(0.0)
+    # The non-spending probe uses the same weighted need.
+    clock[0] += 1.0
+    q.check("t", cost=1.0)
+    assert q.stats()["tokens"]["t"] == pytest.approx(1.0)
+
+
+def test_tenant_cost_weighted_service_refund_on_queue_reject(serve_stack):
+    """Service-level refund parity: a queue-full rejection after a
+    cost-weighted spend returns the whole weighted cost, so the tenant
+    can re-submit the identical stack the moment a slot frees."""
+    from structured_light_for_3d_model_replication_tpu.serve.jobs import (
+        QueueFullError,
+    )
+    from structured_light_for_3d_model_replication_tpu.serve.tenants \
+        import stack_cost
+
+    svc = ReconstructionService(_config(
+        tenant_rate_per_s=0.001, tenant_burst=8, content_cache=False,
+        tenant_cost_weighted=True))
+    # (not started: admission-side behavior only — jobs just queue)
+    _, h, w = serve_stack.shape
+    cost = stack_cost(h, w)
+    for i in range(16):                       # fill the 16-deep queue
+        svc.submit_array(serve_stack + np.uint8(i), tenant="t")
+    tokens_before = svc.tenants.stats()["tokens"]["t"]
+    assert tokens_before == pytest.approx(8.0 - 16 * cost)
+    with pytest.raises(QueueFullError):
+        svc.submit_array(serve_stack + np.uint8(40), tenant="t")
+    # Refund parity: the failed admission cost the tenant NOTHING.
+    assert svc.tenants.stats()["tokens"]["t"] == \
+        pytest.approx(tokens_before)
+
+
+def test_job_pins_shared_on_the_board(tmp_path):
+    """Job-pin sharing (ROADMAP item): a router writes job placements
+    through to the pin board, so a restarted/peer router answers
+    /status//result routing from the board instead of probing the whole
+    fleet; stale records prune by TTL."""
+    from structured_light_for_3d_model_replication_tpu.serve.blobstore \
+        import open_blob_store
+    from structured_light_for_3d_model_replication_tpu.serve.router \
+        import PinBoard
+
+    store = open_blob_store(str(tmp_path / "board"))
+    rA = FleetRouter(["http://127.0.0.1:1"], check_interval_s=999.0,
+                     router_id="router-a", pin_store=store)
+    rA.pin_job("job-1", "http://replica-x:1")
+    # pin_job only ENQUEUES (store I/O must not ride the per-submit
+    # request path); the board-sync thread drains — here, directly.
+    assert rA._flush_job_pins() == 1
+    # A second router over the SAME board resolves the pin on local
+    # miss — no fleet probe, no transport at all.
+    rB = FleetRouter(["http://127.0.0.1:1"], check_interval_s=999.0,
+                     router_id="router-b", pin_store=store)
+    assert rB.job_url("job-1") == "http://replica-x:1"
+    # ...and caches it locally (the second read hits memory).
+    assert rB._jobs["job-1"] == "http://replica-x:1"
+    # Torn/absent records read as None (never raise into routing).
+    board = PinBoard(store, "router-c")
+    store.put(board._job_key("torn"), b"{not json")
+    assert board.read_job("torn") is None
+    assert board.read_job("never-written") is None
+    # TTL pruning drops only stale records.
+    rec = json.loads(store.get(board._job_key("job-1")).decode())
+    rec["t_wall"] = time.time() - 7200.0
+    store.replace(board._job_key("job-1"), json.dumps(rec).encode())
+    board.write_job("job-2", "http://replica-y:1")
+    assert board.prune_jobs(ttl_s=3600.0) == 1
+    assert board.read_job("job-1") is None
+    assert board.read_job("job-2") == "http://replica-y:1"
+
+
+def test_signals_report_dead_devices(tmp_path):
+    """/fleet/signals degraded-device honesty: a replica's dead chips
+    drop out of device_lanes_total and surface as devices_dead_total."""
+    router = FleetRouter(["http://127.0.0.1:1"],
+                         check_interval_s=999.0, router_id="router-a")
+    with router._lock:
+        router._ready["http://127.0.0.1:1"] = True
+        router._replica_stats["http://127.0.0.1:1"] = {
+            "queue_depth": 0, "queue_capacity": 8, "workers_alive": 2,
+            "sessions": {"live": 1},
+            "lanes": {"lanes": [{"index": 0, "device": "cpu:0"},
+                                {"index": 1, "device": "cpu:1"}],
+                      "devices_dead": ["cpu:1"], "devices_live": 1},
+            "governor": {"level": 0, "memory_pressure": 0.0,
+                         "shed_total": {}},
+        }
+    sig = router.signals()
+    assert sig["device_lanes_total"] == 1
+    assert sig["devices_dead_total"] == 1

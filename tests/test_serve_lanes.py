@@ -22,6 +22,10 @@ Shapes are tiny (24x40 / 32x48 cameras, 24-frame protocol) so the whole
 module compiles a handful of sub-second programs per lane.
 """
 
+import dataclasses
+import io
+import time
+
 import numpy as np
 import pytest
 
@@ -307,6 +311,167 @@ def test_sharded_solve_pads_non_divisible_clouds():
         <= 0.02 * len(plain.faces)
 
 
+# ---------------------------------------------------------------------------
+# Device-loss tolerance (ISSUE 15): seeded chaos, lane health, re-pin
+# ---------------------------------------------------------------------------
+
+
+def test_device_fault_plan_env_roundtrip_and_determinism(monkeypatch):
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+
+    plan = faults.DeviceFaultPlan([
+        faults.DeviceFaultRule(device="cpu:1", kind="device_lost",
+                               after_launches=2, count=3),
+        faults.DeviceFaultRule(device="cpu:2", kind="nan_output"),
+    ])
+    monkeypatch.setenv(faults.DEVICE_FAULTS_ENV, plan.to_env())
+    loaded = faults.DeviceFaultPlan.from_env()
+    assert [dataclasses.asdict(r) for r in loaded.rules] == \
+        [dataclasses.asdict(r) for r in plan.rules]
+    # Launch windows: clean before after_launches, faulted for count,
+    # clean again; cpu:2's default count=-1 faults forever.
+    assert plan.fault_for("cpu:1", 1) is None
+    assert plan.fault_for("cpu:1", 2).kind == "device_lost"
+    assert plan.fault_for("cpu:1", 4).kind == "device_lost"
+    assert plan.fault_for("cpu:1", 5) is None
+    assert plan.fault_for("cpu:2", 999).kind == "nan_output"
+    assert plan.fault_for("cpu:0", 0) is None
+    # Injector counts launches per device and ledgers fired faults.
+    inj = faults.DeviceFaultInjector(plan)
+    assert inj.next_fault("cpu:1") is None
+    assert inj.next_fault("cpu:1") is None
+    assert inj.next_fault("cpu:1").kind == "device_lost"
+    assert inj.first_fault_t() is not None
+    assert [(d, i, k) for _, d, i, k in inj.injected] == \
+        [("cpu:1", 2, "device_lost")]
+    # Seeded campaigns are reproducible (hw/faults determinism rule).
+    a = faults.DeviceFaultPlan.seeded(7, [f"cpu:{i}" for i in range(8)],
+                                      p_dead=0.3)
+    b = faults.DeviceFaultPlan.seeded(7, [f"cpu:{i}" for i in range(8)],
+                                      p_dead=0.3)
+    assert [r.device for r in a.rules] == [r.device for r in b.rules]
+
+
+def test_lane_health_hysteresis_and_dead_callback():
+    from structured_light_for_3d_model_replication_tpu.serve import lanes
+
+    pool = DeviceLanePool(n_lanes=2)
+    deaths: list = []
+    pool.on_device_dead = deaths.append
+    # One failure: still healthy (hysteresis absorbs a flake).
+    assert pool.note_launch_failure("cpu:1") == lanes.LANE_HEALTHY
+    assert pool.lane_alive(1)
+    # A clean launch resets the streak.
+    pool.note_launch_ok("cpu:1")
+    assert pool.note_launch_failure("cpu:1") == lanes.LANE_HEALTHY
+    assert pool.note_launch_failure("cpu:1") == lanes.LANE_SUSPECT
+    assert pool.note_launch_failure("cpu:1") == lanes.LANE_DEAD
+    assert deaths == ["cpu:1"]
+    assert not pool.lane_alive(1) and pool.lane_alive(0)
+    assert pool.dead_devices() == ["cpu:1"]
+    # Dead is sticky against launch outcomes (a straggler batch must
+    # not un-kill the chip under the re-pin)...
+    pool.note_launch_ok("cpu:1")
+    assert pool.device_state("cpu:1") == lanes.LANE_DEAD
+    # ...and a second escalation path is a no-op, not a double event.
+    assert not pool.mark_device_dead("cpu:1")
+    # Only the probe path revives.
+    assert pool.revive_device("cpu:1")
+    assert pool.device_state("cpu:1") == lanes.LANE_HEALTHY
+    # New sessions avoid a dead device.
+    pool.mark_device_dead("cpu:0", reason="test")
+    assert pool.assign_session("s-x").label == "cpu:1"
+
+
+def test_shard_degrade_ladder():
+    pool = DeviceLanePool(n_lanes=8, shard_min_pixels=1,
+                          shard_devices=8)
+    big = _bucket(32, 48)  # 32 rows: divisible by 8/4/2
+    assert pool.shards_for(big) == 8
+    # A dead member OUTSIDE the degraded span halves the tier.
+    pool.mark_device_dead("cpu:7", reason="test")
+    assert pool.effective_shard_devices() == 4
+    assert pool.shards_for(big) == 4
+    pool.mark_device_dead("cpu:2", reason="test")
+    assert pool.shards_for(big) == 2
+    # devices[:2] dead ⇒ the tier turns OFF (lane-pinned fallback)
+    # rather than spanning a dead chip.
+    pool.mark_device_dead("cpu:1", reason="test")
+    assert pool.effective_shard_devices() == 0
+    assert pool.shards_for(big) == 0
+    key = pool.route(big, 1, pool.lane(0))
+    assert key.shards == 0 and key.device == "cpu:0"
+    # Revival walks back up the ladder.
+    pool.revive_device("cpu:1")
+    assert pool.shards_for(big) == 2
+
+
+def test_watchdog_per_device_budget_and_escalation():
+    """The restart-budget bug fix: one dead chip burning its budget must
+    not disable the watchdog for healthy chips — budgets are per device,
+    and a spent budget ESCALATES to device-dead when the hook is wired."""
+    import threading
+    import time as _time
+    import types
+
+    from structured_light_for_3d_model_replication_tpu.serve.governor \
+        import GovernorParams, OverloadGovernor
+    from structured_light_for_3d_model_replication_tpu.serve.jobs import (
+        AdmissionQueue,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import trace
+
+    def wedged_worker(name, label):
+        return types.SimpleNamespace(
+            name=name, lane=types.SimpleNamespace(label=label),
+            alive=True, abandoned=False, last_beat=-1e9)
+
+    params = GovernorParams(watchdog_interval_s=0.02,
+                            wedge_timeout_s=0.01,
+                            watchdog_max_restarts=2)
+    gov = OverloadGovernor(params, AdmissionQueue(max_depth=4),
+                           trace.MetricsRegistry())
+    workers = [wedged_worker("w-sick", "cpu:1")]
+    escalated: list = []
+    lock = threading.Lock()
+
+    def restart(w):
+        repl = wedged_worker(w.name + "r", w.lane.label)
+        with lock:
+            workers[workers.index(w)] = repl
+        return repl
+
+    def escalate(w):
+        escalated.append(w.lane.label)
+
+    gov.start_watchdog(lambda: list(workers), restart,
+                       escalate_fn=escalate)
+    try:
+        deadline = _time.monotonic() + 5.0
+        while not escalated and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert escalated == ["cpu:1"]
+        stats = gov.stats()
+        assert stats["worker_restarts_by_device"]["cpu:1"] == 2
+        # A HEALTHY chip wedging afterwards still gets replacements —
+        # its budget was never touched by cpu:1's spend.
+        with lock:
+            workers.append(wedged_worker("w-healthy", "cpu:0"))
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if gov.stats()["worker_restarts_by_device"].get("cpu:0"):
+                break
+            _time.sleep(0.02)
+        assert gov.stats()["worker_restarts_by_device"].get("cpu:0"), \
+            "healthy device got no replacement after the sick one's " \
+            "budget was spent (the global-budget bug)"
+        # Revival resets the sick device's budget.
+        gov.reset_restart_budget("cpu:1")
+        assert "cpu:1" not in gov.stats()["worker_restarts_by_device"]
+    finally:
+        gov.stop_watchdog()
+
+
 def test_watchdog_lane_swap_keeps_device_and_cache_counters(service,
                                                             lane_stack):
     """Governor regression (the wedged-worker path): the replacement
@@ -331,3 +496,209 @@ def test_watchdog_lane_swap_keeps_device_and_cache_counters(service,
     after = service.cache.stats()
     assert after["misses"] == mid["misses"], (mid, after)
     assert after["hits"] > mid["hits"]
+
+
+# ---------------------------------------------------------------------------
+# Integrated device chaos: dead chip mid-session, NaN containment, revive
+# ---------------------------------------------------------------------------
+
+
+def _chaos_config(**over):
+    from structured_light_for_3d_model_replication_tpu.stream import (
+        StreamParams,
+    )
+
+    base = dict(proj=PROJ, buckets=((H, W),), batch_sizes=(1,),
+                linger_ms=5.0, queue_depth=16, workers=2, devices=2,
+                mesh_depth=6, content_cache=False,
+                stream=StreamParams(preview_depth=5),
+                device_probe_interval_s=120.0)
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _arm(monkeypatch, *rules):
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+
+    plan = faults.DeviceFaultPlan(list(rules))
+    monkeypatch.setenv(faults.DEVICE_FAULTS_ENV, plan.to_env())
+
+
+def _stop(svc, sid, stack, timeout=60.0):
+    job = svc.submit_session_stop(sid, stack)
+    assert job.wait(timeout), job.status_dict()
+    return job
+
+
+def test_device_lost_mid_session_repins_and_finalizes_bitwise(
+        monkeypatch, lane_stack):
+    """The lane-chaos gate: a chip that starts refusing launches
+    mid-scan is escalated to dead, its sticky session migrates to the
+    surviving lane COMPILE-FREE, no acked stop is lost, and finalize
+    on the adopted lane is bitwise-identical to a never-faulted
+    session over the same stacks."""
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+    from structured_light_for_3d_model_replication_tpu.serve import lanes
+
+    # cpu:1 serves 2 clean launches, then refuses forever (dead chip).
+    _arm(monkeypatch, faults.DeviceFaultRule(
+        device="cpu:1", kind="device_lost", after_launches=2))
+    svc = ReconstructionService(_chaos_config()).start()
+    try:
+        s_ok = svc.create_session({"covis": False})["session_id"]
+        s_victim = svc.create_session({"covis": False})["session_id"]
+        victim = svc.sessions.get(s_victim)
+        assert victim.lane.label == "cpu:1"
+        stacks = [lane_stack + np.uint8(1 + i) for i in range(5)]
+        # Two clean stops on the victim lane, then three that each die
+        # on cpu:1 (healthy→suspect→dead) and complete on cpu:0.
+        jobs = [_stop(svc, s_victim, s) for s in stacks]
+        assert all(j.status == "done" for j in jobs), \
+            [j.status_dict() for j in jobs]  # zero lost acked stops
+        assert sum(j.launch_retries for j in jobs) >= 3
+        assert svc.lanes.device_state("cpu:1") == lanes.LANE_DEAD
+        assert victim.lane.label == "cpu:0"  # sticky session re-pinned
+        snap = svc.registry.snapshot()
+        assert sum(snap.get("serve_device_dead_total", {}).values()) == 1
+        assert sum(snap.get("serve_lane_repins_total", {}).values()) >= 1
+        state = {k: v for k, v in
+                 snap.get("serve_lane_state", {}).items()}
+        assert any("cpu:1" in k and v == 2 for k, v in state.items()), \
+            state
+        # Degraded-pool honesty: capacity halves, readiness says so
+        # while staying READY (one lane lives).
+        assert svc.queue.max_depth == 8
+        ready = svc.readiness()
+        assert ready["ready"] and ready.get("degraded")
+        assert ready["devices_dead"] == ["cpu:1"]
+        assert svc.lanes.stats()["devices_dead"] == ["cpu:1"]
+        # Post-death stops ride the adopted lane with ZERO compiles
+        # (per-device warmup covered cpu:0's session programs).
+        before = svc.cache.stats()
+        with sanitize.no_compile_region("lane-chaos-adopted-stop"):
+            post = _stop(svc, s_victim, lane_stack + np.uint8(9))
+        assert post.status == "done" and post.lane == victim.lane.index
+        assert svc.cache.stats()["misses"] == before["misses"]
+        # Bitwise parity: a reference session over the SAME stacks on
+        # the healthy lane finalizes to identical bytes. PLY (the full
+        # fused cloud) keeps the assertion bitwise while skipping the
+        # meshing tail's finalize-only compiles — the mesh is a
+        # deterministic function of these bytes.
+        for s in stacks + [lane_stack + np.uint8(9)]:
+            _stop(svc, s_ok, s)
+        got = svc.finalize_session(s_victim, result_format="ply")
+        ref = svc.finalize_session(s_ok, result_format="ply")
+        assert got.status == "done" and ref.status == "done"
+        assert len(got.result_bytes) > 0
+        assert got.result_bytes == ref.result_bytes
+    finally:
+        svc.abort()
+
+
+def test_nan_output_contained_without_tripping_breaker(monkeypatch,
+                                                       lane_stack):
+    """A NaN-emitting chip under SL_SANITIZE: the poisoned batch is
+    caught at the readback boundary, retried on a surviving lane (job
+    completes — contained), the lane goes suspect, and the
+    whole-service breaker NEVER opens (device faults are the lane
+    tier's problem, not grounds to shed fleet admissions)."""
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+    from structured_light_for_3d_model_replication_tpu.serve import lanes
+    from structured_light_for_3d_model_replication_tpu.serve.jobs import (
+        Job,
+    )
+
+    monkeypatch.setenv("SL_SANITIZE", "1")
+    _arm(monkeypatch, faults.DeviceFaultRule(
+        device="cpu:1", kind="nan_output", count=2))
+    svc = ReconstructionService(
+        _chaos_config(warmup_sessions=False)).start()
+    try:
+        def pinned(stack):
+            cfg = svc.config
+            job = Job(stack=stack, col_bits=cfg.proj.col_bits,
+                      row_bits=cfg.proj.row_bits,
+                      decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
+                      result_format="ply")
+            job.lane = 1
+            job.on_terminal = svc._on_terminal
+            svc.queue.submit(job)
+            return job
+
+        j1, j2 = pinned(lane_stack + np.uint8(1)), \
+            pinned(lane_stack + np.uint8(2))
+        for j in (j1, j2):
+            assert j.wait(60.0) and j.status == "done", j.status_dict()
+            assert j.launch_retries == 1
+        assert svc.lanes.device_state("cpu:1") == lanes.LANE_SUSPECT
+        # Containment contract: zero breaker trips, breaker closed.
+        assert svc.governor.breaker_open() is None
+        snap = svc.registry.snapshot()
+        assert sum(snap.get("serve_breaker_trips_total",
+                            {}).values()) == 0
+        # A clean launch walks the lane back to healthy.
+        j3 = pinned(lane_stack + np.uint8(3))
+        assert j3.wait(60.0) and j3.status == "done", j3.status_dict()
+        assert j3.launch_retries == 0
+        assert svc.lanes.device_state("cpu:1") == lanes.LANE_HEALTHY
+    finally:
+        svc.abort()
+
+
+def test_probe_revives_device_after_transient_loss(monkeypatch,
+                                                   lane_stack):
+    """Quarantine + probe-revive: a device lost for a bounded window is
+    probed at backoff cadence, re-warmed, and rejoins the pool — fresh
+    workers, restored queue capacity, new sessions placeable on it."""
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+    from structured_light_for_3d_model_replication_tpu.serve import lanes
+    from structured_light_for_3d_model_replication_tpu.serve.jobs import (
+        Job,
+    )
+
+    # 3 worker launches die (→ dead), the 4th consumer of the fault
+    # window is the FIRST probe (still dead), then the chip answers.
+    _arm(monkeypatch, faults.DeviceFaultRule(
+        device="cpu:1", kind="device_lost", count=4))
+    svc = ReconstructionService(_chaos_config(
+        warmup_sessions=False,
+        device_probe_interval_s=0.2,
+        device_probe_backoff_max_s=0.5)).start()
+    try:
+        def pinned(stack):
+            cfg = svc.config
+            job = Job(stack=stack, col_bits=cfg.proj.col_bits,
+                      row_bits=cfg.proj.row_bits,
+                      decode_cfg=cfg.decode_cfg, tri_cfg=cfg.tri_cfg,
+                      result_format="ply")
+            job.lane = 1
+            job.on_terminal = svc._on_terminal
+            svc.queue.submit(job)
+            return job
+
+        jobs = [pinned(lane_stack + np.uint8(1 + i)) for i in range(3)]
+        for j in jobs:
+            assert j.wait(60.0) and j.status == "done", j.status_dict()
+        assert svc.lanes.device_state("cpu:1") == lanes.LANE_DEAD
+        assert svc.queue.max_depth == 8
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and \
+                svc.lanes.device_state("cpu:1") != lanes.LANE_HEALTHY:
+            time.sleep(0.05)
+        assert svc.lanes.device_state("cpu:1") == lanes.LANE_HEALTHY, \
+            "probe never revived the device"
+        assert svc.queue.max_depth == 16  # capacity restored
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not any(
+                w.alive and w.lane is not None
+                and w.lane.label == "cpu:1" for w in svc.workers):
+            time.sleep(0.05)
+        assert any(w.alive and w.lane is not None
+                   and w.lane.label == "cpu:1" for w in svc.workers), \
+            "no revived worker lane on cpu:1"
+        # The revived lane serves again (its programs were re-warmed).
+        j = pinned(lane_stack + np.uint8(7))
+        assert j.wait(60.0) and j.status == "done", j.status_dict()
+        assert j.launch_retries == 0
+    finally:
+        svc.abort()

@@ -44,6 +44,9 @@ CAM_H, CAM_W = 24, 40
 STREAM_PARAMS = {
     "method": "posegraph", "view_cap": 1024, "preview_points": 1024,
     "preview_depth": 4, "final_depth": 5, "model_cap": 8192, "window": 3,
+    # The soak/fleet gates pin the legacy Poisson lane their compiled-
+    # program keys were established on (the session default is "tsdf").
+    "representation": "poisson",
     "merge": {"voxel_size": 4.0, "ransac_iterations": 512,
               "icp_iterations": 8, "fpfh_max_nn": 24, "normals_k": 8,
               "max_points": 1024, "posegraph_iterations": 10,

@@ -59,13 +59,19 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--preview-every", type=int, default=1,
                    help="emit a preview every N fused stops (0 = off)")
     s.add_argument("--representation",
-                   choices=("poisson", "tsdf", "splat"),
-                   default="poisson",
+                   choices=("tsdf", "archival", "poisson", "splat"),
+                   default="tsdf",
                    help="scene representation (docs/STREAMING.md, batch "
-                        "and --stream): 'tsdf' fuses into a brick volume "
-                        "(fusion/) — streaming stops integrate instead of "
-                        "re-solving, and the final mesh carries vertex "
-                        "color when --stl names a .ply (STL drops color); "
+                        "and --stream): 'tsdf' (default) fuses into a "
+                        "brick volume (fusion/) — streaming stops "
+                        "integrate instead of re-solving, finalize is "
+                        "integrate-don't-re-solve too, and the final "
+                        "mesh carries vertex color when --stl names a "
+                        ".ply (STL drops color); 'archival' keeps the "
+                        "TSDF previews but makes the FINAL artifact the "
+                        "full-depth watertight Poisson solve (the "
+                        "print/archive format); 'poisson' is the legacy "
+                        "lane (coarse Poisson re-solve previews too); "
                         "'splat' adds the Gaussian appearance tier "
                         "(docs/RENDERING.md) — rendered previews "
                         "(--preview-render) and a saveable scene "

@@ -82,12 +82,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="coarse Poisson depth of per-stop session "
                         "previews (finalize uses the full depth)")
     p.add_argument("--representation",
-                   choices=("poisson", "tsdf", "splat"),
+                   choices=("tsdf", "archival", "poisson", "splat"),
                    default=d.stream.representation,
                    help="default session scene representation "
-                        "(docs/STREAMING.md): 'tsdf' previews integrate "
-                        "incrementally (fusion/) and finalize meshes "
-                        "carry vertex color; 'splat' adds rendered "
+                        "(docs/STREAMING.md): 'tsdf' (default) previews "
+                        "integrate incrementally (fusion/), finalize is "
+                        "integrate-don't-re-solve, and meshes carry "
+                        "vertex color; 'archival' keeps TSDF previews "
+                        "but finalizes via the watertight Poisson solve "
+                        "(the print/archive format); 'poisson' is the "
+                        "legacy re-solve lane; 'splat' adds rendered "
                         "novel views (GET /session/<id>/render, "
                         "docs/RENDERING.md); per-session override via "
                         "the POST /session body")

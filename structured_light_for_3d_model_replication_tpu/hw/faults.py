@@ -323,18 +323,142 @@ class DeviceLostError(RuntimeError):
     """The launch's view of a dead chip: the runtime refused the program
     because the device is gone (the ``DEVICE_LOST`` shape real backends
     raise). The serve tier's lane-health escalation keys on this class
-    (plus a string sniff for real runtime errors, `serve/worker.py`)."""
+    (plus the per-backend taxonomy below for real runtime errors,
+    `serve/worker.py`)."""
 
 
-def is_device_loss(exc: BaseException) -> bool:
+#: Env var extending :data:`DEVICE_LOSS_TAXONOMY` with deployment
+#: vocabulary the table doesn't ship (a fleet's driver build may word a
+#: dead chip its own way). Accepts a JSON object keyed by backend —
+#: ``{"tpu": ["pattern", ...]}`` or ``{"tpu": {"patterns": [...],
+#: "types": [...]}}`` — or a bare comma-separated pattern list applied
+#: to every backend. Malformed values are logged and ignored, never
+#: raised (the BlobFaultPlan env idiom).
+DEVICE_LOSS_PATTERNS_ENV = "SL_DEVICE_LOSS_PATTERNS"
+
+#: Per-backend device-loss vocabulary: ``types`` are exception CLASS
+#: names (matched against the exception's MRO — lets an extension key on
+#: an unambiguous error class instead of prose), ``patterns`` are
+#: lowercase message substrings. The split by backend exists because the
+#: same word means different things per runtime: a TPU "halted" is a
+#: dead chip, a CPU "halted" is somebody's debugger — classifying with
+#: one flat list (the old string sniff) either over-fires on healthy
+#: backends or under-fires on real losses. Deliberately NOT listed:
+#: allocation failures ("out of memory", "RESOURCE_EXHAUSTED") — an OOM
+#: lane is overloaded, not dead, and must feed the governor's breaker,
+#: never the lane-death escalation.
+DEVICE_LOSS_TAXONOMY: dict[str, dict[str, tuple[str, ...]]] = {
+    # CPU devices don't die under a living process: only the generic
+    # (injected-fault) vocabulary classifies.
+    "cpu": {
+        "types": (),
+        "patterns": ("device_lost", "device lost", "device is gone"),
+    },
+    "tpu": {
+        "types": (),
+        "patterns": ("device_lost", "device lost", "device is gone",
+                     "tpu is halted", "core halted",
+                     "slice health check failed",
+                     "failed to connect to tpu driver"),
+    },
+    "gpu": {
+        "types": (),
+        "patterns": ("device_lost", "device lost", "device is gone",
+                     "cuda_error_device_unavailable",
+                     "cuda_error_ecc_uncorrectable",
+                     "fell off the bus", "gpu is lost"),
+    },
+}
+
+# jax.default_backend() spellings that aren't taxonomy keys.
+_BACKEND_ALIASES = {"cuda": "gpu", "rocm": "gpu"}
+
+# (raw env string, parsed extension) — re-parsed only when the env var
+# actually changes, so the per-launch classifier costs one dict probe.
+_env_taxonomy_cache: tuple[str | None, dict] = (None, {})
+
+
+def _env_taxonomy() -> dict:
+    global _env_taxonomy_cache
+    raw = os.environ.get(DEVICE_LOSS_PATTERNS_ENV)
+    if raw == _env_taxonomy_cache[0]:
+        return _env_taxonomy_cache[1]
+    ext: dict[str, dict[str, tuple[str, ...]]] = {}
+    if raw and raw.strip():
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            doc = None
+        if isinstance(doc, dict):
+            for backend, spec in doc.items():
+                if isinstance(spec, dict):
+                    ext[backend] = {
+                        "types": tuple(spec.get("types", ())),
+                        "patterns": tuple(
+                            str(p).lower()
+                            for p in spec.get("patterns", ())),
+                    }
+                else:
+                    ext[backend] = {"types": (), "patterns": tuple(
+                        str(p).lower() for p in spec)}
+        elif doc is None:
+            # Not JSON: a bare comma list — every backend learns it.
+            pats = tuple(p.strip().lower()
+                         for p in raw.split(",") if p.strip())
+            ext = {b: {"types": (), "patterns": pats}
+                   for b in DEVICE_LOSS_TAXONOMY}
+        else:
+            log.error("ignoring malformed %s: not a JSON object or "
+                      "pattern list", DEVICE_LOSS_PATTERNS_ENV)
+    _env_taxonomy_cache = (raw, ext)
+    return ext
+
+
+def _loss_entries(backend: str | None) -> list[dict]:
+    """Taxonomy entries to consult: the backend's own (plus its env
+    extension), or — when the backend can't be resolved — the union of
+    every backend's (the conservative superset: an unclassifiable
+    runtime must not silence a real loss)."""
+    ext = _env_taxonomy()
+    if backend is not None:
+        backend = _BACKEND_ALIASES.get(backend, backend)
+        if backend in DEVICE_LOSS_TAXONOMY or backend in ext:
+            entries = []
+            if backend in DEVICE_LOSS_TAXONOMY:
+                entries.append(DEVICE_LOSS_TAXONOMY[backend])
+            if backend in ext:
+                entries.append(ext[backend])
+            return entries
+    return list(DEVICE_LOSS_TAXONOMY.values()) + list(ext.values())
+
+
+def is_device_loss(exc: BaseException, backend: str | None = None) -> bool:
     """Device-loss classifier shared by the worker and the probe: the
-    injected :class:`DeviceLostError`, or a real runtime error whose
-    message carries the backend's device-loss vocabulary."""
+    injected :class:`DeviceLostError`, or a real runtime error matching
+    the backend's row of :data:`DEVICE_LOSS_TAXONOMY` (error-type name
+    or message vocabulary, extensible via ``SL_DEVICE_LOSS_PATTERNS``).
+
+    ``backend`` defaults to the live ``jax.default_backend()``;
+    unresolvable (no jax, broken runtime) falls back to matching every
+    backend's vocabulary — over-matching a dying process beats
+    under-matching a dead chip."""
     if isinstance(exc, DeviceLostError):
         return True
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = None
+    names = {c.__name__ for c in type(exc).__mro__}
     msg = str(exc).lower()
-    return "device_lost" in msg or "device lost" in msg \
-        or "device is gone" in msg
+    for entry in _loss_entries(backend):
+        if names.intersection(entry["types"]):
+            return True
+        if any(p in msg for p in entry["patterns"]):
+            return True
+    return False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -151,9 +151,15 @@ def mesh_from_cloud(
     if extraction not in ("auto", "host", "device"):
         # Fail BEFORE the multi-second solve, not in the extractor after.
         raise ValueError(f"unknown extraction engine {extraction!r}")
+    if representation == "archival":
+        # The streaming tier's opt-in watertight format (docs/STREAMING.md):
+        # TSDF previews during the scan, Poisson for the final artifact.
+        # By the time a cloud reaches this function the preview story is
+        # over — archival IS the Poisson print path.
+        representation = "poisson"
     if representation not in ("poisson", "tsdf"):
         raise ValueError(f"unknown representation {representation!r} "
-                         "(expected 'poisson' or 'tsdf')")
+                         "(expected 'poisson', 'tsdf' or 'archival')")
     pts = np.asarray(cloud.points, np.float32)
     if pts.shape[0] < 16:
         raise ValueError(f"too few points to mesh ({pts.shape[0]})")
@@ -242,6 +248,28 @@ def mesh_from_cloud(
     log.info("meshed %d points -> %d verts / %d faces (mode=%s depth=%d)",
              pts.shape[0], len(mesh.vertices), len(mesh.faces), mode, depth)
     return mesh
+
+
+def mesh_from_cloud_async(cloud: PointCloud, *, task_name: str = "mesh",
+                          **kw):
+    """Launch :func:`mesh_from_cloud` on a pipelined worker and return
+    the :class:`~..utils.overlap.PipelinedTask` handle.
+
+    The overlapped-finalize seam (docs/MESHING.md): once a cloud's
+    geometry is final, its Poisson/extraction solve shares no data with
+    the caller's remaining registration/merge tail (pose assembly,
+    health gating, artifact serialization) — so the solve can run while
+    the caller finishes that tail, and ``task.result()`` joins
+    deterministically. Determinism contract: the worker runs the SAME
+    function with the SAME arguments the sequential call would, so the
+    joined mesh is bit-identical to ``mesh_from_cloud(...)`` —
+    tests/test_overlap.py pins it. The caller must not mutate ``cloud``
+    (or ``kw`` arrays) until the join; worker exceptions re-raise at
+    ``result()``, exactly where the sequential path would have thrown.
+    """
+    from ..utils.overlap import PipelinedTask
+
+    return PipelinedTask(mesh_from_cloud, cloud, name=task_name, **kw)
 
 
 def _tsdf_mesh(cloud: PointCloud, pts: np.ndarray, normals: np.ndarray,

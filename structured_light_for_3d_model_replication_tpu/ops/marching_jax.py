@@ -4,10 +4,11 @@ The host extractor (:func:`.marching.extract_sparse`) pulls the full chi +
 density brick tensors to host (two (M, 8³) float fields — ~750 MB at the
 1M-point depth-10 band over this dev environment's ~20 MB/s tunnel) and
 then runs NumPy over the active cells. This module keeps classification,
-compaction and edge interpolation ON DEVICE and reads back only the
-compacted triangle soup — the output-sized readback, not the field-sized
-one — before the host finishes with the global winding vote, density trim
-and vertex weld (:func:`.marching.weld`).
+compaction, edge interpolation AND the whole post-soup tail — global
+winding vote, density trim, vertex weld — ON DEVICE, so the only data
+that crosses the link is the welded result: unique vertices + faces
+(plus four count scalars). Readback is tallied per call in
+:data:`LAST_READBACK` and pinned by tests to exactly that set.
 
 Same algorithm as the host path — 6-tet decomposition, identical per-case
 edge logic — expressed as three shape-static jitted programs with host
@@ -25,8 +26,17 @@ syncs only at the two data-dependent counts:
    (cell, tet, slot) triangle slots, interpolate each triangle's three
    edge crossings, and orient every triangle so its normal points from the
    inside (χ > iso) to the outside — a per-(tet, case) static flip table,
-   so the soup leaves the device with globally field-consistent winding
-   and the host vote reduces to one all-or-nothing flip.
+   so the soup is globally field-consistent and the outward vote reduces
+   to one all-or-nothing flip.
+4. **tail** (same static ``T``): the all-or-nothing winding flip (sign
+   vote over triangle normals against the soup centroid), the optional
+   density-quantile trim, and the vertex weld. The weld keys on the raw
+   float32 BIT PATTERNS of the vertex coordinates — valid because the
+   edge-ascending canonicalization below makes every shared crossing
+   bit-identical, so "same vertex" is exact equality, no rounding grid
+   needed: bitcast → lexsort → first-occurrence group ids → scattered
+   unique vertices + inverse-mapped faces, degenerate faces dropped,
+   exactly the host :func:`.marching.weld` contract.
 
 Capacities are data-dependent, so they are bucketed to powers of two
 (bounded recompiles) and sliced to the true counts on device before the
@@ -57,7 +67,7 @@ import jax
 import jax.numpy as jnp
 
 from . import _backend
-from .marching import _CORNERS, _TETS, weld
+from .marching import _CORNERS, _TETS
 from .poisson_sparse import BS
 from ..io.stl import TriangleMesh
 from ..utils.log import get_logger
@@ -186,6 +196,22 @@ def _bucket(n: int, floor: int = 4096) -> int:
     """Static-capacity bucket: next power of two ≥ max(n, floor), so the
     data-dependent counts reuse a handful of compiled programs."""
     return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
+#: Per-call device→host transfer tally: cleared at the top of
+#: :func:`extract_sparse_jax`, one entry per named readback with its
+#: byte count. tests/test_marching_jax.py asserts the keys are exactly
+#: {"counts", "vertices", "faces"} and that vertices/faces carry
+#: ``nv·12`` / ``nf·12`` bytes — i.e. the welded result and nothing
+#: field- or soup-sized ever crosses the link.
+LAST_READBACK: dict[str, int] = {}
+
+
+def _pull(name: str, arr) -> _np.ndarray:
+    """Materialize ``arr`` on host and tally the bytes under ``name``."""
+    out = _np.asarray(arr)
+    LAST_READBACK[name] = LAST_READBACK.get(name, 0) + out.nbytes
+    return out
 
 
 def _nb8_table(nbr):
@@ -322,6 +348,80 @@ def _phase_triangles(cells, density, block_coords, iso, T: int):
     return tris, dens
 
 
+@functools.partial(jax.jit, static_argnames=("do_trim",),
+                   donate_argnums=(0, 1),
+                   in_shardings=None, out_shardings=None)
+def _phase_tail(tris, dens, n, trim, do_trim: bool):
+    """Winding vote + optional quantile trim + vertex weld, on device.
+
+    ``tris`` is _phase_triangles' bucketed (T, 3, 3) soup with ``n`` real
+    rows (slots ≥ n hold garbage and are masked throughout). Returns
+    ``(uverts (3T, 3) float32, faces (T, 3) int32, counts (2,) int32)``
+    with ``counts = [nv, nf]``; the caller slices to the true counts on
+    device so the readback is the welded result only.
+
+    The weld keys on float32 bit patterns: the ``_EP_CUBE`` ascending-edge
+    canonicalization makes every shared crossing bit-identical, so exact
+    bit equality IS vertex identity (−0.0 is normalized to +0.0 first).
+    Host parity: same vote rule (``Σ sign(vote) ≤ 0`` flips), same
+    ``np.quantile`` linear interpolation for the trim threshold, same
+    degenerate-face drop as :func:`.marching.weld`.
+    """
+    T = tris.shape[0]
+    valid = jnp.arange(T, dtype=jnp.int32) < n
+
+    # Global outward decision: device winding is already field-consistent
+    # (normals along −∇χ), so one sign vote against the soup centroid
+    # settles outward-vs-inward for every triangle at once.
+    cen = tris.mean(axis=1)
+    vf = valid.astype(jnp.float32)
+    gcen = (jnp.sum(cen * vf[:, None], axis=0)
+            / jnp.maximum(jnp.sum(vf), 1.0))
+    nrm = jnp.cross(tris[:, 1] - tris[:, 0], tris[:, 2] - tris[:, 0])
+    vote = jnp.sum(nrm * (cen - gcen), axis=-1)
+    outward_flip = jnp.sum(jnp.where(valid, jnp.sign(vote), 0.0)) <= 0.0
+    tris = jnp.where(outward_flip, tris[:, ::-1, :], tris)
+
+    keep = valid
+    if do_trim:
+        sd = jnp.sort(jnp.where(valid, dens, jnp.inf))
+        pos = trim * (n - 1).astype(jnp.float32)
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, n - 1)
+        hi = jnp.minimum(lo + 1, n - 1)
+        frac = pos - lo.astype(jnp.float32)
+        thresh = sd[lo] * (1.0 - frac) + sd[hi] * frac
+        keep = keep & (dens > thresh)
+
+    # Weld: bitcast → lexsort (invalid rows last) → first-occurrence
+    # group ids → scatter unique vertices / inverse-map faces.
+    vflat = tris.reshape(T * 3, 3) + 0.0           # −0.0 → +0.0
+    vkeep = jnp.repeat(keep, 3)
+    key = jax.lax.bitcast_convert_type(vflat, jnp.int32)
+    order = jnp.lexsort((key[:, 2], key[:, 1], key[:, 0],
+                         (~vkeep).astype(jnp.int32)))
+    ks = key[order]
+    valid_s = vkeep[order]
+    newg = jnp.concatenate([jnp.ones((1,), bool),
+                            jnp.any(ks[1:] != ks[:-1], axis=1)]) & valid_s
+    gid = jnp.cumsum(newg.astype(jnp.int32)) - 1
+    nv = jnp.sum(newg.astype(jnp.int32))
+    big = T * 3
+    uverts = jnp.zeros((big, 3), jnp.float32).at[
+        jnp.where(newg, gid, big)].set(vflat[order], mode="drop")
+    inv = jnp.zeros((big,), jnp.int32).at[order].set(
+        jnp.where(valid_s, gid, 0))
+    faces = inv.reshape(T, 3)
+    good = (keep & (faces[:, 0] != faces[:, 1])
+            & (faces[:, 1] != faces[:, 2])
+            & (faces[:, 0] != faces[:, 2]))
+    rank = jnp.cumsum(good.astype(jnp.int32)) - 1
+    dest = jnp.where(good, jnp.minimum(rank, T), T)
+    faces_c = jnp.zeros((T + 1, 3), jnp.int32).at[dest].set(
+        faces, mode="drop")[:T]
+    nf = jnp.sum(good.astype(jnp.int32))
+    return uverts, faces_c, jnp.stack([nv, nf])
+
+
 def extract_sparse_jax(grid, quantile_trim: float = 0.0,
                        use_pallas: bool | None = None) -> TriangleMesh:
     """SparsePoissonGrid → welded TriangleMesh, extraction on device.
@@ -338,46 +438,35 @@ def extract_sparse_jax(grid, quantile_trim: float = 0.0,
                          "extractor for hand-assembled grids")
     if use_pallas is None:
         use_pallas = _backend.tpu_backend()
+    LAST_READBACK.clear()
     iso = jnp.float32(grid.iso)
     c9, active, count = _phase_corners(grid.chi, grid.nbr,
                                        grid.block_valid, iso,
                                        use_pallas=bool(use_pallas))
-    n_cells = int(count)
+    n_cells = int(_pull("counts", count))
     if n_cells == 0:
         return TriangleMesh(_np.zeros((0, 3), _np.float32),
                             _np.zeros((0, 3), _np.int32))
     K = _bucket(n_cells)
     cell_ids = _phase_cells(active, K)
     count_d, cells = _phase_count(c9, cell_ids, iso, K)
-    nt = int(count_d)
+    nt = int(_pull("counts", count_d))
     if nt == 0:
         return TriangleMesh(_np.zeros((0, 3), _np.float32),
                             _np.zeros((0, 3), _np.int32))
     tris_d, dens_d = _phase_triangles(
         cells, grid.density, grid.block_coords, iso, _bucket(nt))
-    # Slice to the true count ON DEVICE before the pull: the bucketed
-    # capacity can be ~2× nt, and this readback is the whole point of
-    # the device path (the per-nt slice program is a trivially cheap
-    # compile next to shipping up to 2× the soup over the link). The
-    # density column only crosses the link when the trim will read it.
-    tris = _np.asarray(tris_d[:nt], _np.float64)
-
-    # Global outward decision — the only orientation work left: device
-    # winding is already field-consistent (normals along −∇χ), so the
-    # host vote collapses to one all-or-nothing flip, same decision rule
-    # as the host extractor's sign vote.
-    cen = tris.mean(axis=1)
-    nrm = _np.cross(tris[:, 1] - tris[:, 0], tris[:, 2] - tris[:, 0])
-    vote = _np.einsum("ij,ij->i", nrm, cen - cen.mean(axis=0))
-    if _np.sum(_np.sign(vote)) <= 0:
-        tris = tris[:, ::-1, :]
-
-    if quantile_trim > 0.0 and tris.shape[0]:
-        dens = _np.asarray(dens_d[:nt])
-        keep = dens > _np.quantile(dens, quantile_trim)
-        tris = tris[keep]
-
-    verts, faces = weld(tris)
+    # Winding vote, trim and weld all run on device, so the only arrays
+    # that cross the link are the welded vertices and faces (sliced to
+    # their true counts ON DEVICE first — the bucketed capacities can
+    # hold ~2× the real mesh, and the per-count slice program is a
+    # trivially cheap compile next to shipping the slack).
+    uverts_d, faces_d, counts_d = _phase_tail(
+        tris_d, dens_d, jnp.int32(nt), jnp.float32(quantile_trim),
+        do_trim=quantile_trim > 0.0)
+    nv, nf = (int(c) for c in _pull("counts", counts_d))
+    verts = _pull("vertices", uverts_d[:nv])
+    faces = _pull("faces", faces_d[:nf])
     world = verts * float(grid.scale) + _np.asarray(grid.origin,
                                                     _np.float32)
     mesh = TriangleMesh(world.astype(_np.float32), faces)

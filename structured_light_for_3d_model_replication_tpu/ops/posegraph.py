@@ -65,6 +65,7 @@ def log_so3(R: jnp.ndarray) -> jnp.ndarray:
     return v * scale
 
 
+@jax.jit
 def chain_poses(edge_T_seq: jnp.ndarray) -> jnp.ndarray:
     """Initial odometry poses from sequential edge measurements.
 
@@ -72,6 +73,12 @@ def chain_poses(edge_T_seq: jnp.ndarray) -> jnp.ndarray:
     (i.e. T_i maps frame-(i+1) points into frame i, the ICP result of
     aligning scan i+1 onto scan i, as the reference accumulates at
     `server/processing.py:162`). Returns (N, 4, 4) with X_0 = I.
+
+    Jitted at module level: the eager ``lax.scan`` used to rebuild its
+    ``step`` closure per call, so EVERY finalize recompiled the scan —
+    caught by the no_compile_region around the overlapped finalize
+    (tests/test_overlap.py); under jit the program is traced once per
+    edge-count.
     """
     def step(X, T):
         Xn = X @ T

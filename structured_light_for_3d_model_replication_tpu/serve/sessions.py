@@ -47,11 +47,13 @@ log = get_logger(__name__)
 #: merge/registration surface stays server-side (it keys compiled
 #: programs; per-session drift would mint fresh compiles — exactly what
 #: the warmed steady state forbids). ``representation`` picks the
-#: preview/final scene representation ("poisson" | "tsdf" | "splat" —
-#: the fusion/splat dispatch, docs/STREAMING.md + docs/RENDERING.md;
-#: a non-default choice compiles its programs on first use unless the
-#: replica warmed that lane too; "splat" adds the GET
-#: /session/<id>/render + /splats surface and result_format
+#: preview/final scene representation ("tsdf" — the default,
+#: integrate-don't-re-solve | "archival" — TSDF previews, watertight
+#: Poisson final artifact | "poisson" — the legacy re-solve lane |
+#: "splat" — the fusion/splat dispatch, docs/STREAMING.md +
+#: docs/RENDERING.md; a non-default choice compiles its programs on
+#: first use unless the replica warmed that lane too; "splat" adds the
+#: GET /session/<id>/render + /splats surface and result_format
 #: "render_png").
 SESSION_OPTION_KEYS = ("preview_every", "preview_depth", "final_depth",
                        "expected_stops", "method", "covis",
@@ -262,10 +264,11 @@ class SessionManager:
                 f"{overrides['method']!r}")
         if "representation" in overrides \
                 and overrides["representation"] not in ("poisson", "tsdf",
-                                                        "splat"):
+                                                        "splat",
+                                                        "archival"):
             raise StackFormatError(
-                f"representation must be 'poisson', 'tsdf' or 'splat', "
-                f"got {overrides['representation']!r}")
+                f"representation must be 'poisson', 'tsdf', 'splat' or "
+                f"'archival', got {overrides['representation']!r}")
         for k in ("preview_every", "preview_depth", "final_depth",
                   "expected_stops"):
             if k in overrides:

@@ -203,11 +203,16 @@ class DeviceWorker:
         the historical containment: every job fails with the fault
         payload, and the governor's breaker counts it."""
         log.warning("batch %s failed: %s", batch.key.label(), e)
-        device_fault = (isinstance(e, DeviceOutputError)
-                        or hwfaults.is_device_loss(e))
         key = getattr(batch, "program_key", None)
         sharded = key is not None and bool(key.shards)
         label = self.lane.label if self.lane is not None else None
+        # Classify against THIS lane's platform ("cpu:0" → "cpu"), not
+        # the process default backend — the right row of the device-loss
+        # taxonomy in a heterogeneous pool.
+        device_fault = (isinstance(e, DeviceOutputError)
+                        or hwfaults.is_device_loss(
+                            e, backend=label.split(":", 1)[0]
+                            if label else None))
         events.record(
             "batch_failed", severity="error", message=str(e),
             program=batch.key.label(), exc_type=type(e).__name__,

@@ -124,13 +124,15 @@ class PreviewMesher:
 
 
 def make_previewer(params):
-    """StreamParams → the session's previewer: the coarse-Poisson
-    re-solver (default), the incremental TSDF mesher
-    (``representation="tsdf"``, `fusion/preview.py`) or the splat
-    appearance lane (``"splat"``, `splat/preview.py` — the TSDF mesher
-    plus rendered novel views). All share the ``__call__(model_pts,
-    model_valid) -> TriangleMesh`` contract."""
-    if params.representation in ("tsdf", "splat"):
+    """StreamParams → the session's previewer: the incremental TSDF
+    mesher (``representation="tsdf"`` — the default — and
+    ``"archival"``, whose previews are the same TSDF lane with only the
+    FINAL artifact going through Poisson; `fusion/preview.py`), the
+    coarse-Poisson re-solver (``"poisson"``, the legacy lane) or the
+    splat appearance lane (``"splat"``, `splat/preview.py` — the TSDF
+    mesher plus rendered novel views). All share the
+    ``__call__(model_pts, model_valid) -> TriangleMesh`` contract."""
+    if params.representation in ("tsdf", "splat", "archival"):
         from ..ops.tsdf import TSDFParams
 
         tparams = TSDFParams(grid_depth=params.tsdf_grid_depth,

@@ -104,14 +104,21 @@ class StreamParams:
     preview_depth: int = 6
     preview_trim: float = 0.05
     # Scene representation for previews AND the final mesh dispatch
-    # (docs/MESHING.md): "poisson" = coarse re-solve previews + the
-    # watertight print path; "tsdf" = incremental fused-volume previews
+    # (docs/MESHING.md, docs/STREAMING.md): "tsdf" (DEFAULT) = the
+    # integrate-don't-re-solve lane — incremental fused-volume previews
     # (fusion/, per-stop integration instead of a re-solve) and a
-    # vertex-COLORED final mesh; "splat" = the TSDF lane PLUS the
-    # Gaussian appearance tier (splat/, docs/RENDERING.md) — rendered
-    # novel-view previews next to the mesh ones, fitted from the
-    # per-stop RGB the session already decodes.
-    representation: str = "poisson"
+    # vertex-COLORED final mesh re-fused from the pose-graph-final
+    # cloud, no Poisson solve anywhere; "archival" = the TSDF preview
+    # lane but the FINAL artifact is the full-depth watertight Poisson
+    # solve (the print/archive format, opt-in because it costs seconds
+    # where the default costs a fraction of one); "poisson" = the
+    # legacy lane — coarse re-solve previews (whose grids warm-start
+    # the final solve) + the watertight print path; "splat" = the TSDF
+    # lane PLUS the Gaussian appearance tier (splat/,
+    # docs/RENDERING.md) — rendered novel-view previews next to the
+    # mesh ones, fitted from the per-stop RGB the session already
+    # decodes.
+    representation: str = "tsdf"
     tsdf_voxel_scale: float = 2.0       # TSDF voxel = scale × merge voxel
     tsdf_grid_depth: int = 8
     tsdf_max_bricks: int = 4096
@@ -290,9 +297,11 @@ class IncrementalSession:
         if params.method not in ("sequential", "posegraph"):
             raise ValueError(f"method must be 'sequential' or 'posegraph',"
                              f" got {params.method!r}")
-        if params.representation not in ("poisson", "tsdf", "splat"):
-            raise ValueError(f"representation must be 'poisson', 'tsdf' "
-                             f"or 'splat', got {params.representation!r}")
+        if params.representation not in ("poisson", "tsdf", "splat",
+                                         "archival"):
+            raise ValueError(f"representation must be 'poisson', 'tsdf', "
+                             f"'splat' or 'archival', got "
+                             f"{params.representation!r}")
         self.calib = calib
         self.col_bits = col_bits
         self.row_bits = row_bits
@@ -657,7 +666,7 @@ class IncrementalSession:
                         "subset", p.model_cap, n_model)
         self._model_points = min(n_model, p.model_cap)
         moved_np = np.asarray(moved)
-        if p.representation in ("tsdf", "splat"):
+        if p.representation in ("tsdf", "splat", "archival"):
             # Incremental TSDF integration (fusion/preview.py): the
             # stop's pose-transformed view fuses into the persistent
             # volume here, so the preview is a pure extraction — no
@@ -715,13 +724,29 @@ class IncrementalSession:
 
     # -- finalize ----------------------------------------------------------
 
-    def finalize(self, mesh: bool = True) -> FinalizeResult:
+    def finalize(self, mesh: bool = True,
+                 overlap: bool = True) -> FinalizeResult:
         """Close the ring: optional loop-closure edge, axis-prior re-pass
         (clean rings) or edge gates (degraded rings), full pose solve,
         full-resolution merge of every retained stop view, and the
-        full-depth watertight mesh — the SAME math `scan_stacks_to_cloud`
-        runs, staged from the per-stop state this session retained (the
-        parity contract of tests/test_stream.py)."""
+        final mesh — the SAME math `scan_stacks_to_cloud` runs, staged
+        from the per-stop state this session retained (the parity
+        contract of tests/test_stream.py).
+
+        The final mesh follows ``params.representation``: the default
+        ``"tsdf"`` re-fuses the pose-graph-final cloud into a TSDF and
+        extracts — integrate-don't-re-solve, a fraction of a second;
+        ``"archival"`` (and the legacy ``"poisson"``) runs the
+        full-depth watertight Poisson solve, the print/archive format.
+
+        ``overlap=True`` (default) launches that mesh solve on a
+        pipelined worker (`utils/overlap.py`) the moment the merged
+        cloud is final, so it runs concurrently with the remaining
+        finalize tail (pose-table assembly, health, stats) and joins
+        deterministically before the result is returned — same mesh
+        bit-for-bit as ``overlap=False`` (tests/test_overlap.py), with
+        the realized concurrency window reported in
+        ``FinalizeResult.stats["overlap"]``."""
         if self._finalized:
             raise health_mod.StopQualityError(
                 f"session {self.scan_id} already finalized")
@@ -736,7 +761,7 @@ class IncrementalSession:
         loop = p.method == "posegraph" and mp.loop_closure
         with events.context(scan_id=self.scan_id), \
                 trace.span("stream.finalize", stops=n):
-            result = self._finalize_inner(n, loop, mp, mesh)
+            result = self._finalize_inner(n, loop, mp, mesh, overlap)
         self._finalized = True
         events.record("session_finalized", stops_fused=n,
                       stops_skipped=len(self._skipped),
@@ -746,7 +771,8 @@ class IncrementalSession:
                       elapsed_s=round(time.monotonic() - t0, 3))
         return result
 
-    def _finalize_inner(self, n: int, loop: bool, mp, want_mesh: bool):
+    def _finalize_inner(self, n: int, loop: bool, mp, want_mesh: bool,
+                        overlap: bool = True):
         p = self.params
         outs_T = [e.T_dev for e in self._edges]
         fit = [e.fit for e in self._edges]
@@ -823,15 +849,14 @@ class IncrementalSession:
             moved.reshape(-1, 3), sub_col.reshape(-1, 3),
             sub_val.reshape(-1), mp, has_colors=True)
 
-        poses_np = np.asarray(poses)
-        all_poses = np.tile(np.eye(4, dtype=np.float32),
-                            (self._next_label, 1, 1))
-        for j, lab in enumerate(self._labels):
-            all_poses[lab] = poses_np[j].astype(np.float32)
-        for lab, (_, predicted) in self._skipped.items():
-            all_poses[lab] = predicted.astype(np.float32)
-
+        # The merged cloud is final here — its geometry is everything the
+        # mesh solve needs. Launch the solve NOW on the pipelined worker
+        # (overlap=True) so the device chews on it while the host runs
+        # the remaining finalize tail below (pose-table assembly, health,
+        # stats); the deterministic join before FinalizeResult means the
+        # mesh is bit-for-bit the sequential path's.
         final_mesh = None
+        mesh_task = None
         solve_stats: dict = {}
         if want_mesh:
             from ..models import meshing
@@ -841,24 +866,41 @@ class IncrementalSession:
             # directly; at a SPARSE final depth (> 8) the full preview
             # GRID rides along and warm-starts the sparse solver's
             # internal coarse solve (world-aligned — the ROADMAP's
-            # "previews → final solve" item).
+            # "previews → final solve" item). Only the legacy poisson
+            # lane has Poisson previews to warm from; archival previews
+            # are the TSDF volume.
             x0 = None
             if p.representation == "poisson":
                 if p.final_depth == p.preview_depth:
                     x0 = getattr(self._mesher, "last_chi", None)
                 elif p.final_depth > 8:
                     x0 = getattr(self._mesher, "last_grid", None)
-            final_mesh = meshing.mesh_from_cloud(
-                merged, mode="watertight", depth=p.final_depth,
-                quantile_trim=p.final_trim,
-                # The splat lane's GEOMETRY is the TSDF volume — its
-                # final mesh is the colored TSDF extraction (the
-                # rendered artifact rides result_format="render_png",
-                # not the mesh path).
-                representation="tsdf" if p.representation == "splat"
-                else p.representation,
+            # The splat lane's GEOMETRY is the TSDF volume — its final
+            # mesh is the colored TSDF extraction (the rendered artifact
+            # rides result_format="render_png", not the mesh path).
+            # Archival = TSDF previews, Poisson final artifact.
+            mesh_rep = {"splat": "tsdf", "archival": "poisson"}.get(
+                p.representation, p.representation)
+            mesh_kw = dict(
+                mode="watertight", depth=p.final_depth,
+                quantile_trim=p.final_trim, representation=mesh_rep,
                 tsdf_max_bricks=p.tsdf_max_bricks, cg_x0=x0,
                 solve_stats=solve_stats)
+            if overlap:
+                mesh_task = meshing.mesh_from_cloud_async(
+                    merged, task_name=f"finalize-{self.scan_id}",
+                    **mesh_kw)
+            else:
+                final_mesh = meshing.mesh_from_cloud(merged, **mesh_kw)
+
+        poses_np = np.asarray(poses)
+        all_poses = np.tile(np.eye(4, dtype=np.float32),
+                            (self._next_label, 1, 1))
+        for j, lab in enumerate(self._labels):
+            all_poses[lab] = poses_np[j].astype(np.float32)
+        for lab, (_, predicted) in self._skipped.items():
+            all_poses[lab] = predicted.astype(np.float32)
+
         stats = {
             "stops_fused": n,
             "stops_skipped": len(self._skipped),
@@ -869,6 +911,20 @@ class IncrementalSession:
             "min_fitness": round(float(fit.min()), 4) if len(fit) else None,
             "cloud_points": len(merged),
         }
+        if mesh_task is not None:
+            # Join: the tail above ran while the solve did. tail_done_s <
+            # solve ended_s = the finalize tail was fully hidden inside
+            # the solve window; bench [6b] asserts the converse too (the
+            # solve was already running when the tail finished).
+            tail_done_s = time.monotonic() - mesh_task.t_submit
+            final_mesh = mesh_task.result()
+            timings = mesh_task.timings()
+            stats["overlap"] = {
+                "solve": timings,
+                "tail_done_s": round(tail_done_s, 6),
+                "overlapped": timings["started_s"] is not None
+                and timings["started_s"] < tail_done_s,
+            }
         if solve_stats:
             # Sparse-finalize solve telemetry (warm_start_blocks > 0 =
             # the previews seeded the final solve; tests assert it).

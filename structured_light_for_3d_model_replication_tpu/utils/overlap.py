@@ -1,0 +1,126 @@
+"""Tiny pipelined executor for the finalize tail (ROADMAP: overlap the
+meshing solve with the ICP/merge tail).
+
+The Poisson/extraction solve of a finalized scan shares no data with the
+rest of the finalize work once the merged cloud exists — yet the batch
+and streaming pipelines ran them strictly in sequence. A
+:class:`PipelinedTask` runs ONE callable on a background thread so the
+caller can keep executing the registration/merge tail (pose assembly,
+health gating, artifact serialization) while the device chews on the
+mesh solve, then joins deterministically: ``result()`` blocks until the
+worker finished and re-raises its exception in the caller's frame, so
+the call site's error behavior is exactly the sequential path's.
+
+Design constraints (why this is 60 lines and not a thread pool):
+
+* **determinism** — the task runs the SAME callable with the SAME
+  arguments as the sequential path would; overlap changes *when* the
+  work runs, never *what* runs. `tests/test_overlap.py` pins bitwise
+  mesh parity of overlapped vs sequential finalize.
+* **correlation context** — `utils/events.context` /
+  `utils/trace.span` fields are contextvars; the task captures the
+  submitter's context via ``contextvars.copy_context()`` so worker-side
+  `events.record` / spans land in the same scan's journal slice. JAX's
+  ``default_device`` is a THREAD-LOCAL, not a contextvar (verified: a
+  copied context does not carry it), so it is captured explicitly at
+  submit and re-entered in the worker — a serve session finalizing
+  under its sticky lane's ``device_ctx`` keeps the solve on that lane.
+* **sanitizer-clean** — the worker owns no package-created locks (the
+  join is a bare Event wait), so the SL_SANITIZE lock-order checker
+  sees no new orderings, and a caller must never hold a session/service
+  lock across ``result()`` anyway (that would serialize the overlap it
+  exists to create).
+* **containment** — a worker crash is carried, not leaked: the
+  exception surfaces at ``result()``, where the sequential path would
+  have raised it.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+
+from .log import get_logger
+
+log = get_logger(__name__)
+
+
+class PipelinedTask:
+    """Run ``fn(*args, **kwargs)`` on a daemon thread, join later.
+
+    ``timings()`` exposes submit/start/end instants (``time.monotonic``
+    seconds) so callers can measure the realized concurrency window —
+    bench [6b] asserts the solve genuinely overlapped the merge tail
+    with these, and `stream/session.py` reports them in
+    ``FinalizeResult.stats["overlap"]``.
+    """
+
+    def __init__(self, fn, *args, name: str = "task", **kwargs):
+        self.name = str(name)
+        self._done = threading.Event()
+        self._result = None
+        self._exc: BaseException | None = None
+        self.t_submit = time.monotonic()
+        self.t_start: float | None = None
+        self.t_end: float | None = None
+        ctx = contextvars.copy_context()
+        # jax.default_device is thread-local (NOT a contextvar): read the
+        # effective value here, on the submitter's thread, and re-enter
+        # it in the worker. None (no jax, or no device override) → no-op.
+        try:
+            import jax
+
+            dev = jax.config.jax_default_device
+        except Exception:
+            dev = None
+
+        def _call():
+            if dev is None:
+                return fn(*args, **kwargs)
+            import jax
+
+            with jax.default_device(dev):
+                return fn(*args, **kwargs)
+
+        def _run():
+            self.t_start = time.monotonic()
+            try:
+                self._result = ctx.run(_call)
+            except BaseException as exc:  # re-raised at result()
+                self._exc = exc
+            finally:
+                self.t_end = time.monotonic()
+                self._done.set()
+
+        self._thread = threading.Thread(
+            target=_run, name=f"overlap-{self.name}", daemon=True)
+        self._thread.start()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None):
+        """Deterministic join: block until the worker finished, return
+        its value or re-raise its exception here. ``timeout`` guards
+        against a wedged device — expiry raises :class:`TimeoutError`
+        and the worker keeps running (daemon: it cannot block exit)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"PipelinedTask({self.name!r}) still running after "
+                f"{timeout:.1f}s — wedged device or runaway solve")
+        self._thread.join()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def timings(self) -> dict:
+        """Relative instants (seconds since submit); end values are
+        None while the task runs."""
+        t0 = self.t_submit
+        return {
+            "started_s": None if self.t_start is None
+            else round(self.t_start - t0, 6),
+            "ended_s": None if self.t_end is None
+            else round(self.t_end - t0, 6),
+        }

@@ -129,6 +129,23 @@ def test_nb8_table_chains_diagonals():
             assert nb8[i, j] == slot(c + np.asarray(o)), (i, j)
 
 
+def test_readback_is_vertices_and_faces_only(sphere_grid):
+    """The device tail (winding vote + weld + compaction) means the host
+    pulls exactly the final mesh: welded vertices (nv·12 bytes), face
+    indices (nf·12 bytes) and two scalar counts — NOT the (T, 3, 3)
+    triangle soup the host weld used to receive (ISSUE 17 acceptance:
+    transfer-size telemetry)."""
+    mesh = marching_jax.extract_sparse_jax(sphere_grid)
+    rb = marching_jax.LAST_READBACK
+    assert set(rb) == {"counts", "vertices", "faces"}
+    assert rb["vertices"] == len(mesh.vertices) * 3 * 4
+    assert rb["faces"] == len(mesh.faces) * 3 * 4
+    assert rb["counts"] <= 16
+    # The old soup transfer was ≥ nf·36 bytes of f32 — the welded pull
+    # must be strictly smaller than that floor.
+    assert rb["vertices"] < len(mesh.faces) * 9 * 4
+
+
 def test_classify_pallas_interpret_matches_xla():
     """The fused Mosaic classify kernel (interpret mode on CPU) agrees
     with the XLA inside/any/all form at every cell position."""

@@ -1,0 +1,219 @@
+"""Overlapped finalize pipeline (utils/overlap.py + the stream/serve
+finalize tail).
+
+Acceptance bars this file pins (ISSUE 17 tentpole):
+
+* **determinism** — `finalize(overlap=True)` (the default) produces the
+  SAME mesh bit-for-bit as `overlap=False`: overlap changes when the
+  solve runs, never what runs;
+* **zero steady-state recompiles** — the new overlapped finalize path
+  compiles nothing once a first finalize warmed the programs (the serve
+  steady-state bar extended to the pipelined worker, which the
+  process-wide compile telemetry still observes);
+* **TSDF default / archival opt-in** — `StreamParams()` finalizes by
+  integrate-don't-re-solve (vertex-colored TSDF extract); the Poisson
+  watertight artifact is the opt-in ``"archival"`` lane (TSDF previews,
+  Poisson final);
+* **worker semantics** — `PipelinedTask` re-raises worker exceptions at
+  the join, carries the submitter's contextvars (correlation ids) AND
+  the thread-local ``jax.default_device`` into the worker.
+"""
+
+import contextvars
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from structured_light_for_3d_model_replication_tpu.io.ply import (
+    PointCloud,
+)
+from structured_light_for_3d_model_replication_tpu.models import (
+    merge as merge_mod,
+)
+from structured_light_for_3d_model_replication_tpu.models import meshing
+from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+    make_calibration,
+)
+from structured_light_for_3d_model_replication_tpu.stream import (
+    IncrementalSession,
+    StreamParams,
+)
+from structured_light_for_3d_model_replication_tpu.utils import sanitize
+from structured_light_for_3d_model_replication_tpu.utils.overlap import (
+    PipelinedTask,
+)
+
+from .conftest import CAM_H, CAM_W, SMALL_PROJ
+
+# Same tiny registration surface as tests/test_stream.py TINY_STREAM so
+# the compiled programs are shared across files.
+TINYM = merge_mod.MergeParams(
+    voxel_size=6.0, ransac_iterations=512, icp_iterations=8,
+    fpfh_max_nn=32, normals_k=12, max_points=1024,
+    posegraph_iterations=20, step_deg=10.0)
+# No representation override: these sessions ride the NEW default lane.
+TSDF_STREAM = StreamParams(merge=TINYM, method="sequential",
+                           view_cap=4096, preview_points=1024,
+                           preview_depth=4, final_depth=5,
+                           model_cap=16_384, window=3,
+                           tsdf_grid_depth=6, tsdf_max_bricks=1024,
+                           covis=False)
+
+
+@pytest.fixture(scope="module")
+def small_calib(synth_rig):
+    cam_K, proj_K, R, T = synth_rig
+    return make_calibration(cam_K, proj_K, R, T, CAM_H, CAM_W,
+                            proj_width=SMALL_PROJ.width,
+                            proj_height=SMALL_PROJ.height)
+
+
+def _two_stop_session(small_calib, stack, scan_id, **overrides):
+    sp = dataclasses.replace(TSDF_STREAM, **overrides) if overrides \
+        else TSDF_STREAM
+    sess = IncrementalSession(small_calib, SMALL_PROJ.col_bits,
+                              SMALL_PROJ.row_bits, params=sp,
+                              scan_id=scan_id)
+    sess.add_stop(stack)
+    sess.add_stop(stack + np.uint8(1))   # same view, new exposure
+    return sess
+
+
+# ---------------------------------------------------------------------------
+# PipelinedTask unit semantics (no device work)
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_task_result_and_timings():
+    task = PipelinedTask(lambda a, b: a + b, 2, b=3, name="add")
+    assert task.result(timeout=30.0) == 5
+    assert task.done()
+    t = task.timings()
+    assert t["started_s"] is not None and t["ended_s"] is not None
+    assert 0.0 <= t["started_s"] <= t["ended_s"]
+
+
+def test_pipelined_task_reraises_at_join():
+    def boom():
+        raise RuntimeError("solver fell over")
+
+    task = PipelinedTask(boom, name="boom")
+    with pytest.raises(RuntimeError, match="solver fell over"):
+        task.result(timeout=30.0)
+
+
+def test_pipelined_task_carries_context_and_device():
+    """The worker sees the submitter's contextvars (correlation ids for
+    events/trace) and the submitter's thread-local jax.default_device
+    (a serve session finalizing under its sticky lane)."""
+    var = contextvars.ContextVar("overlap_test", default="unset")
+    var.set("submitter")
+    dev = jax.devices("cpu")[0]
+
+    def probe():
+        return var.get(), jax.config.jax_default_device
+
+    with jax.default_device(dev):
+        task = PipelinedTask(probe, name="probe")
+    got_var, got_dev = task.result(timeout=30.0)
+    assert got_var == "submitter"
+    assert got_dev is dev
+
+
+# ---------------------------------------------------------------------------
+# Representation seam: tsdf default, archival opt-in
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_default_is_tsdf():
+    """Integrate-don't-re-solve is the default finalize; Poisson is the
+    opt-in archival/legacy lane (ISSUE 17 representation flip)."""
+    assert StreamParams().representation == "tsdf"
+    for ok in ("tsdf", "archival", "poisson", "splat"):
+        dataclasses.replace(TSDF_STREAM, representation=ok)
+    with pytest.raises(ValueError, match="representation"):
+        IncrementalSession(
+            None, 6, 5,
+            params=dataclasses.replace(TSDF_STREAM,
+                                       representation="octree"))
+
+
+def test_meshing_archival_alias_is_poisson(rng):
+    """models/meshing accepts representation="archival" as an alias of
+    the Poisson watertight path (what the CLI batch lane passes
+    through), bit-identical output."""
+    n = 4096
+    pts = rng.normal(size=(n, 3)).astype(np.float32)
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    nrm = pts.copy()
+    pts = pts * 50.0
+    a = meshing.mesh_from_cloud(PointCloud(pts, normals=nrm), depth=5,
+                                representation="archival")
+    b = meshing.mesh_from_cloud(PointCloud(pts, normals=nrm), depth=5,
+                                representation="poisson")
+    assert np.array_equal(a.vertices, b.vertices)
+    assert np.array_equal(a.faces, b.faces)
+    with pytest.raises(ValueError, match="archival"):
+        meshing.mesh_from_cloud(PointCloud(pts, normals=nrm),
+                                representation="octree")
+
+
+def test_archival_session_tsdf_previews_poisson_final(synth_scan,
+                                                      small_calib):
+    """"archival": previews ride the TSDF volume (colored, incremental)
+    while finalize runs the full watertight Poisson solve — the
+    print/archive artifact, uncolored."""
+    stack, _ = synth_scan
+    sess = _two_stop_session(small_calib, stack, "t-overlap-archival",
+                             representation="archival")
+    assert sess.preview_meta["representation"] == "archival"
+    assert sess.preview.vertex_colors is not None   # TSDF preview lane
+    fin = sess.finalize(mesh=True)
+    assert len(fin.mesh.faces) > 0
+    assert fin.mesh.vertex_colors is None           # Poisson final
+
+
+# ---------------------------------------------------------------------------
+# Overlapped finalize: parity + steady state
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_finalize_bitwise_parity(synth_scan, small_calib):
+    """finalize(overlap=True) — the default — joins deterministically:
+    the mesh is bit-for-bit the sequential path's, and the realized
+    concurrency window is reported in stats["overlap"]."""
+    stack, _ = synth_scan
+    fin_o = _two_stop_session(small_calib, stack,
+                              "t-overlap-par-a").finalize(mesh=True)
+    fin_s = _two_stop_session(small_calib, stack,
+                              "t-overlap-par-b").finalize(mesh=True,
+                                                          overlap=False)
+    assert np.array_equal(fin_o.mesh.vertices, fin_s.mesh.vertices)
+    assert np.array_equal(fin_o.mesh.faces, fin_s.mesh.faces)
+    assert np.array_equal(fin_o.mesh.vertex_colors,
+                          fin_s.mesh.vertex_colors)
+    assert fin_o.mesh.vertex_colors is not None     # tsdf default lane
+    ov = fin_o.stats["overlap"]
+    assert ov["solve"]["started_s"] is not None
+    assert ov["solve"]["ended_s"] >= ov["solve"]["started_s"]
+    assert ov["tail_done_s"] > 0.0
+    assert isinstance(ov["overlapped"], bool)
+    assert "overlap" not in fin_s.stats             # sequential: no window
+
+
+def test_overlap_finalize_zero_steady_state_recompiles(synth_scan,
+                                                       small_calib):
+    """Once one finalize warmed the programs, the overlapped finalize
+    path — including the solve on the pipelined worker, which the
+    process-wide compile telemetry still sees — compiles nothing."""
+    stack, _ = synth_scan
+    _two_stop_session(small_calib, stack,
+                      "t-overlap-warm").finalize(mesh=True)
+    sess = _two_stop_session(small_calib, stack, "t-overlap-steady")
+    with sanitize.no_compile_region("overlapped-finalize"):
+        fin = sess.finalize(mesh=True)
+    assert len(fin.mesh.faces) > 0
+    assert "overlap" in fin.stats

@@ -502,6 +502,65 @@ def test_unknown_preconditioner_rejected(rng):
 
 
 @pytest.mark.slow
+def test_bf16_fine_band_matches_fp32(rng):
+    """fine_dtype="bfloat16" demotes ONLY the preconditioner's relaxation
+    arithmetic; the fp32 residual stopping rule must keep the converged
+    surface inside the same error envelope as the fp32 mode (the bench
+    [3d]/[3e] gate, here measured at a CI-sized depth 9). ~100 s of
+    solves, so it rides the slow tier plus an explicit node-id run in
+    the meshtail-smoke CI job."""
+    pts, nrm = _sphere_cloud(rng, 60_000, r=50.0)
+    anchors = np.asarray(
+        [[s * 100.0, t * 100.0, u * 100.0]
+         for s in (-1, 1) for t in (-1, 1) for u in (-1, 1)], np.float32)
+    pts = np.vstack([pts, anchors])
+    nrm = np.vstack([nrm, np.tile([1.0, 0.0, 0.0], (8, 1))]).astype(
+        np.float32)
+    base = poisson_sparse.PoissonParams(
+        depth=9, cg_iters=24, max_blocks=32_768, coarse_depth=7,
+        coarse_iters=150)
+    assert base.fine_dtype == "float32"      # fp32 stays the default
+    g32, _, s32 = poisson_sparse.reconstruct_sparse(
+        pts, nrm, params=base, with_stats=True)
+    g16, _, s16 = poisson_sparse.reconstruct_sparse(
+        pts, nrm, params=base._replace(fine_dtype="bfloat16"),
+        with_stats=True)
+    assert s32["fine_dtype"] == "float32"
+    assert s16["fine_dtype"] == "bfloat16"
+    voxel = float(g16.scale)
+
+    def shell_err(grid):
+        mesh = marching.extract_sparse(grid)
+        rad = np.linalg.norm(mesh.vertices, axis=1)
+        shell = rad < 100.0        # drop the 8 anchor blobs
+        assert shell.mean() > 0.9
+        return np.abs(rad[shell] - 50.0)
+
+    e32, e16 = shell_err(g32), shell_err(g16)
+    # Absolute envelope: same bounds the fp32 surface-error tests pin.
+    assert np.median(e16) < 3.0 * voxel, (np.median(e16), voxel)
+    assert np.percentile(e16, 90) < 8.0 * voxel
+    # Relative to fp32, the bench-gate deltas: the demoted relaxation
+    # may change the Krylov path, not the converged surface.
+    assert abs(np.median(e16) - np.median(e32)) < 0.35 * voxel
+    assert abs(np.percentile(e16, 90)
+               - np.percentile(e32, 90)) < 3.0 * voxel
+
+
+def test_bf16_rejected_on_jacobi_and_bogus_dtype(rng):
+    pts, nrm = _sphere_cloud(rng, 100)
+    with pytest.raises(ValueError, match="jacobi"):
+        poisson_sparse.reconstruct_sparse(
+            pts, nrm, params=poisson_sparse.PoissonParams(
+                depth=7, preconditioner="jacobi",
+                fine_dtype="bfloat16"))
+    with pytest.raises(ValueError, match="fine_dtype"):
+        poisson_sparse.reconstruct_sparse(
+            pts, nrm, params=poisson_sparse.PoissonParams(
+                depth=7, fine_dtype="float16"))
+
+
+@pytest.mark.slow
 def test_deep_depth_auto_raises_coarse_grid(rng, monkeypatch):
     """The depth-15 p90 tail fix, pinned at the dispatch level: with no
     explicit coarse_depth the coarse grid must scale so the coarse/fine

@@ -352,6 +352,71 @@ def test_device_fault_plan_env_roundtrip_and_determinism(monkeypatch):
     assert [r.device for r in a.rules] == [r.device for r in b.rules]
 
 
+def test_device_loss_taxonomy_per_backend():
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+
+    # The injected class classifies on any backend, message or not.
+    assert faults.is_device_loss(faults.DeviceLostError("x"),
+                                 backend="cpu")
+    # Backend-specific vocabulary: a TPU "core halted" is a dead chip;
+    # the same words on a CPU backend are somebody's debugger.
+    halted = RuntimeError("INTERNAL: Core halted unexpectedly")
+    assert faults.is_device_loss(halted, backend="tpu")
+    assert not faults.is_device_loss(halted, backend="cpu")
+    # Lazy default: jax.default_backend() is "cpu" in this suite, so
+    # the TPU vocabulary must NOT fire without an explicit backend.
+    assert not faults.is_device_loss(halted)
+    # GPU spellings, plus the cuda/rocm backend-name aliases.
+    gone = RuntimeError("CUDA_ERROR_DEVICE_UNAVAILABLE: GPU is lost")
+    assert faults.is_device_loss(gone, backend="gpu")
+    assert faults.is_device_loss(gone, backend="cuda")
+    assert not faults.is_device_loss(gone, backend="tpu")
+    # The generic (injected-fault) vocabulary classifies everywhere.
+    for b in ("cpu", "tpu", "gpu"):
+        assert faults.is_device_loss(
+            RuntimeError("status: DEVICE_LOST"), backend=b)
+        # OOM is an overloaded lane, never a dead one — it must feed
+        # the breaker, not the lane-death escalation.
+        assert not faults.is_device_loss(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory"), backend=b)
+    # Unresolvable backend → the union of every vocabulary (an
+    # unclassifiable runtime must not silence a real loss).
+    assert faults.is_device_loss(halted, backend="weird-runtime")
+
+
+def test_device_loss_env_extension(monkeypatch):
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+
+    wedged = RuntimeError("neuron watchdog: engine wedged")
+    assert not faults.is_device_loss(wedged, backend="tpu")
+    # Per-backend pattern extension.
+    monkeypatch.setenv(faults.DEVICE_LOSS_PATTERNS_ENV,
+                       '{"tpu": ["engine wedged"]}')
+    assert faults.is_device_loss(wedged, backend="tpu")
+    assert not faults.is_device_loss(wedged, backend="gpu")
+
+    # Error-TYPE extension: keys on the exception class name (MRO-wide).
+    class VendorDriverDeath(RuntimeError):
+        pass
+
+    monkeypatch.setenv(
+        faults.DEVICE_LOSS_PATTERNS_ENV,
+        '{"gpu": {"types": ["VendorDriverDeath"], "patterns": []}}')
+    assert faults.is_device_loss(VendorDriverDeath("opaque"),
+                                 backend="gpu")
+    assert not faults.is_device_loss(VendorDriverDeath("opaque"),
+                                     backend="cpu")
+    # A bare comma list teaches every backend.
+    monkeypatch.setenv(faults.DEVICE_LOSS_PATTERNS_ENV,
+                       "ring bus parity, fabric link down")
+    assert faults.is_device_loss(RuntimeError("Ring bus PARITY error"),
+                                 backend="cpu")
+    # Malformed (valid JSON, wrong shape) is ignored, never raised.
+    monkeypatch.setenv(faults.DEVICE_LOSS_PATTERNS_ENV, "[1, 2]")
+    assert not faults.is_device_loss(RuntimeError("benign"),
+                                     backend="cpu")
+
+
 def test_lane_health_hysteresis_and_dead_callback():
     from structured_light_for_3d_model_replication_tpu.serve import lanes
 

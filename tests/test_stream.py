@@ -70,14 +70,20 @@ FASTM = merge_mod.MergeParams(
 TINYM = dataclasses.replace(FASTM, ransac_iterations=512,
                             icp_iterations=8, max_points=1024)
 
+# representation="poisson" pins the LEGACY lane these parity/warm-start
+# fixtures were written against (coarse Poisson re-solve previews +
+# Poisson final); the session default is now "tsdf" — covered by the
+# TSDF/archival tests below.
 FAST_STREAM = StreamParams(merge=FASTM, method="posegraph",
                            view_cap=8192, preview_points=2048,
                            preview_depth=5, final_depth=6,
-                           model_cap=32_768, window=3, expected_stops=4)
+                           model_cap=32_768, window=3, expected_stops=4,
+                           representation="poisson")
 TINY_STREAM = StreamParams(merge=TINYM, method="sequential",
                            view_cap=4096, preview_points=1024,
                            preview_depth=4, final_depth=5,
-                           model_cap=16_384, window=3)
+                           model_cap=16_384, window=3,
+                           representation="poisson")
 
 
 @pytest.fixture(scope="module")
@@ -406,6 +412,10 @@ def stream_service(serve_ring):
             posegraph_iterations=10, step_deg=12.0),
         method="posegraph", view_cap=1024, preview_points=1024,
         preview_depth=4, final_depth=5, model_cap=8192, window=3,
+        # Legacy-lane service default (the session default is now
+        # "tsdf"); per-session representation overrides below exercise
+        # the tsdf / splat / archival lanes explicitly.
+        representation="poisson",
         # Tiny splat lane so representation="splat" sessions stay
         # CPU-cheap (the render roundtrip tests below).
         splat_cap=2048, splat_fit_iters=4, splat_fit_pixels=960,
@@ -500,6 +510,35 @@ def test_serve_session_tsdf_colored_mesh(stream_service, serve_ring):
     mesh = read_ply_mesh(io.BytesIO(body))
     assert len(mesh.faces) > 0
     assert mesh.vertex_colors is not None
+    client.delete_session(sid)
+
+
+def test_serve_session_archival_roundtrip(stream_service, serve_ring):
+    """Session option representation="archival": live previews ride the
+    TSDF lane (colored, integrate-don't-re-solve) while finalize runs
+    the full-depth watertight Poisson solve — the print/archive
+    artifact, which carries no vertex colors."""
+    from structured_light_for_3d_model_replication_tpu.io.ply import (
+        read_ply_mesh,
+    )
+
+    _, client = stream_service
+    sid = client.create_session(representation="archival")
+    for stack in serve_ring[:2]:
+        st = client.wait(client.submit_stop(sid, stack), timeout_s=120.0)
+        assert st["status"] == "done", st
+    status = client.session_status(sid)
+    assert status["representation"] == "archival"
+    # The live previewer is the TSDF lane riding under the archival
+    # label — colored faces, no per-stop Poisson re-solve.
+    assert status["preview"]["representation"] == "archival"
+    assert int(status["preview"]["faces"]) > 0
+    fin = client.finalize_session(sid, result_format="mesh_ply")
+    assert fin["result"]["colored"] is False, fin
+    body = client.result(fin["job_id"])
+    mesh = read_ply_mesh(io.BytesIO(body))
+    assert len(mesh.faces) > 0
+    assert mesh.vertex_colors is None
     client.delete_session(sid)
 
 

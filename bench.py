@@ -81,7 +81,16 @@ The remaining BASELINE configs are measured too and written to
    session re-pinned to a survivor with zero program-cache miss
    growth, and emits the ``lane_failover_s`` headline line (first
    injected fault → the victim session's first completed stop on the
-   adopted lane);
+   adopted lane); 7c2 is the SHARDED-CHAOS gate (set-keyed spans,
+   probe-convict attribution): an 8-wide sharded-only load with the
+   FIRST device in enumeration order seeded dead — asserts the probe
+   convicts the actual casualty, the span re-forms 4-wide from the
+   LIVE set (the old devices[:k] prefix zeroed the tier here), zero
+   lost acked jobs, flat steady-state program-cache misses after the
+   re-form warm, revival restores the 8-wide span and rebalances the
+   displaced session home with bitwise finalize parity — emits the
+   ``sharded_failover_s`` headline line (first injected fault → first
+   job completed on the re-formed span);
 8. streaming incremental reconstruction (`stream/`) on the same 24-stop
    scan: per-stop fusion with progressive previews — emits the
    ``first_preview_s`` and ``incremental_vs_batch_final_s`` headline
@@ -1800,6 +1809,204 @@ def main():
                 svc.drain(timeout=60.0)
 
     guarded("serve_lane_chaos", config7c)
+
+    # ------------------------------------------------------------------
+    # Config 7c2: SHARDED-CHAOS gate (set-keyed spans + probe-convict
+    # attribution, serve/lanes.py). An 8-wide sharded-only load with
+    # the FIRST device in enumeration order seeded dead: the sharded
+    # launch error cannot name the casualty, so the pool's span-fault
+    # streak fires the service's per-member probe, which convicts the
+    # actual dead chip and re-forms a 4-wide span from the LIVE set
+    # (the old devices[:k] prefix turned the tier OFF when device 0
+    # died). Asserts zero lost acked jobs, flat steady-state
+    # program-cache misses after the re-form warm, probe-revival
+    # restoring the full 8-wide span, the displaced sticky session
+    # rebalanced home, and bitwise finalize parity against a
+    # never-faulted session — emits the ``sharded_failover_s``
+    # headline (first injected fault → first job completed on the
+    # re-formed span).
+    # ------------------------------------------------------------------
+    def config7c2():
+        from structured_light_for_3d_model_replication_tpu.config import (
+            ProjectorConfig as _PC,
+        )
+        from structured_light_for_3d_model_replication_tpu.hw import (
+            faults as hwfaults,
+        )
+        from structured_light_for_3d_model_replication_tpu.serve import (
+            ReconstructionService,
+            ServeConfig,
+        )
+        from structured_light_for_3d_model_replication_tpu.serve import (
+            lanes as lanes_mod,
+        )
+        from structured_light_for_3d_model_replication_tpu.stream import (
+            StreamParams,
+        )
+
+        n_local = len(jax.local_devices())
+        if n_local < 8:
+            _log(f"[7c2] skipped: {n_local} local device(s) — force 8 "
+                 "with XLA_FLAGS=--xla_force_host_platform_device_count=8")
+            details["serve_sharded_chaos"] = {
+                "skipped": f"{n_local} local device(s)"}
+            flush_details()
+            return
+
+        chaos_proj = _PC(width=160, height=96)
+        chaos_stack = np.asarray(patterns.pattern_stack(
+            chaos_proj.width, chaos_proj.height, chaos_proj.col_bits,
+            chaos_proj.row_bits, chaos_proj.brightness))
+        sh, sw = chaos_stack.shape[1], chaos_stack.shape[2]
+        assert sh % 8 == 0, (sh, "rows must divide the 8-wide span")
+        platform = jax.devices()[0].platform
+        victim_label = f"{platform}:0"   # FIRST in enumeration order
+        # 2 clean sharded launches, then a bounded dead window: two
+        # sharded faults feed the streak, the third fires the convict
+        # probe, two more eat the revive probes, then the chip answers.
+        plan = hwfaults.DeviceFaultPlan([hwfaults.DeviceFaultRule(
+            device=victim_label, kind="device_lost", after_launches=2,
+            count=5)])
+        prev_env = os.environ.get(hwfaults.DEVICE_FAULTS_ENV)
+        os.environ[hwfaults.DEVICE_FAULTS_ENV] = plan.to_env()
+        svc = None
+        try:
+            try:
+                cfg = ServeConfig(
+                    proj=chaos_proj, buckets=((sh, sw),),
+                    batch_sizes=(1,), linger_ms=5.0, queue_depth=32,
+                    workers=2, devices=8, content_cache=False,
+                    shard_min_pixels=sh * sw, shard_devices=8,
+                    stream=StreamParams(preview_depth=5),
+                    device_probe_interval_s=1.0,
+                    device_probe_backoff_max_s=2.0)
+                svc = ReconstructionService(cfg)
+                t0 = time.perf_counter()
+                svc.start()
+                warm_s = time.perf_counter() - t0
+                warmed_misses = svc.cache.stats()["misses"]
+            finally:
+                if prev_env is None:
+                    os.environ.pop(hwfaults.DEVICE_FAULTS_ENV, None)
+                else:
+                    os.environ[hwfaults.DEVICE_FAULTS_ENV] = prev_env
+            injector = svc.fault_injector
+            assert injector is not None, "SL_DEVICE_FAULTS did not arm"
+            full_span = tuple(sorted(
+                f"{platform}:{i}" for i in range(8)))
+            assert svc.lanes.span_devices() == full_span, \
+                svc.lanes.span_devices()
+            # First session lands on lane 0 — the doomed chip.
+            sid = svc.create_session({"covis": False})["session_id"]
+            sid_ref = svc.create_session({"covis": False})["session_id"]
+            victim = svc.sessions.get(sid)
+            assert victim.lane.label == victim_label, victim.lane
+            stacks = [chaos_stack + np.uint8(1 + i % 7)
+                      for i in range(6)]
+            acked = []
+            for s in stacks:
+                job = svc.submit_session_stop(sid, s)
+                acked.append(job)
+                assert job.wait(180.0), job.status_dict()
+            lost = [j.status_dict() for j in acked
+                    if j.status != "done"]
+            assert not lost, lost[:3]        # zero lost acked jobs
+            # Attribution: exactly ONE device died — the real casualty
+            # — via the span-fault streak + per-member probe.
+            snap = svc.registry.snapshot()
+            assert sum(snap.get("serve_sharded_span_faults_total",
+                                {}).values()) >= 2
+            assert sum(snap.get("serve_sharded_span_probes_total",
+                                {}).values()) >= 1
+            assert sum(snap.get("serve_device_dead_total",
+                                {}).values()) == 1
+            reformed_misses = svc.cache.stats()["misses"]
+            t_fault = injector.first_fault_t()
+            assert t_fault is not None, "no fault injected"
+            adopted = [j.finished_t for j in acked
+                       if j.status == "done"
+                       and j.finished_t is not None
+                       and j.finished_t > t_fault
+                       and j.launch_retries > 0]
+            assert adopted, "no job completed on the re-formed span"
+            failover_s = min(adopted) - t_fault
+            # Probe-revival: the bounded fault window drains, the chip
+            # answers, the span returns to the FULL 8-wide set and the
+            # displaced sticky session migrates home.
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not (
+                    svc.lanes.device_state(victim_label)
+                    == lanes_mod.LANE_HEALTHY
+                    and svc.lanes.span_devices() == full_span
+                    and victim.lane.label == victim_label):
+                time.sleep(0.1)
+            assert svc.lanes.span_devices() == full_span, \
+                "revival never restored the 8-wide span"
+            assert victim.lane.label == victim_label, \
+                "revival never rebalanced the session home"
+            # Steady state after the re-form warm + revival: sharded
+            # traffic grows ZERO program-cache misses.
+            steady = svc.cache.stats()["misses"]
+            extra = [chaos_stack + np.uint8(11),
+                     chaos_stack + np.uint8(12)]
+            for s in extra:
+                job = svc.submit_session_stop(sid, s)
+                acked.append(job)
+                assert job.wait(180.0) and job.status == "done", \
+                    job.status_dict()
+            cache = svc.cache.stats()
+            assert cache["misses"] == steady, (steady, cache)
+            # Bitwise finalize parity: a never-faulted session over the
+            # SAME stacks produces identical bytes.
+            for s in stacks + extra:
+                job = svc.submit_session_stop(sid_ref, s)
+                assert job.wait(180.0) and job.status == "done", \
+                    job.status_dict()
+            got = svc.finalize_session(sid, result_format="ply")
+            ref = svc.finalize_session(sid_ref, result_format="ply")
+            assert got.status == "done" and ref.status == "done", \
+                (got.status_dict(), ref.status_dict())
+            assert len(got.result_bytes) > 0
+            assert got.result_bytes == ref.result_bytes, \
+                "migrated session finalize is not bitwise-identical"
+            snap = svc.registry.snapshot()
+            details["serve_sharded_chaos"] = {
+                "stack": f"{sh}x{sw}x{chaos_stack.shape[0]}",
+                "warmup_s": round(warm_s, 2),
+                "jobs_acked": len(acked),
+                "jobs_lost": len(lost),
+                "devices_dead": sum(
+                    snap.get("serve_device_dead_total", {}).values()),
+                "span_faults": sum(
+                    snap.get("serve_sharded_span_faults_total",
+                             {}).values()),
+                "span_probes": sum(
+                    snap.get("serve_sharded_span_probes_total",
+                             {}).values()),
+                "session_rebalances": sum(
+                    snap.get("serve_lane_rebalances_total",
+                             {}).values()),
+                "faults_injected": len(injector.injected),
+                "warm_misses": warmed_misses,
+                "reform_misses": reformed_misses - warmed_misses,
+                "sharded_failover_s": round(failover_s, 4),
+            }
+            flush_details()
+            _log(f"[7c2] sharded failover {failover_s:.3f}s "
+                 f"({len(acked)} acked jobs, 0 lost, "
+                 f"{len(injector.injected)} faults injected, "
+                 f"span re-formed {reformed_misses - warmed_misses} "
+                 "compile(s) off the hot path)")
+            print(json.dumps({"metric": "sharded_failover_s",
+                              "value": round(failover_s, 4),
+                              "unit": "s",
+                              "direction": "lower_is_better"}),
+                  flush=True)
+        finally:
+            if svc is not None:
+                svc.drain(timeout=60.0)
+
+    guarded("serve_sharded_chaos", config7c2)
 
     # ------------------------------------------------------------------
     # Config 9: durability soak — sustained offered load against a

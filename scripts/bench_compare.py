@@ -59,8 +59,10 @@ def higher_is_better(metric: str) -> bool:
     going UP is the improvement, going down the regression.
     Latency-shaped fleet lines (``fleet_failover_s``, the proactive
     tier's ``fleet_proactive_repin_s`` — background adoption must get
-    FASTER — and config [7c]'s ``lane_failover_s``, the device-loss
-    tier's fault-to-adopted-lane window), config [11]'s per-stop
+    FASTER — config [7c]'s ``lane_failover_s``, the device-loss
+    tier's fault-to-adopted-lane window, and config [7c2]'s
+    ``sharded_failover_s``, the sharded tier's fault-to-re-formed-span
+    window — probe conviction must stay cheap), config [11]'s per-stop
     preview latency (``tsdf_preview_s``), config [12]'s per-view
     render latency (``render_view_s``), config [6b]'s finalize-tail
     lines (``full_360_scan_to_mesh_s`` re-based on the overlapped

@@ -647,6 +647,48 @@ class FaultyDevice:
         return out
 
 
+class FaultySpan:
+    """Span-member targeting for the sharded cross-chip tier: wraps one
+    sharded AOT executable and consults the injector for EVERY device in
+    the program's span, so an ``SL_DEVICE_FAULTS`` rule naming a single
+    member (``"cpu:0"``) kills/poisons the whole sharded launch — which
+    is exactly what a real mesh does when one chip dies. Each member's
+    launch counter advances per sharded launch (a span launch IS a
+    launch on every member), keeping count-limited (transient) rules'
+    windows consistent with the per-lane wrapper's.
+
+    The raised loss deliberately does NOT name the guilty member to the
+    caller-visible error flow the worker classifies on — attribution is
+    the probe-convict protocol's job (`serve/service.py`,
+    docs/ROBUSTNESS.md), and a chaos error that confessed would test
+    nothing."""
+
+    def __init__(self, compiled, span: Sequence[str],
+                 injector: DeviceFaultInjector):
+        self.compiled = compiled
+        self.span = tuple(span)
+        self.injector = injector
+
+    def __call__(self, *args):
+        fired = None
+        for label in self.span:
+            rule = self.injector.next_fault(label)
+            if rule is not None and fired is None:
+                fired = (rule, label)
+        if fired is not None:
+            rule, label = fired
+            if rule.kind in ("latency", "hang"):
+                self.injector._sleep(rule.stall_s)
+            if rule.kind in ("device_lost", "hang"):
+                raise DeviceLostError(
+                    "injected device loss on sharded span "
+                    f"{'+'.join(self.span)} (kind={rule.kind})")
+        out = self.compiled(*args)
+        if fired is not None and fired[0].kind == "nan_output":
+            out = self.injector.poison_output(out)
+        return out
+
+
 class FlakyChannel:
     """Fault wrapper over a ``CommandChannel``-shaped object: a ``drop``
     fault swallows the trigger (the phone never saw the command — the

@@ -75,5 +75,34 @@ def serve_space_mesh(n_devices: int, devices=None) -> Mesh:
     return make_mesh(data=1, space=int(n_devices), devices=devices)
 
 
+def resolve_device_labels(labels, devices=None) -> list:
+    """The jax.Device objects behind a span of ``"platform:id"`` labels,
+    in device ENUMERATION order (not label order) — mesh row placement
+    must be reproducible across processes that enumerate the same
+    topology, regardless of how the span set was sorted for its
+    program-key identity. Raises on a label no local device answers to
+    (a span staged against a phantom chip must fail at build time, not
+    at launch)."""
+    devices = list(devices if devices is not None else jax.local_devices())
+    want = set(labels)
+    out = [d for d in devices if f"{d.platform}:{d.id}" in want]
+    if len(out) != len(want):
+        have = {f"{d.platform}:{d.id}" for d in devices}
+        raise ValueError(
+            f"unknown device label(s) {sorted(want - have)} in span "
+            f"{sorted(want)}; local devices: {sorted(have)}")
+    return out
+
+
+def serve_span_mesh(labels, devices=None) -> Mesh:
+    """Set-keyed serving mesh: one job spans EXACTLY the named devices
+    (`serve/cache.ProgramKey.span`), not a count-prefix of the
+    enumeration. This is what lets the sharded tier drop one dead
+    member and keep the other chips working (docs/MESHING.md § shard
+    degrade) — a prefix mesh dies whole when device 0 does."""
+    devs = resolve_device_labels(labels, devices)
+    return make_mesh(data=1, space=len(devs), devices=devs)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

@@ -52,18 +52,28 @@ class ProgramKey:
     executables, so the zero-recompile steady-state assertion is
     per-chip. ``shards`` > 0 selects the sharded cross-chip tier
     instead: one program whose camera rows span that many devices
-    (`parallel/mesh.py`). ``device=None, shards=0`` is the historical
-    single-default-device program.
+    (`parallel/mesh.py`). ``span`` is the sharded program's device-SET
+    identity — the sorted labels of the exact chips the mesh is built
+    over — so a span re-formed around a dead member
+    (``lanes.DeviceLanePool.span_devices``) is a distinct executable
+    from the full-width one, and reviving the member brings the
+    still-cached full-span program back without a compile. An empty
+    span with ``shards`` > 0 is the historical count-prefix program
+    (first ``shards`` devices in enumeration order). ``device=None,
+    shards=0`` is the historical single-default-device program.
     """
 
     bucket: BucketKey
     batch: int
     device: str | None = None
     shards: int = 0
+    span: tuple = ()
 
     def label(self) -> str:
         base = f"B{self.batch}:{self.bucket.label()}"
         if self.shards:
+            if self.span:
+                return f"{base}@mesh{self.shards}[{'+'.join(self.span)}]"
             return f"{base}@mesh{self.shards}"
         if self.device is not None:
             return f"{base}@{self.device}"
@@ -118,14 +128,37 @@ class ProgramCache:
         # arrays must be reused at every call.
         self._placements: dict = {}
         self._placed_calibs: dict = {}
+        self._meshes: dict = {}
 
     # -- placement (device lanes / sharded tier) -----------------------
+
+    def _mesh_for(self, key: ProgramKey):
+        """The mesh a sharded ``key`` stages over, memoized per
+        (shards, span) so the replicated-calib and batch shardings of
+        one program share one Mesh object. Span-keyed keys resolve
+        their exact device set (`parallel/mesh.serve_span_mesh`);
+        span-less sharded keys keep the historical enumeration prefix."""
+        memo = (key.shards, key.span)
+        m = self._meshes.get(memo)
+        if m is None:
+            import jax
+
+            from ..parallel import mesh as pmesh
+
+            if key.span:
+                m = pmesh.serve_span_mesh(key.span)
+            else:
+                m = pmesh.serve_space_mesh(
+                    key.shards, devices=jax.local_devices()[:key.shards])
+            self._meshes[memo] = m
+        return m
 
     def _sharding_for(self, key: ProgramKey):
         """The input-batch sharding for ``key``: a SingleDeviceSharding
         for a lane-pinned program, the rows-over-space NamedSharding for
-        a sharded one, None for the historical default placement."""
-        memo = (key.device, key.shards)
+        a sharded one (over the key's exact device span when it carries
+        one), None for the historical default placement."""
+        memo = (key.device, key.shards, key.span)
         if memo in self._placements:
             return self._placements[memo]
         import jax
@@ -134,9 +167,7 @@ class ProgramCache:
         if key.shards:
             from ..parallel import mesh as pmesh
 
-            m = pmesh.serve_space_mesh(
-                key.shards, devices=jax.local_devices()[:key.shards])
-            sharding = pmesh.stack_batch_sharding(m)
+            sharding = pmesh.stack_batch_sharding(self._mesh_for(key))
         elif key.device is not None:
             dev = next((d for d in jax.local_devices()
                         if f"{d.platform}:{d.id}" == key.device), None)
@@ -155,7 +186,7 @@ class ProgramCache:
         Memoized per (bucket geometry, placement) — the arrays' identity
         must persist so AOT calls always see the lowered placement."""
         b = key.bucket
-        memo = (b.height, b.width, key.device, key.shards)
+        memo = (b.height, b.width, key.device, key.shards, key.span)
         with self._lock:
             placed = self._placed_calibs.get(memo)
         if placed is not None:
@@ -166,9 +197,8 @@ class ProgramCache:
 
             from ..parallel import mesh as pmesh
 
-            m = pmesh.serve_space_mesh(
-                key.shards, devices=jax.local_devices()[:key.shards])
-            calib = jax.device_put(calib, pmesh.replicated(m))
+            calib = jax.device_put(
+                calib, pmesh.replicated(self._mesh_for(key)))
         elif key.device is not None:
             import jax
 

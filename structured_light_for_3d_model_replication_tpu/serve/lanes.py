@@ -24,8 +24,16 @@ readyz-miss detector; only a successful probe revives a dead device),
 visible as ``serve_lane_state{device=}``. A dead transition fires the
 service's ``on_device_dead`` hook, sticky sessions re-pin to surviving
 lanes (``serve_lane_repins_total``), and the sharded big-bucket tier
-degrades its span down the 8→4→2→off ladder instead of launching over a
-dead mesh member (docs/MESHING.md § shard degrade).
+re-forms its span from the LIVE device set — the widest power-of-two
+width the survivors can fill, down the 8→4→2→off ladder — instead of
+launching over a dead mesh member (docs/MESHING.md § shard degrade).
+Spans are device SETS, not enumeration prefixes: chip 0 dying costs the
+tier one member, not the whole span. Sharded launches feed the same
+health machine through ``note_sharded_failure`` — N consecutive faults
+on one span fire ``on_span_suspect`` so the service can probe each
+member and convict the dead one (docs/ROBUSTNESS.md § probe-convict).
+On revive, ``rebalance_sessions`` migrates the sessions that were moved
+off the chip back home, with flap hysteresis.
 
 The pool is pure bookkeeping — no threads, no device I/O. Constructing
 one (without an explicit ``devices`` list) calls ``jax.local_devices()``,
@@ -100,7 +108,9 @@ class DeviceLanePool:
                  shard_min_pixels: int | None = None,
                  shard_devices: int = 0, devices=None,
                  registry: "trace.MetricsRegistry | None" = None,
-                 suspect_failures: int = 2, dead_failures: int = 3):
+                 suspect_failures: int = 2, dead_failures: int = 3,
+                 sharded_suspect_failures: int = 2,
+                 rebalance_flap_window_s: float = 300.0):
         if devices is None:
             import jax
 
@@ -124,7 +134,10 @@ class DeviceLanePool:
                               else min(int(shard_devices), len(devices)))
         self._lock = threading.Lock()
         self._session_lane: dict[str, DeviceLane] = {}
-        self._solve_meshes: dict[int, object] = {}
+        # Solve meshes are keyed by the span's device SET (sorted label
+        # tuple), not a count — a 4-wide span over {1,2,3,4} and one
+        # over {0,1,2,3} are different meshes.
+        self._solve_meshes: dict[tuple, object] = {}
         # -- lane health (device-loss tier) ----------------------------
         self.registry = registry if registry is not None \
             else trace.REGISTRY
@@ -139,6 +152,20 @@ class DeviceLanePool:
         # (the service hooks its re-pin/worker-deactivation here; that
         # work takes other locks and must not nest under ours).
         self.on_device_dead = None  # callable(label) | None
+        # -- sharded-fault attribution ---------------------------------
+        # Sharded launches can't name the dead member from the launch
+        # error alone; the pool counts consecutive faults per span and
+        # fires ``on_span_suspect`` (outside the lock) at the threshold
+        # so the service can probe-convict (docs/ROBUSTNESS.md).
+        self.sharded_suspect_failures = max(
+            1, int(sharded_suspect_failures))
+        self._span_failures: dict[tuple, int] = {}
+        self.on_span_suspect = None  # callable(span tuple) | None
+        # -- revival rebalancing ---------------------------------------
+        self.rebalance_flap_window_s = float(rebalance_flap_window_s)
+        self._displaced: dict[str, set[str]] = {}
+        self._revive_times: dict[str, list[float]] = {}
+        self._revives: dict[str, int] = {}
         self._state_gauge = {
             label: self.registry.gauge(
                 "serve_lane_state",
@@ -152,6 +179,17 @@ class DeviceLanePool:
             "serve_lane_repins_total",
             "sticky sessions re-pinned to a surviving lane after their "
             "device died")
+        self._span_faults = self.registry.counter(
+            "serve_sharded_span_faults_total",
+            "device-class faults observed on sharded cross-chip "
+            "launches (pre-attribution)")
+        self._span_probes = self.registry.counter(
+            "serve_sharded_span_probes_total",
+            "probe-convict rounds triggered by consecutive sharded "
+            "faults on one span")
+        self._rebalances = self.registry.counter(
+            "serve_lane_rebalances_total",
+            "sticky sessions migrated back to their revived device")
 
     # -- lanes ---------------------------------------------------------
 
@@ -278,12 +316,23 @@ class DeviceLanePool:
         return state
 
     def mark_device_dead(self, label: str, reason: str = "") -> bool:
-        """Escalation entry (the watchdog's repeatedly-wedged-lane path):
-        declare ``label`` dead directly. True iff this call made the
-        transition (idempotent — a second caller is a no-op)."""
+        """Escalation entry (the watchdog's repeatedly-wedged-lane path
+        and the probe-convict verdict on a sharded span member): declare
+        ``label`` dead directly. True iff this call made the transition
+        (idempotent — a second caller is a no-op). A span member that
+        hosts no lane gets its health record created here — the sharded
+        tier spans every pool device, not just the laned ones."""
         with self._lock:
             h = self._health.get(label)
-            if h is None or h.state == LANE_DEAD:
+            if h is None:
+                if self.device_by_label(label) is None:
+                    return False  # not a pool device at all
+                h = self._health[label] = _DeviceHealth()
+                self._state_gauge.setdefault(label, self.registry.gauge(
+                    "serve_lane_state",
+                    "device-lane health (0 healthy, 1 suspect, 2 dead)",
+                    device=label))
+            if h.state == LANE_DEAD:
                 return False
             self._set_state(h, label, LANE_DEAD)
             h.dead_since = time.monotonic()
@@ -301,7 +350,9 @@ class DeviceLanePool:
 
     def revive_device(self, label: str) -> bool:
         """The probe path's success: return a dead device to service
-        (healthy, streak cleared). True iff it was dead."""
+        (healthy, streak cleared). True iff it was dead. Each revive is
+        timestamped — ``rebalance_sessions`` reads the recent-revive
+        history as its flap hysteresis."""
         with self._lock:
             h = self._health.get(label)
             if h is None or h.state != LANE_DEAD:
@@ -310,6 +361,12 @@ class DeviceLanePool:
             h.dead_since = None
             h.reason = ""
             self._set_state(h, label, LANE_HEALTHY)
+            now = time.monotonic()
+            self._revives[label] = self._revives.get(label, 0) + 1
+            times = self._revive_times.setdefault(label, [])
+            times.append(now)
+            # Bounded: only stamps inside the flap window matter.
+            del times[:max(0, len(times) - 8)]
         events.record("device_revived", severity="info", device=label)
         log.info("device %s revived — rejoining the pool", label)
         return True
@@ -360,6 +417,11 @@ class DeviceLanePool:
                 load[lane.index] += 1
                 self._session_lane[sid] = lane
                 moved[sid] = lane
+            if moved:
+                # Remember who was displaced: revival rebalancing
+                # brings exactly these sessions home.
+                self._displaced.setdefault(
+                    dead_label, set()).update(moved)
         for sid, lane in moved.items():
             self._repins.inc()
             events.record("session_lane_repin", severity="warning",
@@ -367,71 +429,204 @@ class DeviceLanePool:
                           to_device=lane.label)
         return moved
 
+    def rebalance_sessions(self, label: str) -> dict[str, DeviceLane]:
+        """Revival rebalancing: migrate the sticky sessions that were
+        moved OFF ``label`` when it died back onto its lanes; returns
+        {session_id: new lane}. Their per-device session programs were
+        warmed at replica start (and re-warmed by the revive path), so
+        the move is compile-free and finalize stays bitwise.
+
+        Hysteresis: a chip revived more than once inside
+        ``rebalance_flap_window_s`` is flapping — its displaced
+        sessions stay on the survivors (kept recorded, so the next
+        STABLE revival still brings them home) rather than thrashing
+        back and forth with every blip."""
+        moved: dict[str, DeviceLane] = {}
+        with self._lock:
+            now = time.monotonic()
+            recent = [t for t in self._revive_times.get(label, ())
+                      if now - t <= self.rebalance_flap_window_s]
+            displaced = self._displaced.pop(label, set())
+            if not displaced:
+                return moved
+            if len(recent) > 1:
+                self._displaced[label] = displaced
+                events.record(
+                    "session_rebalance_deferred", severity="warning",
+                    device=label, sessions=len(displaced),
+                    revives_in_window=len(recent),
+                    message=f"device {label} is flapping "
+                            f"({len(recent)} revives in "
+                            f"{self.rebalance_flap_window_s:.0f}s); "
+                            "keeping displaced sessions on survivors")
+                return moved
+            targets = [ln for ln in self.lanes if ln.label == label]
+            if not targets:
+                return moved
+            load: dict[int, int] = {ln.index: 0 for ln in targets}
+            for assigned in self._session_lane.values():
+                if assigned.index in load:
+                    load[assigned.index] += 1
+            for sid in sorted(displaced):
+                cur = self._session_lane.get(sid)
+                if cur is None or cur.label == label:
+                    continue  # session ended, or already back home
+                lane = min(targets,
+                           key=lambda ln: (load[ln.index], ln.index))
+                load[lane.index] += 1
+                self._session_lane[sid] = lane
+                moved[sid] = lane
+        for sid, lane in moved.items():
+            self._rebalances.inc()
+            events.record("session_lane_rebalance", severity="info",
+                          session_id=sid, to_device=label,
+                          to_lane=lane.index)
+        return moved
+
+    # -- sharded-fault attribution -------------------------------------
+
+    def note_sharded_ok(self, span) -> None:
+        """A clean sharded launch over ``span``: the consecutive-fault
+        streak resets (attribution fires only on CONSECUTIVE faults —
+        an intermittently healthy span is the hysteresis's no-probe
+        case)."""
+        with self._lock:
+            self._span_failures.pop(tuple(span), None)
+
+    def note_sharded_failure(self, span, reason: str = "") -> int:
+        """A device-class fault on a sharded launch over ``span``;
+        returns the streak length. The launch error can't name WHICH
+        mesh member died, so nothing escalates per device here — at
+        ``sharded_suspect_failures`` consecutive faults the pool fires
+        ``on_span_suspect(span)`` outside the lock and resets the
+        streak (the probe verdict, not further counting, decides)."""
+        span = tuple(span)
+        fire = False
+        with self._lock:
+            n = self._span_failures.get(span, 0) + 1
+            if n >= self.sharded_suspect_failures:
+                self._span_failures.pop(span, None)
+                fire = True
+            else:
+                self._span_failures[span] = n
+        self._span_faults.inc()
+        events.record("sharded_span_fault", severity="warning",
+                      span=list(span), reason=reason, streak=n)
+        if fire:
+            self._span_probes.inc()
+            log.warning(
+                "span %s: %d consecutive sharded faults — requesting "
+                "per-member probe conviction", "+".join(span), n)
+            cb = self.on_span_suspect
+            if cb is not None:
+                cb(span)
+        return n
+
     # -- program routing ----------------------------------------------
 
-    def effective_shard_devices(self) -> int:
-        """The span the sharded tier can honestly use RIGHT NOW: the
-        configured ``shard_devices``, halved down the 8→4→2 ladder while
-        any device in the program's span (``devices[:k]`` — the mesh the
-        cache stages over) is dead. Below 2 the tier is off (0): the
-        bucket degrades to a lane-pinned program on a surviving chip
-        rather than launching over a dead mesh member
-        (docs/MESHING.md § shard degrade)."""
+    def span_devices(self, assume_live: str | None = None) -> tuple:
+        """The device SET the sharded tier spans RIGHT NOW: sorted
+        labels of the widest power-of-two span (≤ ``shard_devices``,
+        halving down the 8→4→2 ladder) fillable from the LIVE devices,
+        taken in enumeration order with dead members skipped — so one
+        early-order dead chip costs the span ONE member, not the whole
+        tier. Empty tuple = tier off (fewer than 2 live chips).
+
+        ``assume_live`` treats one (dead) label as live — the revive
+        path warms the post-revival span's program BEFORE flipping the
+        device back in, keeping the worker hot path compile-free."""
         k = self.shard_devices
+        if k < 2:
+            return ()
         with self._lock:
             dead = {d for d, h in self._health.items()
                     if h.state == LANE_DEAD}
-        if not dead:
-            return k
+        dead.discard(assume_live)
+        live = [device_label(d) for d in self.devices
+                if device_label(d) not in dead]
         while k >= 2:
-            span = {device_label(d) for d in self.devices[:k]}
-            if not (span & dead):
-                return k
+            if len(live) >= k:
+                return tuple(sorted(live[:k]))
             k //= 2
-        return 0
+        return ()
+
+    def effective_shard_devices(self) -> int:
+        """The span WIDTH the sharded tier can honestly use right now
+        (`span_devices`); 0 = tier off. Kept as the stats()/readyz
+        scalar — the span set itself is what programs key on."""
+        return len(self.span_devices())
+
+    def span_for(self, key: BucketKey) -> tuple:
+        """The device span a bucket's launch dispatches over: empty
+        (lane-pinned program) unless the sharded tier is enabled, the
+        live span covers >1 chip, the bucket meets the size threshold
+        AND its row count splits evenly over the span (GSPMD would pad
+        an uneven split; refusing keeps the dispatch decision — and the
+        warmed program set — exact)."""
+        if (self.shard_min_pixels is None
+                or key.height * key.width < self.shard_min_pixels):
+            return ()
+        span = self.span_devices()
+        if len(span) < 2 or key.height % len(span):
+            return ()
+        return span
 
     def shards_for(self, key: BucketKey) -> int:
-        """Shard count for a bucket: 0 (lane-pinned program) unless the
-        sharded tier is enabled, spans >1 chip, the bucket meets the
-        size threshold AND its row count splits evenly over the mesh
-        (GSPMD would pad an uneven split; refusing keeps the dispatch
-        decision — and the warmed program set — exact). With dead mesh
-        members the span degrades down the halving ladder first."""
-        shards = self.effective_shard_devices()
-        if (self.shard_min_pixels is None or shards < 2
+        """Shard count for a bucket (``len(span_for(key))``): 0 means a
+        lane-pinned program."""
+        return len(self.span_for(key))
+
+    def span_program_key(self, key: BucketKey, batch: int,
+                         span) -> ProgramKey | None:
+        """The sharded ProgramKey (bucket, batch) routes to over an
+        EXPLICIT span — the warm paths' view (probe-convict re-form and
+        revival compute their target span first, then warm its programs
+        off the hot path). None when the bucket wouldn't shard over
+        that span."""
+        span = tuple(span)
+        if (self.shard_min_pixels is None or len(span) < 2
                 or key.height * key.width < self.shard_min_pixels
-                or key.height % shards):
-            return 0
-        return shards
+                or key.height % len(span)):
+            return None
+        return ProgramKey(bucket=key, batch=batch, shards=len(span),
+                          span=span)
 
     def route(self, key: BucketKey, batch: int,
               lane: DeviceLane | None) -> ProgramKey:
         """The ProgramKey a (bucket, batch) launch uses from ``lane``:
-        the sharded cross-chip program when the bucket qualifies, else
-        the lane's per-device program."""
-        shards = self.shards_for(key)
-        if shards:
-            return ProgramKey(bucket=key, batch=batch, shards=shards)
+        the sharded cross-chip program (set-keyed to the current live
+        span) when the bucket qualifies, else the lane's per-device
+        program."""
+        span = self.span_for(key)
+        if span:
+            return ProgramKey(bucket=key, batch=batch, shards=len(span),
+                              span=span)
         device = (lane.label if lane is not None and self.multi_device
                   else None)
         return ProgramKey(bucket=key, batch=batch, device=device)
+
+    def span_jax_devices(self, span) -> list:
+        """The jax.Device objects behind a span, in pool enumeration
+        order (mesh row placement must not depend on label sort)."""
+        want = set(span)
+        return [d for d in self.devices if device_label(d) in want]
 
     def solve_mesh(self, key: BucketKey):
         """The `parallel/mesh.py` device mesh a sharded bucket's heavy
         postprocess solves (Poisson via ``mesh_from_cloud(device_mesh=
         …)``) span — None for lane-pinned buckets. Memoized: one Mesh
-        object per shard count."""
-        shards = self.shards_for(key)
-        if not shards:
+        object per device SET."""
+        span = self.span_for(key)
+        if not span:
             return None
         with self._lock:
-            mesh = self._solve_meshes.get(shards)
+            mesh = self._solve_meshes.get(span)
             if mesh is None:
                 from ..parallel import mesh as pmesh
 
                 mesh = pmesh.serve_space_mesh(
-                    shards, devices=self.devices[:shards])
-                self._solve_meshes[shards] = mesh
+                    len(span), devices=self.span_jax_devices(span))
+                self._solve_meshes[span] = mesh
             return mesh
 
     # -- sticky sessions ----------------------------------------------
@@ -441,19 +636,29 @@ class DeviceLanePool:
         sessions; ties break toward the lowest index — deterministic,
         which the placement tests rely on). Idempotent per session.
         Dead-device lanes are skipped — a degraded pool places every
-        new session on its surviving chips (falling back to all lanes
-        only in the every-device-dead degenerate, where the service is
-        not ready anyway)."""
+        new session on its surviving chips. The every-lane-dead
+        degenerate no longer picks blindly across all lanes: it ranks
+        by health state first (suspect before dead — a suspect chip may
+        still answer; a dead one won't until a probe revives it), then
+        load, so the least-doomed lane wins."""
         with self._lock:
             lane = self._session_lane.get(session_id)
             if lane is not None:
                 return lane
-            candidates = self._healthy_lanes() or self.lanes
             load = {ln.index: 0 for ln in self.lanes}
             for assigned in self._session_lane.values():
                 load[assigned.index] = load.get(assigned.index, 0) + 1
-            lane = min(candidates, key=lambda ln: (load[ln.index],
-                                                   ln.index))
+            candidates = self._healthy_lanes()
+            if candidates:
+                lane = min(candidates, key=lambda ln: (load[ln.index],
+                                                       ln.index))
+            else:
+                def rank(ln):
+                    h = self._health.get(ln.label)
+                    state = h.state if h is not None else LANE_HEALTHY
+                    return (_STATE_VALUE[state], load[ln.index],
+                            ln.index)
+                lane = min(self.lanes, key=rank)
             self._session_lane[session_id] = lane
             return lane
 
@@ -468,12 +673,27 @@ class DeviceLanePool:
     # ------------------------------------------------------------------
 
     def stats(self) -> dict:
+        span = (self.span_devices()
+                if self.shard_min_pixels is not None else ())
         with self._lock:
+            now = time.monotonic()
             per_lane: dict[int, int] = {ln.index: 0 for ln in self.lanes}
             for lane in self._session_lane.values():
                 per_lane[lane.index] = per_lane.get(lane.index, 0) + 1
             states = {label: h.state for label, h in self._health.items()}
             dead = sorted(d for d, s in states.items() if s == LANE_DEAD)
+            health = {
+                label: {
+                    "state": h.state,
+                    # Age, not the raw monotonic stamp — scrapers can't
+                    # share this process's clock origin.
+                    "dead_since_s": (round(now - h.dead_since, 3)
+                                     if h.dead_since is not None
+                                     else None),
+                    "revives": self._revives.get(label, 0),
+                }
+                for label, h in self._health.items()}
+            revives_total = sum(self._revives.values())
         return {
             "devices": [device_label(d) for d in self.devices],
             "lanes": [{"index": ln.index, "device": ln.label,
@@ -481,10 +701,14 @@ class DeviceLanePool:
                        "sessions": per_lane.get(ln.index, 0)}
                       for ln in self.lanes],
             # Degraded-pool honesty (the /fleet/signals + /readyz
-            # surface): how many chips the pool is actually running on.
+            # surface): how many chips the pool is actually running on,
+            # each tracked device's state/death age/revive count, and
+            # the exact span the sharded tier dispatches over.
             "devices_dead": dead,
             "devices_live": len(states) - len(dead),
+            "device_health": health,
+            "revives_total": revives_total,
+            "span_devices": list(span),
             "shard_min_pixels": self.shard_min_pixels,
-            "shard_devices": (self.effective_shard_devices()
-                              if self.shard_min_pixels is not None else 0),
+            "shard_devices": len(span),
         }

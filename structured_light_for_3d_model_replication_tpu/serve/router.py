@@ -337,6 +337,11 @@ class FleetRouter:
         # never fan out to replicas themselves).
         self._replica_stats: dict[str, dict] = {}
         self._rr = 0
+        # Smooth-weighted round-robin credit per replica (session
+        # creation spread): with equal weights it degenerates to plain
+        # round-robin; a degraded replica (dead chips, deep queue)
+        # accrues credit slower and is picked proportionally less.
+        self._wrr_credit: dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._board_thread: threading.Thread | None = None
@@ -635,6 +640,7 @@ class FleetRouter:
         workers = 0
         mem_frac = 0.0
         overload = 0
+        revives_total = span_width_total = 0
         for url in ready:
             s = snaps.get(url)
             if not s:
@@ -652,6 +658,12 @@ class FleetRouter:
             lanes_total += sum(1 for ln in lanes
                                if ln.get("device") not in dead)
             devices_dead += len(dead)
+            revives_total += int(lane_stats.get("revives_total") or 0)
+            # Span truth, not just a count: the autoscaler (and the
+            # weighted placement above) must see how wide the sharded
+            # tier actually runs fleet-wide.
+            span_width_total += len(lane_stats.get("span_devices")
+                                    or [])
             gov = s.get("governor") or {}
             overload = max(overload, int(gov.get("level") or 0))
             mem_frac = max(mem_frac,
@@ -670,6 +682,8 @@ class FleetRouter:
             "worker_lanes_total": workers,
             "device_lanes_total": lanes_total,
             "devices_dead_total": devices_dead,
+            "device_revives_total": revives_total,
+            "span_devices_total": span_width_total,
             "overload_level_max": overload,
             "memory_pressure_max": round(mem_frac, 4),
             "shed_total": shed_total,
@@ -736,24 +750,87 @@ class FleetRouter:
 
     # -- placement ------------------------------------------------------
 
+    def replica_weight(self, url: str) -> float:
+        """Health-aware load weight from the sweep's cached /healthz
+        snapshot: the replica's live-device fraction (a 7/8-chip
+        replica weighs 0.875 — it IS 7/8ths of a replica) scaled down
+        by its queue fill. 1.0 with no snapshot yet (cold start must
+        not zero anybody out), floored above 0 so a ready-but-strained
+        replica stays reachable rather than starved."""
+        with self._lock:
+            s = self._replica_stats.get(url)
+        if not s:
+            return 1.0
+        w = 1.0
+        lane_stats = s.get("lanes") or {}
+        devices = lane_stats.get("devices") or []
+        dead = lane_stats.get("devices_dead")
+        # Dead-count over the pool's full device list — NOT the
+        # "devices_live" field, which counts only health-TRACKED chips
+        # (lane devices + convicted span members) and would read a
+        # healthy 8-chip/2-lane replica as 2/8 alive.
+        if devices and isinstance(dead, list):
+            w *= max(0.0, 1.0 - min(1.0, len(dead) / len(devices)))
+        try:
+            depth = float(s.get("queue_depth") or 0)
+            cap = float(s.get("queue_capacity") or 0)
+        except (TypeError, ValueError):
+            depth = cap = 0.0
+        if cap > 0:
+            w *= max(0.0, 1.0 - min(1.0, depth / cap))
+        return max(w, 0.05)
+
     def place_submit(self, body: bytes) -> list[str]:
-        """Candidate replicas for one submit, consistent-hash owner
-        first: duplicates of the same bytes keep landing on the same
-        replica while it lives, so its local content cache answers."""
+        """Candidate replicas for one submit: the consistent-hash
+        preference list, load-weighted. Each candidate keeps its ring
+        rank with probability ``weight / max_weight``, decided by a
+        DETERMINISTIC per-(key, replica) draw — so duplicates of the
+        same bytes still land on the same replica (the content-cache
+        affinity contract holds exactly), equal weights reproduce the
+        pure ring order bit-for-bit, and a degraded replica sheds a
+        proportional slice of its keyspace to the next preference
+        instead of all (thundering re-key) or none (7/8 chips, 8/8
+        load)."""
         key = hashlib.sha256(body).hexdigest()
-        return self.ring.preference(key, avoid=self._down())
+        pref = self.ring.preference(key, avoid=self._down())
+        if len(pref) < 2:
+            return pref
+        weights = {u: self.replica_weight(u) for u in pref}
+        w_max = max(weights.values())
+        if w_max <= 0:
+            return pref
+        kept, demoted = [], []
+        for u in pref:
+            # Uniform in [0, 1) from the (key, replica) pair — stable
+            # across calls, independent across replicas.
+            draw = int(hashlib.sha256(
+                f"{key}|{u}".encode()).hexdigest()[:8], 16) / 0x100000000
+            (kept if draw < weights[u] / w_max else demoted).append(u)
+        return kept + demoted
 
     def place_session(self, session_id: str) -> list[str]:
         return self.ring.preference(session_id, avoid=self._down())
 
     def next_replica(self) -> str | None:
-        """Round-robin over ready replicas (session creation spread)."""
+        """Session creation spread: smooth weighted round-robin over
+        ready replicas. Equal weights (no signals scraped yet) cycle
+        exactly like the historical round-robin; a replica reporting
+        dead chips or a deep queue (``replica_weight``) is picked
+        proportionally less often."""
         ready = self.ready_replicas()
         if not ready:
             return None
+        weights = {u: self.replica_weight(u) for u in ready}
+        total = sum(weights.values())
         with self._lock:
-            self._rr += 1
-            return ready[self._rr % len(ready)]
+            credit = self._wrr_credit
+            for gone in [u for u in credit if u not in weights]:
+                credit.pop(gone)
+            for u in ready:
+                credit[u] = credit.get(u, 0.0) + weights[u]
+            pick = max(ready, key=lambda u: credit[u])
+            credit[pick] -= total
+            return pick
 
     # -- pin bookkeeping -------------------------------------------------
 

@@ -60,7 +60,7 @@ from .jobs import (
     StackFormatError,
     error_payload,
 )
-from .lanes import DeviceLanePool
+from .lanes import LANE_DEAD, DeviceLanePool
 from .sessions import SessionManager, UnknownSessionError
 from .store import JournalStore, SessionStreamStore
 from .tenants import TenantQuotas
@@ -331,6 +331,12 @@ class ReconstructionService:
             shard_devices=config.shard_devices,
             registry=self.registry)
         self.lanes.on_device_dead = self._on_device_dead
+        # Sharded-fault attribution (docs/ROBUSTNESS.md § probe-
+        # convict): N consecutive faults on one sharded span fire this
+        # hook; the service probes each member and convicts the dead
+        # one — the only way a sharded-only workload ever detects a
+        # chip death (the launch error can't name the member).
+        self.lanes.on_span_suspect = self._on_span_suspect
         # Lane re-resolution at absorb time (device-loss tier): a stop
         # whose session re-pinned must ride the adopting lane's buckets.
         self.batcher.lane_resolver = self._resolve_lane
@@ -463,6 +469,75 @@ class ReconstructionService:
             for b in self.config.batch_sizes:
                 keys.append(self.lanes.route(bkey, int(b), lane))
         return keys
+
+    def _span_program_keys(self, span) -> list:
+        """The sharded ProgramKeys the router would answer over an
+        EXPLICIT span, for every configured bucket × batch — the warm
+        set for a span about to come into service (probe-convict
+        re-form, revival restore). Warming these OFF the worker hot
+        path is what keeps the zero-recompile steady state across a
+        span change."""
+        keys = []
+        for h, w in self.config.buckets:
+            bkey = self._bucket_key(h, w)
+            for b in self.config.batch_sizes:
+                k = self.lanes.span_program_key(bkey, int(b), span)
+                if k is not None:
+                    keys.append(k)
+        return keys
+
+    def _warm_span_programs(self, span) -> bool:
+        """Compile/warm the sharded programs for ``span``; True when
+        every key is resident afterwards. Failures are contained — the
+        worker's next dispatch would compile inline (counted, slower,
+        but correct), so a warm failure must not block the span change
+        that routing has already made."""
+        ok = True
+        for k in self._span_program_keys(span):
+            try:
+                self.cache.get(k)
+            except Exception as e:
+                ok = False
+                events.record("span_warm_failed", severity="error",
+                              program=k.label(), message=str(e))
+        return ok
+
+    def _on_span_suspect(self, span) -> None:
+        """Probe-convict: the pool saw N consecutive device-class
+        faults on sharded launches over ``span`` (worker thread; no
+        locks held). Run the tiny probe program on EVERY span member —
+        the launch error couldn't name the casualty, the per-member
+        probe can — and convict the ones that fail via
+        ``mark_device_dead`` (which re-pins sessions, stops lane
+        workers, and schedules the probe-revive cycle exactly like a
+        lane-attributed death). Then warm the re-formed span's programs
+        so surviving sharded traffic stays compile-free."""
+        convicted = []
+        for label in span:
+            if self.lanes.device_state(label) == LANE_DEAD:
+                continue  # already convicted (e.g. by a lane launch)
+            if not self._probe_device(label):
+                convicted.append(label)
+        if not convicted:
+            # Inconclusive: every member answered its probe. Transient
+            # mesh failure (link blip, collective timeout) — leave the
+            # span alone; another fault streak re-probes.
+            events.record("span_probe_inconclusive", severity="warning",
+                          span=list(span))
+            return
+        for label in convicted:
+            events.record("span_member_convicted", severity="error",
+                          device=label, span=list(span))
+            log.error("sharded span %s: probe convicted member %s",
+                      "+".join(span), label)
+            self.lanes.mark_device_dead(
+                label, reason="sharded-fault probe conviction")
+        new_span = self.lanes.span_devices()
+        if new_span:
+            self._warm_span_programs(new_span)
+        if self.store is not None:
+            self.store.note("span_reformed", convicted=convicted,
+                            span=list(new_span))
 
     def _lane_device_count(self) -> int:
         return len(self.lanes.distinct_devices())
@@ -610,13 +685,17 @@ class ReconstructionService:
             return False
 
     def _revive_device(self, label: str) -> bool:
-        """Probe success: re-warm the lane's program set (cache hits
+        """Probe success: re-warm the lane's program set AND the
+        restored (post-revival) sharded span's programs (cache hits
         when still resident; honest counted compiles when the LRU
         evicted them while dead), THEN rejoin — fresh workers, restored
-        admission bound, fresh watchdog budget. Sessions moved off the
-        device stay where they are; new sessions rebalance onto it.
-        True iff the device actually rejoined (a failed re-warm keeps
-        it dead and the caller keeps its probe backoff)."""
+        admission bound, fresh watchdog budget — and migrate the
+        sessions that were displaced off this device back home
+        (``rebalance_sessions``; compile-free via the per-device
+        session warmup, so their finalize stays bitwise, with flap
+        hysteresis so a bouncing chip doesn't thrash them). True iff
+        the device actually rejoined (a failed re-warm keeps it dead
+        and the caller keeps its probe backoff)."""
         lanes = self.lanes.lanes_on(label)
         if not lanes:
             return False
@@ -624,11 +703,23 @@ class ReconstructionService:
             for k in self._lane_program_keys(lanes[0]):
                 if k.device == label:
                     self.cache.get(k)
+            # The span this revival restores (the full set again once
+            # every member is back): warmed BEFORE the device flips
+            # live, so the first sharded dispatch after the re-form is
+            # a hit, not an inline compile on the request path.
+            for k in self._span_program_keys(
+                    self.lanes.span_devices(assume_live=label)):
+                self.cache.get(k)
         except Exception as e:
             events.record("device_rewarm_failed", severity="error",
                           device=label, message=str(e))
             return False  # stays dead; the probe retries at backoff
         self.lanes.revive_device(label)
+        # Restore the admission bound in the same breath as the state
+        # flip: anything watching device_state() may act on HEALTHY
+        # immediately, and the worker-restart + rebalance steps below
+        # can take a while on a loaded host.
+        self._rescale_queue()
         self.governor.reset_restart_budget(label)
         with self._workers_lock:
             for lane in lanes:
@@ -641,9 +732,18 @@ class ReconstructionService:
                             f"serve-worker-r{self._worker_seq}", lane)
                         self.workers[i] = repl
                         repl.start()
-        self._rescale_queue()
+        # Revival rebalancing: bring the displaced sticky sessions home
+        # (after the fresh workers exist, so the lane can serve them).
+        moved = self.lanes.rebalance_sessions(label)
+        for sid, lane in moved.items():
+            entry = self.sessions.peek(sid)
+            if entry is not None:
+                entry.repin(lane)
+        if moved:
+            self.batcher.repin_pending()
         if self.store is not None:
-            self.store.note("device_revived", device=label)
+            self.store.note("device_revived", device=label,
+                            sessions_rebalanced=len(moved))
         return True
 
     # -- lifecycle ---------------------------------------------------------

@@ -99,6 +99,10 @@ class ServeSession:
         # at replica start, so placement and failover adoption are both
         # compile-free.
         self.lane = lane
+        # How many times this session's sticky lane moved (device-loss
+        # re-pins AND revival rebalances) — the chaos tests' migration
+        # evidence, surfaced in status_dict.
+        self.lane_moves = 0
         self.lock = threading.Lock()
         self.created_t = time.monotonic()
         self.last_t = self.created_t
@@ -122,8 +126,10 @@ class ServeSession:
         return jax.default_device(self.lane.device)
 
     def repin(self, lane) -> None:
-        """Move the session's sticky lane — the device-loss re-pin
-        (serve/lanes.py). The session's device-resident state (model
+        """Move the session's sticky lane — the device-loss re-pin and
+        the revival rebalance (serve/lanes.py) share this path, so
+        migrating BACK is as compile-free and bitwise as migrating
+        away. The session's device-resident state (model
         buffers, retained preps, preview grids) is UNCOMMITTED jax
         arrays throughout (built from host arrays under the lane's
         ``default_device`` context), so the next ingest/finalize under
@@ -135,6 +141,8 @@ class ServeSession:
         compute; total on-device data loss is the fleet handoff
         replay's domain, docs/SERVING.md failure matrix)."""
         with self.lock:
+            if lane is not self.lane:
+                self.lane_moves += 1
             self.lane = lane
 
     def ingest(self, points, colors, valid, coverage=None,
@@ -208,6 +216,7 @@ class ServeSession:
                    **self.session.status_dict()}
             if self.lane is not None:
                 out["device_lane"] = self.lane.label
+                out["lane_moves"] = self.lane_moves
             if self.result_job_id is not None:
                 out["result_job_id"] = self.result_job_id
             return out

@@ -221,21 +221,27 @@ class DeviceWorker:
             jobs=",".join(j.job_id for j in batch.jobs))
         nan_fault = isinstance(e, DeviceOutputError)
         if device_fault and self.lane_pool is not None \
-                and label is not None and not sharded and not nan_fault:
-            # Lane health hears only LANE-PINNED launches: a sharded
-            # program spans many chips, and we cannot tell WHICH mesh
-            # member died from here — blaming the driving worker's own
-            # (healthy) lane would kill the wrong chip while the dead
-            # member stayed "healthy" and the span never degraded. The
-            # dead member's own lane launches are the detection path
-            # (one lane per chip is the recommended topology); the
-            # sharded batch's jobs still retry below and re-dispatch
-            # through whatever span shards_for answers then. NaN
-            # faults defer attribution further (below): the fault
-            # could live in the DATA, and only the cross-lane retry's
-            # outcome disambiguates.
-            self.lane_pool.note_launch_failure(label,
-                                               reason="device_lost")
+                and not nan_fault:
+            if sharded:
+                # A sharded program spans many chips and the launch
+                # error cannot name WHICH mesh member died — blaming
+                # the driving worker's own (healthy) lane would kill
+                # the wrong chip. Instead the pool counts consecutive
+                # faults per SPAN; at the threshold it fires the
+                # service's probe-convict hook, which runs a tiny
+                # program on each member and feeds mark_device_dead
+                # with the actual casualty (docs/ROBUSTNESS.md §
+                # probe-convict). The batch's jobs still retry below
+                # and re-dispatch through whatever span route()
+                # answers after the re-form.
+                self.lane_pool.note_sharded_failure(
+                    key.span or (), reason=type(e).__name__)
+            elif label is not None:
+                # NaN faults defer attribution further (below): the
+                # fault could live in the DATA, and only the
+                # cross-lane retry's outcome disambiguates.
+                self.lane_pool.note_launch_failure(label,
+                                                   reason="device_lost")
         failed = 0
         for job in batch.jobs:
             if device_fault and nan_fault and not sharded \
@@ -247,7 +253,13 @@ class DeviceWorker:
                 # lane: without this, one poisoned upload retried a
                 # few times would walk every healthy device to dead.
                 pass
-            elif device_fault and self._retry_cross_lane(job, label):
+            elif device_fault and self._retry_cross_lane(
+                    job, None if sharded else label):
+                # Sharded faults exclude NO lane: the casualty is some
+                # span member (the probe's verdict, maybe this worker's
+                # own chip, maybe not) — excluding the driving lane
+                # here would strand retries in a 2-lane pool once the
+                # OTHER lane's device is convicted.
                 if nan_fault and label is not None:
                     # Deferred attribution: remember where the NaN
                     # happened; a CLEAN completion on another lane
@@ -319,14 +331,20 @@ class DeviceWorker:
         with self.tracer.span("serve.batch", program=key.label(),
                               occupancy=batch.occupancy):
             compiled = self.cache.get(key)
-            if self.fault_injector is not None and self.lane is not None \
-                    and not key.shards:
-                # Seeded device chaos (hw/faults.py): the lane boundary
-                # is where a dead/NaN-emitting chip manifests — the
-                # sharded cross-chip tier degrades via the pool's lane
-                # health instead (docs/MESHING.md § shard degrade).
-                compiled = hwfaults.FaultyDevice(
-                    compiled, self.lane.label, self.fault_injector)
+            if self.fault_injector is not None and self.lane is not None:
+                # Seeded device chaos (hw/faults.py): the launch
+                # boundary is where a dead/NaN-emitting chip manifests.
+                # Sharded launches consult the injector per SPAN MEMBER
+                # (FaultySpan) — a rule naming one chip kills the whole
+                # cross-chip program, exactly like a real mesh — so a
+                # sharded-only workload exercises the probe-convict
+                # attribution path under SL_DEVICE_FAULTS.
+                if key.shards and key.span:
+                    compiled = hwfaults.FaultySpan(
+                        compiled, key.span, self.fault_injector)
+                elif not key.shards:
+                    compiled = hwfaults.FaultyDevice(
+                        compiled, self.lane.label, self.fault_injector)
             calib = self.cache.placed_calib(key)
             with self.tracer.span("launch"):  # path: serve.batch.launch
                 out = compiled(self.cache.stage(key, batch.stacked()),
@@ -356,10 +374,14 @@ class DeviceWorker:
             # fault): the failure streak resets the moment the device
             # answers with sane output — and before the jobs turn
             # terminal, so a caller observing a done job observes the
-            # healthy lane too. Sharded launches are excluded both
-            # ways (see _handle_batch_failure): a cross-chip success
-            # is not evidence about THIS lane's chip and must not
-            # reset a genuine lane-pinned failure streak.
+            # healthy lane too. Sharded launches stay out of LANE
+            # health both ways (see _handle_batch_failure): a
+            # cross-chip success is not evidence about THIS lane's
+            # chip and must not reset a genuine lane-pinned failure
+            # streak — it resets the SPAN's consecutive-fault streak
+            # instead.
+            if self.lane_pool is not None and key.shards:
+                self.lane_pool.note_sharded_ok(key.span or ())
             if self.lane_pool is not None and self.lane is not None \
                     and not key.shards:
                 self.lane_pool.note_launch_ok(self.lane.label)

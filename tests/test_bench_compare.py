@@ -252,6 +252,41 @@ def test_fleet_headline_lines_and_direction(tmp_path, capsys):
     assert doc["regressions"] == 2
 
 
+def test_sharded_failover_metric_direction(tmp_path, capsys):
+    """Bench config [7c2] adds ``sharded_failover_s`` — the sharded
+    tier's first-fault-to-re-formed-span window (probe conviction +
+    span re-form + warmed retry). Latency-shaped: LOWER is better, and
+    --strict flags the window growing round over round."""
+    assert not bench_compare.higher_is_better("sharded_failover_s")
+    tail = "\n".join([
+        _headline("lane_failover_s", 3.0),
+        _headline("sharded_failover_s", 11.5),
+        "[7c2] sharded failover 11.500s (8 acked jobs, 0 lost)",
+    ])
+    _round(tmp_path, 1, tail)
+    traj = bench_compare.load_history([str(tmp_path / "BENCH_r01.json")])
+    assert traj["sharded_failover_s"] == [(1, 11.5)]
+
+    # Conviction getting FASTER: an improvement, strict passes.
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text(_headline("sharded_failover_s", 6.0) + "\n",
+                     encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["sharded_failover_s"] == "improved"
+
+    # The window growing beyond threshold: a regression, strict fails.
+    fresh.write_text(_headline("sharded_failover_s", 20.0) + "\n",
+                     encoding="utf-8")
+    rc = _run(tmp_path, str(fresh), "--strict", "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_metric = {r["metric"]: r["verdict"] for r in doc["rows"]}
+    assert by_metric["sharded_failover_s"] == "REGRESSION"
+
+
 def test_proactive_repin_and_signal_metric_directions(tmp_path, capsys):
     """ISSUE 14: config [10]'s proactive tier adds
     ``fleet_proactive_repin_s`` — background adoption latency, LOWER is

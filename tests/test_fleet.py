@@ -1411,3 +1411,64 @@ def test_signals_report_dead_devices(tmp_path):
     sig = router.signals()
     assert sig["device_lanes_total"] == 1
     assert sig["devices_dead_total"] == 1
+
+
+def test_replica_weight_and_weighted_placement():
+    """Health-aware load weights (ISSUE 18 satellite): a replica's
+    weight is its live-chip fraction scaled by queue headroom; the
+    round-robin and consistent-hash paths both shed a PROPORTIONAL
+    slice of load off a degraded replica — deterministically per key,
+    so duplicate-bytes affinity survives — instead of all-or-nothing."""
+    import hashlib
+
+    urls = [f"http://127.0.0.1:{p}" for p in (1, 2, 3)]
+    router = FleetRouter(urls, check_interval_s=999.0,
+                         router_id="router-w")
+    with router._lock:
+        for u in urls:
+            router._ready[u] = True
+    # Cold start: no snapshot yet weighs 1.0 (nobody zeroed out).
+    assert router.replica_weight(urls[0]) == 1.0
+    # Equal weights: smooth WRR covers every replica evenly…
+    picks = [router.next_replica() for _ in range(6)]
+    assert {p for p in picks} == set(urls)
+    assert all(picks.count(u) == 2 for u in urls)
+    # …and placement reproduces the pure ring order bit-for-bit.
+    body = b"stack-bytes-1"
+    assert router.place_submit(body) == router.ring.preference(
+        hashlib.sha256(body).hexdigest(), avoid=set())
+    # Degrade replica 0: 1 of 2 chips dead, queue half full.
+    with router._lock:
+        router._replica_stats[urls[0]] = {
+            "queue_depth": 4, "queue_capacity": 8,
+            "lanes": {"devices": ["cpu:0", "cpu:1"],
+                      "devices_dead": ["cpu:1"], "devices_live": 1},
+        }
+    assert router.replica_weight(urls[0]) == pytest.approx(0.25)
+    assert router.replica_weight(urls[1]) == 1.0
+    # Weighted WRR: the half-dead, half-full replica draws a minority
+    # of picks — but is floored, never starved.
+    counts = {u: 0 for u in urls}
+    for _ in range(90):
+        counts[router.next_replica()] += 1
+    assert counts[urls[0]] >= 1
+    assert counts[urls[0]] < counts[urls[1]]
+    assert counts[urls[0]] < counts[urls[2]]
+    # Weighted consistent-hash placement: across many keys the
+    # degraded replica keeps only ~a quarter of its ring-first slots
+    # (sheds the rest to the NEXT preference, keeping the full
+    # candidate list), and every demotion is deterministic per key.
+    kept = total = 0
+    for i in range(200):
+        body = f"stack-{i}".encode()
+        pref = router.ring.preference(
+            hashlib.sha256(body).hexdigest(), avoid=set())
+        placed = router.place_submit(body)
+        assert sorted(placed) == sorted(pref)       # nobody dropped
+        assert placed == router.place_submit(body)  # deterministic
+        if pref[0] == urls[0]:
+            total += 1
+            if placed[0] == urls[0]:
+                kept += 1
+    assert total > 20, "ring never ranked the degraded replica first"
+    assert 0 < kept < round(0.6 * total), (kept, total)

@@ -91,10 +91,12 @@ def test_pool_shard_threshold_and_divisibility():
     # Below threshold: lane-pinned per-device program.
     small = pool.route(_bucket(H, W), 2, pool.lane(1))
     assert small.device == "cpu:1" and small.shards == 0
-    # At threshold: one cross-chip program, no device pin.
+    # At threshold: one cross-chip program, no device pin — keyed by
+    # the SET of live devices it spans, not just the width.
     big = pool.route(_bucket(HB, WB), 2, pool.lane(1))
     assert big.shards == 4 and big.device is None
-    assert big.label().endswith("@mesh4")
+    assert big.span == ("cpu:0", "cpu:1", "cpu:2", "cpu:3")
+    assert big.label().endswith("@mesh4[cpu:0+cpu:1+cpu:2+cpu:3]")
     # Rows not divisible by the shard count: refuse the sharded tier
     # (GSPMD padding would blur the dispatch decision) — lane-pinned.
     odd = pool.route(_bucket(33, 64), 1, pool.lane(0))
@@ -168,9 +170,13 @@ def test_warmup_covers_every_lane_and_the_sharded_program(service):
             assert f"B{b}:{H}x{W}x{frames}@cpu:{d}" in labels
     # Big bucket: the cross-chip sharded program only (never lane-pinned
     # — warming per-device copies of a bucket that always dispatches
-    # sharded would be dead compiles).
+    # sharded would be dead compiles). The label carries the span's
+    # device SET: the program identity IS the set of chips it runs on.
+    span = service.lanes.span_devices()
+    assert len(span) == 2
+    span_tag = "+".join(span)
     for b in BATCH_SIZES:
-        assert f"B{b}:{HB}x{WB}x{frames}@mesh2" in labels
+        assert f"B{b}:{HB}x{WB}x{frames}@mesh2[{span_tag}]" in labels
         for d in range(N_LANES):
             assert f"B{b}:{HB}x{WB}x{frames}@cpu:{d}" not in labels
     # Session-lane warmup ran once per distinct lane device.
@@ -453,22 +459,38 @@ def test_shard_degrade_ladder():
                           shard_devices=8)
     big = _bucket(32, 48)  # 32 rows: divisible by 8/4/2
     assert pool.shards_for(big) == 8
-    # A dead member OUTSIDE the degraded span halves the tier.
-    pool.mark_device_dead("cpu:7", reason="test")
+    assert pool.span_devices() == tuple(
+        sorted(f"cpu:{i}" for i in range(8)))
+    # The FIRST device in enumeration order dying no longer zeroes the
+    # tier (the old devices[:k] prefix bug): the span re-forms from the
+    # live SET — one casualty costs one member, then the power-of-two
+    # ladder picks the widest fillable width.
+    pool.mark_device_dead("cpu:0", reason="test")
     assert pool.effective_shard_devices() == 4
+    span = pool.span_devices()
+    assert "cpu:0" not in span and len(span) == 4
     assert pool.shards_for(big) == 4
-    pool.mark_device_dead("cpu:2", reason="test")
-    assert pool.shards_for(big) == 2
-    # devices[:2] dead ⇒ the tier turns OFF (lane-pinned fallback)
-    # rather than spanning a dead chip.
-    pool.mark_device_dead("cpu:1", reason="test")
+    key = pool.route(big, 1, pool.lane(1))
+    assert key.shards == 4 and key.span == span
+    assert key.device is None
+    # Further deaths walk the ladder down over whatever still lives.
+    for d in ("cpu:1", "cpu:2", "cpu:3", "cpu:4"):
+        pool.mark_device_dead(d, reason="test")
+    assert pool.effective_shard_devices() == 2   # 3 live → 2-wide
+    assert all(m not in pool.span_devices()
+               for m in ("cpu:0", "cpu:1", "cpu:2", "cpu:3", "cpu:4"))
+    pool.mark_device_dead("cpu:5", reason="test")
+    pool.mark_device_dead("cpu:6", reason="test")
+    # One survivor ⇒ the tier turns OFF (lane-pinned fallback).
     assert pool.effective_shard_devices() == 0
     assert pool.shards_for(big) == 0
-    key = pool.route(big, 1, pool.lane(0))
-    assert key.shards == 0 and key.device == "cpu:0"
-    # Revival walks back up the ladder.
-    pool.revive_device("cpu:1")
-    assert pool.shards_for(big) == 2
+    key = pool.route(big, 1, pool.lane(7))
+    assert key.shards == 0 and key.device == "cpu:7"
+    # Revival walks back up the ladder — the re-formed span is the
+    # live SET, wherever those chips sit in enumeration order.
+    pool.revive_device("cpu:0")
+    assert pool.effective_shard_devices() == 2
+    assert pool.span_devices() == ("cpu:0", "cpu:7")
 
 
 def test_watchdog_per_device_budget_and_escalation():
@@ -765,5 +787,186 @@ def test_probe_revives_device_after_transient_loss(monkeypatch,
         j = pinned(lane_stack + np.uint8(7))
         assert j.wait(60.0) and j.status == "done", j.status_dict()
         assert j.launch_retries == 0
+    finally:
+        svc.abort()
+
+
+# ---------------------------------------------------------------------------
+# Sharded-tier honesty (ISSUE 18): set-keyed spans, probe-convict,
+# revival rebalancing
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_fault_streak_fires_probe_callback():
+    """Pool-level attribution contract: sharded launch faults count per
+    SPAN (the error can't name the member), a clean launch resets the
+    streak, and the probe hook fires exactly at the threshold."""
+    pool = DeviceLanePool(n_lanes=2, shard_min_pixels=1,
+                          shard_devices=4)
+    fired: list = []
+    pool.on_span_suspect = fired.append
+    span = pool.span_devices()
+    assert len(span) == 4
+    # One fault: counted, no probe yet (hysteresis absorbs a blip).
+    assert pool.note_sharded_failure(span, reason="DeviceLostError") == 1
+    assert not fired
+    # A clean sharded launch resets the streak.
+    pool.note_sharded_ok(span)
+    assert pool.note_sharded_failure(span) == 1
+    # Second CONSECUTIVE fault: the probe callback fires with the span.
+    pool.note_sharded_failure(span)
+    assert fired == [span]
+    # The streak reset on fire — the next fault starts a fresh one
+    # (the probe verdict, not further counting, decides from here).
+    assert pool.note_sharded_failure(span) == 1
+    assert fired == [span]
+
+
+def test_rebalance_hysteresis_defers_flapping_device():
+    """Revival rebalancing with flap hysteresis: one stable revive
+    brings displaced sessions home; a second revive inside the window
+    defers (the chip is flapping) while KEEPING them recorded, so the
+    next stable revival still migrates them back."""
+    pool = DeviceLanePool(n_lanes=2, rebalance_flap_window_s=0.2)
+    home = pool.assign_session("s-v")
+    assert home.label == "cpu:0"
+    pool.mark_device_dead("cpu:0", reason="test")
+    moved = pool.repin_sessions("cpu:0")
+    assert moved["s-v"].label == "cpu:1"
+    # First revival: stable — the session comes home.
+    assert pool.revive_device("cpu:0")
+    assert pool.rebalance_sessions("cpu:0")["s-v"].label == "cpu:0"
+    # Flap: a second death + revive inside the window defers migration…
+    pool.mark_device_dead("cpu:0", reason="test")
+    assert pool.repin_sessions("cpu:0")["s-v"].label == "cpu:1"
+    assert pool.revive_device("cpu:0")
+    assert pool.rebalance_sessions("cpu:0") == {}
+    assert pool.assign_session("s-v").label == "cpu:1"  # stayed put
+    # …but once the window drains, the displaced set is still known
+    # and the session migrates home.
+    time.sleep(0.25)
+    assert pool.rebalance_sessions("cpu:0")["s-v"].label == "cpu:0"
+
+
+def test_sharded_fault_probe_convicts_first_device_and_reforms_span(
+        monkeypatch, big_stack):
+    """The set-keyed honesty gate [7c2]: the FIRST device in
+    enumeration order dies under a sharded-only load. The launch fault
+    cannot name the casualty, so after the streak threshold the
+    service probes every span member, convicts cpu:0, re-forms a
+    2-wide span from the LIVE set (the old devices[:k] prefix turned
+    the tier OFF here), warms it off the hot path, and loses zero
+    acked jobs."""
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+    from structured_light_for_3d_model_replication_tpu.serve import lanes
+
+    _arm(monkeypatch, faults.DeviceFaultRule(
+        device="cpu:0", kind="device_lost"))
+    svc = ReconstructionService(_chaos_config(
+        buckets=((HB, WB),), queue_depth=16, workers=2, devices=4,
+        shard_min_pixels=HB * WB, shard_devices=4,
+        warmup_sessions=False)).start()
+    try:
+        assert svc.lanes.span_devices() == \
+            ("cpu:0", "cpu:1", "cpu:2", "cpu:3")
+        jobs = [svc.submit_array(big_stack + np.uint8(1 + i))
+                for i in range(4)]
+        for j in jobs:
+            assert j.wait(120.0) and j.status == "done", j.status_dict()
+        # Probe-convict named the right chip — and ONLY that chip.
+        assert svc.lanes.device_state("cpu:0") == lanes.LANE_DEAD
+        for d in ("cpu:1", "cpu:2", "cpu:3"):
+            assert svc.lanes.device_state(d) == lanes.LANE_HEALTHY
+        span = svc.lanes.span_devices()
+        assert len(span) == 2 and "cpu:0" not in span
+        assert svc.lanes.effective_shard_devices() == 2
+        snap = svc.registry.snapshot()
+        assert sum(snap.get("serve_sharded_span_faults_total",
+                            {}).values()) >= 2
+        assert sum(snap.get("serve_sharded_span_probes_total",
+                            {}).values()) >= 1
+        assert sum(snap.get("serve_device_dead_total",
+                            {}).values()) == 1
+        # stats() surfaces the span set and the casualty's age.
+        st = svc.lanes.stats()
+        assert st["span_devices"] == list(span)
+        assert st["shard_devices"] == 2
+        assert st["device_health"]["cpu:0"]["state"] == lanes.LANE_DEAD
+        assert st["device_health"]["cpu:0"]["dead_since_s"] >= 0.0
+        assert st["device_health"]["cpu:1"]["dead_since_s"] is None
+        # The re-formed span was warmed OFF the worker hot path:
+        # post-conviction sharded traffic grows no program-cache
+        # misses (the zero-recompile steady state survives the span
+        # change).
+        before = svc.cache.stats()
+        j = svc.submit_array(big_stack + np.uint8(9))
+        assert j.wait(120.0) and j.status == "done", j.status_dict()
+        assert svc.cache.stats()["misses"] == before["misses"]
+    finally:
+        svc.abort()
+
+
+def test_probe_revival_rebalances_sessions_and_finalizes_bitwise(
+        monkeypatch, lane_stack):
+    """Revival rebalancing end to end: a transiently lost chip kills
+    its sticky session onto the survivor; the probe revives it, the
+    displaced session migrates HOME (compile-free — the revive path
+    re-warmed before flipping live), and finalize is bitwise-identical
+    to a never-faulted session over the same stacks."""
+    from structured_light_for_3d_model_replication_tpu.hw import faults
+    from structured_light_for_3d_model_replication_tpu.serve import lanes
+
+    # 3 worker launches die (→ dead), the 4th fault feeds the FIRST
+    # probe, then the chip answers and revives.
+    _arm(monkeypatch, faults.DeviceFaultRule(
+        device="cpu:1", kind="device_lost", count=4))
+    svc = ReconstructionService(_chaos_config(
+        device_probe_interval_s=0.2,
+        device_probe_backoff_max_s=0.5)).start()
+    try:
+        s_ok = svc.create_session({"covis": False})["session_id"]
+        s_victim = svc.create_session({"covis": False})["session_id"]
+        victim = svc.sessions.get(s_victim)
+        assert victim.lane.label == "cpu:1"
+        stacks = [lane_stack + np.uint8(1 + i) for i in range(3)]
+        jobs = [_stop(svc, s_victim, s) for s in stacks]
+        assert all(j.status == "done" for j in jobs), \
+            [j.status_dict() for j in jobs]  # zero lost acked stops
+        # (No point-in-time assert on the displaced lane here: with a
+        # 0.2s probe cadence the revival can land before this line.
+        # The repin counter + lane_moves below prove the round trip.)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and \
+                victim.lane.label != "cpu:1":
+            time.sleep(0.05)
+        assert svc.lanes.device_state("cpu:1") == lanes.LANE_HEALTHY
+        assert victim.lane.label == "cpu:1", \
+            "revival never rebalanced the displaced session home"
+        # Two moves: fled on death, came home on revival.
+        assert victim.status_dict()["lane_moves"] == 2
+        snap = svc.registry.snapshot()
+        assert sum(snap.get("serve_lane_repins_total",
+                            {}).values()) >= 1  # fled on death
+        assert sum(snap.get("serve_lane_rebalances_total",
+                            {}).values()) == 1
+        st = svc.lanes.stats()
+        assert st["revives_total"] == 1
+        assert st["device_health"]["cpu:1"]["revives"] == 1
+        assert st["device_health"]["cpu:1"]["dead_since_s"] is None
+        # Post-revival stops ride the revived home lane with ZERO
+        # program-cache miss growth.
+        before = svc.cache.stats()
+        post = _stop(svc, s_victim, lane_stack + np.uint8(7))
+        assert post.status == "done" and post.lane == victim.lane.index
+        assert svc.cache.stats()["misses"] == before["misses"]
+        # Bitwise parity: a reference session over the SAME stacks on
+        # the never-faulted lane finalizes to identical bytes.
+        for s in stacks + [lane_stack + np.uint8(7)]:
+            _stop(svc, s_ok, s)
+        got = svc.finalize_session(s_victim, result_format="ply")
+        ref = svc.finalize_session(s_ok, result_format="ply")
+        assert got.status == "done" and ref.status == "done"
+        assert len(got.result_bytes) > 0
+        assert got.result_bytes == ref.result_bytes
     finally:
         svc.abort()
